@@ -1,0 +1,22 @@
+"""d4pg_tpu — a TPU-native D4PG (distributional DDPG) framework.
+
+Built from scratch in JAX/XLA with the capabilities of the reference
+``Fzk123456/d4pg-pytorch`` (see /root/repo/SURVEY.md): DDPG/D4PG with a C51
+categorical distributional critic, n-step returns, prioritized experience
+replay, hindsight experience replay, Gaussian/OU exploration noise, and
+parallel actor/learner training.
+
+TPU-first design (not a port):
+
+- all agent math lives in one jitted ``train_step`` (``d4pg_tpu.agent``);
+- data parallelism is ``jax.shard_map`` + ``psum`` over an ICI mesh
+  (``d4pg_tpu.parallel``), replacing the reference's shared-memory Hogwild
+  scheme (reference ``main.py:371-405``, ``shared_adam.py``);
+- replay (uniform + PER segment trees, n-step, HER) runs on the TPU-VM host
+  with vectorized NumPy / native C++ trees (``d4pg_tpu.replay``);
+- environments are pure-JAX functional envs rolled out with ``lax.scan``
+  fully on device, plus a gymnasium adapter for host envs
+  (``d4pg_tpu.envs``).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,8 @@
+"""Flax neural-network modules: policy, distributional critics, encoders."""
+
+from d4pg_tpu.models.actor import Actor
+from d4pg_tpu.models.critic import Critic, DistConfig
+from d4pg_tpu.models.encoders import PixelEncoder
+from d4pg_tpu.models.init import fanin_uniform
+
+__all__ = ["Actor", "Critic", "DistConfig", "PixelEncoder", "fanin_uniform"]

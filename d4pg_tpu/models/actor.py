@@ -1,0 +1,48 @@
+"""Deterministic policy network.
+
+Capability parity with reference ``models.py:15-41``: 3×256 MLP with fan-in
+init, tanh output in (−1, 1), final layer initialized at scale 3e-3. We fix
+the reference's missing activation between its stacked ``fc2``/``fc2_2``
+layers (``models.py:36-37`` — two linear maps with no ReLU collapse to one;
+SURVEY.md quirk #9) by applying ReLU between every hidden layer.
+
+Compute dtype is configurable (bfloat16 for TPU MXU); params stay float32.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from d4pg_tpu.models.init import fanin_uniform
+
+
+class Actor(nn.Module):
+    action_dim: int
+    hidden_sizes: Sequence[int] = (256, 256, 256)
+    final_init_scale: float = 3e-3
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: jax.Array) -> jax.Array:
+        x = obs.astype(self.dtype)
+        for i, width in enumerate(self.hidden_sizes):
+            x = nn.Dense(
+                width,
+                kernel_init=fanin_uniform(),
+                bias_init=fanin_uniform(),
+                dtype=self.dtype,
+                name=f"hidden_{i}",
+            )(x)
+            x = nn.relu(x)
+        x = nn.Dense(
+            self.action_dim,
+            kernel_init=nn.initializers.uniform(scale=self.final_init_scale),
+            bias_init=nn.initializers.uniform(scale=self.final_init_scale),
+            dtype=self.dtype,
+            name="out",
+        )(x)
+        return jnp.tanh(x).astype(jnp.float32)

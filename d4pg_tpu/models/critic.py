@@ -1,0 +1,101 @@
+"""Distributional critic networks.
+
+Capability parity with reference ``models.py:51-88``: state through a 256-wide
+layer, action concatenated at the second layer (``models.py:80``), two more
+256-wide ReLU layers, then a value head. Differences, by design:
+
+- the categorical (C51) head emits **logits**, not softmax probabilities
+  (reference ``models.py:82-83``); downstream losses use ``log_softmax``.
+- a ``scalar`` head gives plain DDPG (the reference reaches this mode via
+  ``critic_dist_info['type']`` — ``ddpg.py:41-55``).
+- a ``mixture_gaussian`` head implements what the reference declares but
+  leaves TODO-empty (``ddpg.py:48-50,224-226``): K (weight, mean, log_std)
+  triples parameterizing a 1-D Gaussian mixture over returns.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from flax.struct import dataclass as flax_dataclass
+
+from d4pg_tpu.models.init import fanin_uniform
+
+
+@flax_dataclass
+class DistConfig:
+    """Static critic-head configuration (reference ``critic_dist_info`` dict,
+    ``main.py:373-376``)."""
+
+    kind: str = "categorical"  # "categorical" | "scalar" | "mixture_gaussian"
+    num_atoms: int = 51
+    v_min: float = -10.0
+    v_max: float = 10.0
+    num_mixtures: int = 5
+
+    @property
+    def head_dim(self) -> int:
+        if self.kind == "categorical":
+            return self.num_atoms
+        if self.kind == "scalar":
+            return 1
+        if self.kind == "mixture_gaussian":
+            return 3 * self.num_mixtures
+        raise ValueError(f"unknown critic head kind: {self.kind}")
+
+
+class Critic(nn.Module):
+    dist: DistConfig
+    hidden_sizes: Sequence[int] = (256, 256, 256)
+    final_init_scale: float = 3e-4
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, action: jax.Array) -> jax.Array:
+        x = obs.astype(self.dtype)
+        x = nn.Dense(
+            self.hidden_sizes[0],
+            kernel_init=fanin_uniform(),
+            bias_init=fanin_uniform(),
+            dtype=self.dtype,
+            name="hidden_0",
+        )(x)
+        x = nn.relu(x)
+        # Action injected after the first state-only layer (models.py:80).
+        x = jnp.concatenate([x, action.astype(self.dtype)], axis=-1)
+        for i, width in enumerate(self.hidden_sizes[1:], start=1):
+            x = nn.Dense(
+                width,
+                kernel_init=fanin_uniform(),
+                bias_init=fanin_uniform(),
+                dtype=self.dtype,
+                name=f"hidden_{i}",
+            )(x)
+            x = nn.relu(x)
+        out = nn.Dense(
+            self.dist.head_dim,
+            kernel_init=nn.initializers.uniform(scale=self.final_init_scale),
+            bias_init=nn.initializers.uniform(scale=self.final_init_scale),
+            dtype=self.dtype,
+            name="out",
+        )(x)
+        return out.astype(jnp.float32)
+
+
+def mixture_gaussian_params(
+    head: jax.Array, num_mixtures: int
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Split a mixture head output into (log_weights, means, stds)."""
+    logits, means, log_stds = jnp.split(head, 3, axis=-1)
+    log_w = jax.nn.log_softmax(logits, axis=-1)
+    stds = jnp.exp(jnp.clip(log_stds, -5.0, 5.0))
+    return log_w, means, stds
+
+
+def mixture_gaussian_mean(head: jax.Array, num_mixtures: int) -> jax.Array:
+    """E[Z] of the mixture head — the actor objective under this head."""
+    log_w, means, _ = mixture_gaussian_params(head, num_mixtures)
+    return jnp.sum(jnp.exp(log_w) * means, axis=-1)

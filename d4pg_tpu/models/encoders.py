@@ -1,0 +1,37 @@
+"""Observation encoders for pixel tasks (BASELINE.json config 4).
+
+The reference has no conv path; this is the dm_control-pixels capability from
+``BASELINE.json``: a small strided conv stack (channels-last, NHWC, as XLA:TPU
+prefers) feeding the MLP trunk of :class:`d4pg_tpu.models.Actor` /
+:class:`~d4pg_tpu.models.Critic`.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class PixelEncoder(nn.Module):
+    """DrQ-style conv encoder: 4 conv layers, 3x3, stride 2 then 1."""
+
+    features: Sequence[int] = (32, 32, 32, 32)
+    embed_dim: int = 50
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, pixels: jax.Array) -> jax.Array:
+        # pixels: [..., H, W, C] in [0, 255] or [0, 1]
+        x = pixels.astype(self.dtype)
+        x = jnp.where(jnp.max(jnp.abs(x)) > 2.0, x / 255.0, x)
+        for i, feat in enumerate(self.features):
+            stride = 2 if i == 0 else 1
+            x = nn.Conv(feat, (3, 3), strides=(stride, stride), dtype=self.dtype)(x)
+            x = nn.relu(x)
+        x = x.reshape(*x.shape[:-3], -1)
+        x = nn.Dense(self.embed_dim, dtype=self.dtype)(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        return jnp.tanh(x).astype(jnp.float32)

@@ -1,0 +1,20 @@
+"""Parameter initializers.
+
+``fanin_uniform`` reproduces the reference's fan-in init (``models.py:6-9``):
+U(−1/√fan_in, +1/√fan_in) on hidden layers, with small-scale output layers
+passed explicitly at the call sites (``models.py:31,73``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def fanin_uniform(dtype=jnp.float32):
+    def init(key, shape, dtype=dtype):
+        fan_in = shape[0]
+        bound = 1.0 / jnp.sqrt(jnp.asarray(fan_in, jnp.float32))
+        return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
+
+    return init

@@ -1,0 +1,39 @@
+"""Pure-functional core ops: categorical distributional RL math, noise, updates."""
+
+from d4pg_tpu.ops.categorical import (
+    CategoricalSupport,
+    categorical_projection,
+    categorical_td_loss,
+    expected_value,
+    make_support,
+)
+from d4pg_tpu.ops.noise import (
+    GaussianNoiseState,
+    OUNoiseState,
+    gaussian_noise_init,
+    gaussian_noise_reset,
+    gaussian_noise_sample,
+    ou_noise_init,
+    ou_noise_reset,
+    ou_noise_sample,
+)
+from d4pg_tpu.ops.nstep import nstep_returns
+from d4pg_tpu.ops.polyak import polyak_update
+
+__all__ = [
+    "CategoricalSupport",
+    "categorical_projection",
+    "categorical_td_loss",
+    "expected_value",
+    "make_support",
+    "GaussianNoiseState",
+    "OUNoiseState",
+    "gaussian_noise_init",
+    "gaussian_noise_reset",
+    "gaussian_noise_sample",
+    "ou_noise_init",
+    "ou_noise_reset",
+    "ou_noise_sample",
+    "nstep_returns",
+    "polyak_update",
+]

@@ -1,0 +1,93 @@
+"""Exploration noise as pure functions of (PRNG key, carried state).
+
+Capability parity with reference ``random_process.py`` (GaussianNoise at
+``:4-21``, OrnsteinUhlenbeckProcess at ``:23-45``) — but with explicit JAX key
+threading instead of global NumPy RNG, and with the ε-decay actually wired up
+(the reference's decay only fires in ``reset()``, which the active loop never
+calls — quirk #10 in SURVEY.md).
+
+All functions are jittable and vmappable over a batch of actors.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class GaussianNoiseState(NamedTuple):
+    epsilon: jax.Array  # current scale multiplier, decayed on reset()
+
+
+class OUNoiseState(NamedTuple):
+    x: jax.Array  # mean-reverting process value, [action_dim]
+    epsilon: jax.Array
+
+
+def gaussian_noise_init(epsilon: float = 0.3) -> GaussianNoiseState:
+    return GaussianNoiseState(epsilon=jnp.asarray(epsilon, jnp.float32))
+
+
+def gaussian_noise_sample(
+    state: GaussianNoiseState,
+    key: jax.Array,
+    shape: tuple[int, ...],
+    mu: float = 0.0,
+    sigma: float = 1.0,
+) -> jax.Array:
+    """ε·N(μ, σ) (reference ``random_process.py:16-18``)."""
+    return state.epsilon * (mu + sigma * jax.random.normal(key, shape))
+
+
+def gaussian_noise_reset(
+    state: GaussianNoiseState,
+    decay: float = 0.001,
+    epsilon_min: float = 0.0,
+) -> GaussianNoiseState:
+    """Per-episode exponential ε decay (reference ``random_process.py:20-21``)."""
+    eps = jnp.maximum(state.epsilon * (1.0 - decay), epsilon_min)
+    return GaussianNoiseState(epsilon=eps)
+
+
+def ou_noise_init(
+    action_dim: int,
+    epsilon: float = 1.0,
+    x0: float = 0.0,
+) -> OUNoiseState:
+    return OUNoiseState(
+        x=jnp.full((action_dim,), x0, jnp.float32),
+        epsilon=jnp.asarray(epsilon, jnp.float32),
+    )
+
+
+def ou_noise_sample(
+    state: OUNoiseState,
+    key: jax.Array,
+    theta: float = 0.15,
+    mu: float = 0.0,
+    sigma: float = 0.2,
+    dt: float = 1e-2,
+) -> tuple[jax.Array, OUNoiseState]:
+    """One step of the mean-reverting OU process (reference ``random_process.py:37-40``).
+
+    x ← x + θ(μ−x)dt + σ√dt·N(0,1); returns (ε·x, new state).
+    """
+    dx = theta * (mu - state.x) * dt + sigma * jnp.sqrt(dt) * jax.random.normal(
+        key, state.x.shape
+    )
+    x = state.x + dx
+    return state.epsilon * x, OUNoiseState(x=x, epsilon=state.epsilon)
+
+
+def ou_noise_reset(
+    state: OUNoiseState,
+    decay: float = 0.001,
+    epsilon_min: float = 0.0,
+    x0: float = 0.0,
+) -> OUNoiseState:
+    return OUNoiseState(
+        x=jnp.full_like(state.x, x0),
+        epsilon=jnp.maximum(state.epsilon * (1.0 - decay), epsilon_min),
+    )

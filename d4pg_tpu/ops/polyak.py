@@ -1,0 +1,15 @@
+"""Target-network updates as pytree maps (reference ``ddpg.py:92-94,110-116``)."""
+
+from __future__ import annotations
+
+import jax
+
+
+def polyak_update(target_params, online_params, tau: float):
+    """θ' ← (1−τ)θ' + τθ over an arbitrary pytree (reference ``ddpg.py:110-116``).
+
+    tau=1.0 reproduces ``hard_update`` (reference ``ddpg.py:92-94``).
+    """
+    return jax.tree_util.tree_map(
+        lambda t, o: (1.0 - tau) * t + tau * o, target_params, online_params
+    )

@@ -1,0 +1,129 @@
+"""Unit tests for the categorical projection against a NumPy oracle.
+
+The oracle is an independent per-sample, per-atom loop implementing
+Φ(R + γ_eff·z) from the C51/D4PG papers (the reference's own two
+implementations disagree on n-step discounting — SURVEY.md §4 — so the oracle,
+not the reference, pins correctness).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from d4pg_tpu.ops import (
+    categorical_projection,
+    categorical_td_loss,
+    expected_value,
+    make_support,
+)
+
+
+def oracle_projection(v_min, v_max, num_atoms, probs, rewards, discounts):
+    z = np.linspace(v_min, v_max, num_atoms)
+    delta = (v_max - v_min) / (num_atoms - 1)
+    out = np.zeros_like(probs)
+    for i in range(probs.shape[0]):
+        for j in range(num_atoms):
+            tz = np.clip(rewards[i] + discounts[i] * z[j], v_min, v_max)
+            b = (tz - v_min) / delta
+            lo, hi = int(np.floor(b)), int(np.ceil(b))
+            if lo == hi:
+                out[i, lo] += probs[i, j]
+            else:
+                out[i, lo] += probs[i, j] * (hi - b)
+                out[i, hi] += probs[i, j] * (b - lo)
+    return out
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_projection_matches_oracle(seed):
+    rng = np.random.default_rng(seed)
+    batch, atoms = 32, 51
+    support = make_support(-10.0, 10.0, atoms)
+    logits = rng.normal(size=(batch, atoms))
+    probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    rewards = rng.uniform(-15, 15, size=batch)
+    # Mix of terminal (0), full n-step (gamma^n), and truncated windows.
+    discounts = rng.choice([0.0, 0.99**5, 0.99**2, 0.99], size=batch)
+
+    got = np.asarray(
+        categorical_projection(
+            support,
+            jnp.asarray(probs, jnp.float32),
+            jnp.asarray(rewards, jnp.float32),
+            jnp.asarray(discounts, jnp.float32),
+        )
+    )
+    want = oracle_projection(-10.0, 10.0, atoms, probs, rewards, discounts)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+    # Projection conserves probability mass.
+    np.testing.assert_allclose(got.sum(-1), 1.0, atol=1e-5)
+
+
+def test_terminal_collapses_to_reward_atom():
+    support = make_support(-10.0, 10.0, 21)  # delta = 1.0, atoms at integers
+    probs = jnp.ones((1, 21)) / 21.0
+    out = categorical_projection(
+        support, probs, jnp.asarray([-3.0]), jnp.asarray([0.0])
+    )
+    expected = np.zeros(21)
+    expected[7] = 1.0  # atom for value -3
+    np.testing.assert_allclose(np.asarray(out[0]), expected, atol=1e-6)
+
+
+def test_reward_clipping_to_support_edges():
+    support = make_support(-1.0, 1.0, 5)
+    probs = jnp.ones((2, 5)) / 5.0
+    out = categorical_projection(
+        support, probs, jnp.asarray([100.0, -100.0]), jnp.asarray([0.0, 0.0])
+    )
+    np.testing.assert_allclose(np.asarray(out[0]), [0, 0, 0, 0, 1], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(out[1]), [1, 0, 0, 0, 0], atol=1e-6)
+
+
+def test_identity_projection():
+    # r=0, discount=1 maps every atom onto itself.
+    support = make_support(-2.0, 2.0, 9)
+    rng = np.random.default_rng(3)
+    p = rng.dirichlet(np.ones(9), size=4).astype(np.float32)
+    out = categorical_projection(
+        support, jnp.asarray(p), jnp.zeros(4), jnp.ones(4)
+    )
+    np.testing.assert_allclose(np.asarray(out), p, atol=1e-5)
+
+
+def test_projection_is_jittable_and_grads_flow():
+    support = make_support(-5.0, 5.0, 11)
+
+    @jax.jit
+    def loss_fn(logits):
+        probs = jax.nn.softmax(logits)
+        proj = categorical_projection(
+            support, probs, jnp.ones(4) * 0.5, jnp.full(4, 0.99)
+        )
+        loss, per = categorical_td_loss(logits, proj)
+        return loss
+
+    g = jax.grad(loss_fn)(jnp.zeros((4, 11)))
+    assert np.all(np.isfinite(np.asarray(g)))
+
+
+def test_td_loss_matches_manual_ce():
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(8, 51)), jnp.float32)
+    target = jnp.asarray(rng.dirichlet(np.ones(51), size=8), jnp.float32)
+    w = jnp.asarray(rng.uniform(0.5, 1.0, size=8), jnp.float32)
+    loss, per = categorical_td_loss(logits, target, w)
+    p = np.asarray(jax.nn.softmax(logits))
+    manual = -(np.asarray(target) * np.log(p)).sum(-1)
+    np.testing.assert_allclose(np.asarray(per), manual, rtol=1e-5)
+    np.testing.assert_allclose(
+        float(loss), float((np.asarray(w) * manual).mean()), rtol=1e-5
+    )
+
+
+def test_expected_value():
+    support = make_support(0.0, 10.0, 11)
+    probs = jnp.zeros((1, 11)).at[0, 3].set(1.0)
+    assert float(expected_value(support, probs)[0]) == pytest.approx(3.0)

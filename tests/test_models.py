@@ -1,0 +1,85 @@
+"""Shape/init/semantics tests for the Flax modules."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from d4pg_tpu.models import Actor, Critic, DistConfig, PixelEncoder
+from d4pg_tpu.models.critic import mixture_gaussian_mean
+
+
+def test_actor_shapes_and_range():
+    actor = Actor(action_dim=6)
+    params = actor.init(jax.random.PRNGKey(0), jnp.zeros((1, 17)))
+    out = actor.apply(params, jnp.ones((32, 17)) * 100.0)
+    assert out.shape == (32, 6)
+    assert np.all(np.abs(np.asarray(out)) <= 1.0)
+
+
+def test_actor_hidden_layers_have_relu_between():
+    # Two distinct hidden mats must not collapse: output of a 2-hidden-layer
+    # actor on x and -x should differ in magnitude (ReLU nonlinearity), unlike
+    # a purely linear stack where f(-x)+f(x)-2f(0) == 0.
+    actor = Actor(action_dim=1, hidden_sizes=(16, 16), final_init_scale=1.0)
+    params = actor.init(jax.random.PRNGKey(1), jnp.zeros((1, 4)))
+
+    def pre_tanh(x):
+        return np.arctanh(np.clip(np.asarray(actor.apply(params, x)), -0.999999, 0.999999))
+
+    x = jnp.ones((1, 4)) * 0.5
+    resid = pre_tanh(x) + pre_tanh(-x) - 2 * pre_tanh(jnp.zeros((1, 4)))
+    assert np.abs(resid).max() > 1e-6
+
+
+def test_critic_categorical_head():
+    dist = DistConfig(kind="categorical", num_atoms=51)
+    critic = Critic(dist=dist)
+    params = critic.init(jax.random.PRNGKey(0), jnp.zeros((1, 17)), jnp.zeros((1, 6)))
+    logits = critic.apply(params, jnp.ones((8, 17)), jnp.ones((8, 6)))
+    assert logits.shape == (8, 51)
+    probs = jax.nn.softmax(logits)
+    np.testing.assert_allclose(np.asarray(probs.sum(-1)), 1.0, atol=1e-5)
+
+
+def test_critic_scalar_head():
+    critic = Critic(dist=DistConfig(kind="scalar"))
+    params = critic.init(jax.random.PRNGKey(0), jnp.zeros((1, 3)), jnp.zeros((1, 1)))
+    q = critic.apply(params, jnp.ones((4, 3)), jnp.ones((4, 1)))
+    assert q.shape == (4, 1)
+
+
+def test_critic_mixture_head():
+    dist = DistConfig(kind="mixture_gaussian", num_mixtures=5)
+    critic = Critic(dist=dist)
+    params = critic.init(jax.random.PRNGKey(0), jnp.zeros((1, 3)), jnp.zeros((1, 1)))
+    head = critic.apply(params, jnp.ones((4, 3)), jnp.ones((4, 1)))
+    assert head.shape == (4, 15)
+    mean = mixture_gaussian_mean(head, 5)
+    assert mean.shape == (4,)
+    assert np.all(np.isfinite(np.asarray(mean)))
+
+
+def test_critic_depends_on_action():
+    critic = Critic(dist=DistConfig(kind="scalar"))
+    params = critic.init(jax.random.PRNGKey(0), jnp.zeros((1, 3)), jnp.zeros((1, 2)))
+    q1 = critic.apply(params, jnp.ones((1, 3)), jnp.zeros((1, 2)))
+    q2 = critic.apply(params, jnp.ones((1, 3)), jnp.ones((1, 2)))
+    assert float(jnp.abs(q1 - q2).sum()) > 1e-6
+
+
+def test_fanin_init_bounds():
+    actor = Actor(action_dim=2)
+    params = actor.init(jax.random.PRNGKey(0), jnp.zeros((1, 100)))
+    k = np.asarray(params["params"]["hidden_0"]["kernel"])
+    bound = 1.0 / np.sqrt(100)
+    assert np.abs(k).max() <= bound + 1e-7
+    out_k = np.asarray(params["params"]["out"]["kernel"])
+    assert np.abs(out_k).max() <= 3e-3 + 1e-7
+
+
+def test_pixel_encoder():
+    enc = PixelEncoder()
+    params = enc.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)))
+    z = enc.apply(params, jnp.ones((2, 64, 64, 3)) * 255.0)
+    assert z.shape == (2, 50)
+    assert np.all(np.abs(np.asarray(z)) <= 1.0)

@@ -1,0 +1,76 @@
+"""Golden/statistical tests for noise processes under fixed PRNG keys."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from d4pg_tpu.ops import (
+    gaussian_noise_init,
+    gaussian_noise_reset,
+    gaussian_noise_sample,
+    ou_noise_init,
+    ou_noise_reset,
+    ou_noise_sample,
+)
+
+
+def test_gaussian_scale_and_decay():
+    state = gaussian_noise_init(epsilon=0.3)
+    key = jax.random.PRNGKey(0)
+    samples = gaussian_noise_sample(state, key, (10000,), sigma=1.0)
+    assert abs(float(jnp.std(samples)) - 0.3) < 0.01
+    for _ in range(100):
+        state = gaussian_noise_reset(state, decay=0.01)
+    assert abs(float(state.epsilon) - 0.3 * 0.99**100) < 1e-5
+
+
+def test_gaussian_deterministic_under_key():
+    state = gaussian_noise_init()
+    key = jax.random.PRNGKey(42)
+    a = gaussian_noise_sample(state, key, (5,))
+    b = gaussian_noise_sample(state, key, (5,))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_ou_mean_reversion():
+    # With sigma=0 the process decays exponentially toward mu.
+    state = ou_noise_init(action_dim=1, x0=1.0)
+    key = jax.random.PRNGKey(0)
+    for _ in range(500):
+        _, state = ou_noise_sample(state, key, theta=0.15, mu=0.0, sigma=0.0, dt=0.1)
+    assert abs(float(state.x[0])) < 1e-3
+
+
+def test_ou_stationary_std():
+    # OU stationary std = sigma / sqrt(2 theta) (in dt->continuous limit).
+    state = ou_noise_init(action_dim=512)
+    key = jax.random.PRNGKey(1)
+    vals = []
+    for i in range(2000):
+        key, sub = jax.random.split(key)
+        x, state = ou_noise_sample(state, sub, theta=0.15, sigma=0.2, dt=1e-2)
+        if i > 500:
+            vals.append(np.asarray(x))
+    std = np.std(np.concatenate(vals))
+    expected = 0.2 / np.sqrt(2 * 0.15)
+    assert abs(std - expected) / expected < 0.15
+
+
+def test_ou_reset_restores_x_and_decays_eps():
+    state = ou_noise_init(action_dim=3, epsilon=1.0, x0=0.5)
+    key = jax.random.PRNGKey(2)
+    _, state = ou_noise_sample(state, key)
+    state = ou_noise_reset(state, decay=0.1, x0=0.5)
+    np.testing.assert_allclose(np.asarray(state.x), 0.5)
+    assert abs(float(state.epsilon) - 0.9) < 1e-6
+
+
+def test_noise_fns_are_jittable():
+    sample = jax.jit(
+        lambda s, k: gaussian_noise_sample(s, k, (4,)), static_argnums=()
+    )
+    out = sample(gaussian_noise_init(), jax.random.PRNGKey(0))
+    assert out.shape == (4,)
+    ou = jax.jit(ou_noise_sample)
+    x, st = ou(ou_noise_init(2), jax.random.PRNGKey(0))
+    assert x.shape == (2,)

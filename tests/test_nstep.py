@@ -1,0 +1,49 @@
+"""Tests for on-device n-step return windows."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from d4pg_tpu.ops import nstep_returns
+
+
+def oracle(rewards, dones, gamma, n):
+    T = len(rewards)
+    rets = np.zeros(T)
+    boot = np.zeros(T)
+    for t in range(T):
+        g, alive = 0.0, True
+        steps = 0
+        for k in range(n):
+            if t + k >= T or not alive:
+                alive = False
+                break
+            g += gamma**k * rewards[t + k]
+            steps += 1
+            if dones[t + k]:
+                alive = False
+                break
+        rets[t] = g
+        boot[t] = (gamma**n) if (alive and steps == n) else 0.0
+    return rets, boot
+
+
+def test_nstep_matches_oracle():
+    rng = np.random.default_rng(0)
+    T = 64
+    rewards = rng.normal(size=T)
+    dones = (rng.uniform(size=T) < 0.15).astype(np.float64)
+    for n in (1, 3, 5):
+        got_r, got_b = nstep_returns(
+            jnp.asarray(rewards, jnp.float32), jnp.asarray(dones, jnp.float32), 0.99, n
+        )
+        want_r, want_b = oracle(rewards, dones, 0.99, n)
+        np.testing.assert_allclose(np.asarray(got_r), want_r, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(got_b), want_b, rtol=1e-5, atol=1e-6)
+
+
+def test_one_step_reduces_to_rewards():
+    rewards = jnp.asarray([1.0, 2.0, 3.0])
+    dones = jnp.asarray([0.0, 0.0, 1.0])
+    r, b = nstep_returns(rewards, dones, 0.9, 1)
+    np.testing.assert_allclose(np.asarray(r), [1, 2, 3], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(b), [0.9, 0.9, 0.0], atol=1e-6)
