@@ -1,0 +1,180 @@
+"""Benchmark: learner grad-steps/sec on TPU vs a reference-style CPU-torch learner.
+
+Prints ONE JSON line:
+  {"metric": "learner_grad_steps_per_sec", "value": N, "unit": "steps/s",
+   "vs_baseline": R}
+
+The measured workload is the flagship D4PG configuration from BASELINE.json
+(HalfCheetah-scale: obs 17, act 6, 3×256 MLPs, C51 with 51 atoms, batch 256):
+one full fused train step — two target forwards, categorical projection,
+critic CE + actor −E[Q] losses, both Adam updates, Polyak — steady-state
+with donated device buffers.
+
+``vs_baseline`` divides by the same step implemented the way the reference
+runs it (pure CPU PyTorch + a NumPy host-side projection, mirroring the
+structure of ``ddpg.py:200-255`` without copying it). The reference publishes
+no numbers (BASELINE.md), so its measured-here CPU throughput is the
+comparison point.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+BATCH = 256
+OBS_DIM = 17
+ACT_DIM = 6
+HIDDEN = 256
+ATOMS = 51
+V_MIN, V_MAX = -150.0, 150.0
+WARMUP_STEPS = 20
+MEASURE_STEPS = 200
+BASELINE_MEASURE_STEPS = 50
+
+
+def bench_tpu() -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from d4pg_tpu.agent import D4PGConfig, create_train_state, jit_train_step
+    from d4pg_tpu.models.critic import DistConfig
+
+    config = D4PGConfig(
+        obs_dim=OBS_DIM,
+        action_dim=ACT_DIM,
+        hidden_sizes=(HIDDEN, HIDDEN, HIDDEN),
+        dist=DistConfig(kind="categorical", num_atoms=ATOMS, v_min=V_MIN, v_max=V_MAX),
+    )
+    state = create_train_state(config, jax.random.PRNGKey(0))
+    step = jit_train_step(config, donate=True)
+    rng = np.random.default_rng(0)
+    batch = {
+        "obs": jnp.asarray(rng.normal(size=(BATCH, OBS_DIM)), jnp.float32),
+        "action": jnp.asarray(rng.uniform(-1, 1, size=(BATCH, ACT_DIM)), jnp.float32),
+        "reward": jnp.asarray(rng.uniform(-1, 0, size=BATCH), jnp.float32),
+        "next_obs": jnp.asarray(rng.normal(size=(BATCH, OBS_DIM)), jnp.float32),
+        "discount": jnp.full((BATCH,), 0.99, jnp.float32),
+        "weights": jnp.ones((BATCH,), jnp.float32),
+    }
+    batch = jax.device_put(batch)
+    for _ in range(WARMUP_STEPS):
+        state, metrics, priorities = step(state, batch)
+    jax.block_until_ready(priorities)
+    t0 = time.perf_counter()
+    for _ in range(MEASURE_STEPS):
+        state, metrics, priorities = step(state, batch)
+    jax.block_until_ready(priorities)
+    dt = time.perf_counter() - t0
+    return MEASURE_STEPS / dt
+
+
+def bench_torch_cpu_baseline() -> float:
+    """Reference-style D4PG step: CPU torch nets + host NumPy projection."""
+    import torch
+    import torch.nn as nn
+
+    torch.set_num_threads(max(1, (torch.get_num_threads())))
+
+    class TActor(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.net = nn.Sequential(
+                nn.Linear(OBS_DIM, HIDDEN), nn.ReLU(),
+                nn.Linear(HIDDEN, HIDDEN), nn.ReLU(),
+                nn.Linear(HIDDEN, HIDDEN), nn.ReLU(),
+                nn.Linear(HIDDEN, ACT_DIM), nn.Tanh(),
+            )
+
+        def forward(self, x):
+            return self.net(x)
+
+    class TCritic(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(OBS_DIM, HIDDEN)
+            self.fc2 = nn.Linear(HIDDEN + ACT_DIM, HIDDEN)
+            self.fc3 = nn.Linear(HIDDEN, HIDDEN)
+            self.head = nn.Linear(HIDDEN, ATOMS)
+
+        def forward(self, s, a):
+            x = torch.relu(self.fc1(s))
+            x = torch.relu(self.fc2(torch.cat([x, a], -1)))
+            x = torch.relu(self.fc3(x))
+            return torch.softmax(self.head(x), -1)
+
+    actor, critic = TActor(), TCritic()
+    actor_t, critic_t = TActor(), TCritic()
+    actor_t.load_state_dict(actor.state_dict())
+    critic_t.load_state_dict(critic.state_dict())
+    opt_a = torch.optim.Adam(actor.parameters(), lr=1e-4)
+    opt_c = torch.optim.Adam(critic.parameters(), lr=1e-4)
+    z = np.linspace(V_MIN, V_MAX, ATOMS)
+    delta = (V_MAX - V_MIN) / (ATOMS - 1)
+    zt = torch.tensor(z, dtype=torch.float32)
+
+    rng = np.random.default_rng(0)
+    obs = torch.tensor(rng.normal(size=(BATCH, OBS_DIM)), dtype=torch.float32)
+    act = torch.tensor(rng.uniform(-1, 1, size=(BATCH, ACT_DIM)), dtype=torch.float32)
+    rew = rng.uniform(-1, 0, size=BATCH)
+    nobs = torch.tensor(rng.normal(size=(BATCH, OBS_DIM)), dtype=torch.float32)
+    disc = np.full(BATCH, 0.99)
+
+    def one_step():
+        with torch.no_grad():
+            na = actor_t(nobs)
+            tp = critic_t(nobs, na).numpy()  # host hop like ddpg.py:214
+        # vectorized NumPy projection (reference's own vectorized form)
+        tz = np.clip(rew[:, None] + disc[:, None] * z[None, :], V_MIN, V_MAX)
+        b = (tz - V_MIN) / delta
+        lo, hi = np.floor(b).astype(int), np.ceil(b).astype(int)
+        m = np.zeros_like(tp)
+        eq = lo == hi
+        np.add.at(m, (np.arange(BATCH)[:, None], lo), tp * (np.where(eq, 1.0, hi - b)))
+        np.add.at(m, (np.arange(BATCH)[:, None], hi), tp * (b - lo))
+        mt = torch.tensor(m, dtype=torch.float32)
+        pred = critic(obs, act)
+        closs = -(mt * torch.log(pred + 1e-10)).sum(-1).mean()
+        opt_c.zero_grad()
+        closs.backward()
+        opt_c.step()
+        a = actor(obs)
+        aloss = -(critic(obs, a) * zt).sum(-1).mean()
+        opt_a.zero_grad()
+        aloss.backward()
+        opt_a.step()
+        with torch.no_grad():
+            for t, s in zip(actor_t.parameters(), actor.parameters()):
+                t.mul_(0.999).add_(0.001 * s)
+            for t, s in zip(critic_t.parameters(), critic.parameters()):
+                t.mul_(0.999).add_(0.001 * s)
+
+    for _ in range(5):
+        one_step()
+    t0 = time.perf_counter()
+    for _ in range(BASELINE_MEASURE_STEPS):
+        one_step()
+    dt = time.perf_counter() - t0
+    return BASELINE_MEASURE_STEPS / dt
+
+
+def main() -> None:
+    tpu = bench_tpu()
+    baseline = bench_torch_cpu_baseline()
+    print(
+        json.dumps(
+            {
+                "metric": "learner_grad_steps_per_sec",
+                "value": round(tpu, 2),
+                "unit": "steps/s",
+                "vs_baseline": round(tpu / baseline, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

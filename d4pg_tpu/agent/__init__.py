@@ -1,0 +1,24 @@
+"""Agent core: the D4PG algorithm as one fused, jittable train step."""
+
+from d4pg_tpu.agent.state import D4PGConfig, TrainState
+from d4pg_tpu.agent.d4pg import (
+    act,
+    act_deterministic,
+    build_networks,
+    create_train_state,
+    jit_train_step,
+    support_of,
+    train_step,
+)
+
+__all__ = [
+    "D4PGConfig",
+    "TrainState",
+    "act",
+    "act_deterministic",
+    "build_networks",
+    "create_train_state",
+    "jit_train_step",
+    "support_of",
+    "train_step",
+]

@@ -1,0 +1,329 @@
+"""D4PG algorithm core: one fused, jittable SGD step.
+
+Everything the reference does between ``sample()`` and
+``update_priorities`` (``ddpg.py:200-255``, SURVEY.md §3.2) — two target
+forwards, the categorical Bellman projection, critic CE loss with PER
+importance weights, actor −E[Q] loss, both Adam updates, the Polyak target
+update, and new priorities — compiles into ONE XLA computation with no
+host↔device hops (the reference round-trips through NumPy every step at
+``ddpg.py:214`` and ``utils.py:7-10``).
+
+The functions are pure: (state, batch) → (state, metrics, priorities). Data
+parallelism wraps them unchanged (``d4pg_tpu.parallel``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from d4pg_tpu.agent.state import D4PGConfig, TrainState
+from d4pg_tpu.models import Actor, Critic
+from d4pg_tpu.ops import (
+    CategoricalSupport,
+    categorical_projection,
+    categorical_td_loss,
+    expected_value,
+    gaussian_noise_init,
+    gaussian_noise_sample,
+    make_support,
+    ou_noise_init,
+    ou_noise_reset,
+    ou_noise_sample,
+    polyak_update,
+)
+from d4pg_tpu.models.critic import mixture_gaussian_mean
+
+
+def _dtype(config: D4PGConfig):
+    return jnp.bfloat16 if config.compute_dtype == "bfloat16" else jnp.float32
+
+
+def build_networks(config: D4PGConfig) -> tuple[Actor, Critic]:
+    actor = Actor(
+        action_dim=config.action_dim,
+        hidden_sizes=tuple(config.hidden_sizes),
+        dtype=_dtype(config),
+    )
+    critic = Critic(
+        dist=config.dist,
+        hidden_sizes=tuple(config.hidden_sizes),
+        dtype=_dtype(config),
+    )
+    return actor, critic
+
+
+def make_optimizers(config: D4PGConfig):
+    adam = partial(optax.adam, b1=config.adam_b1, b2=config.adam_b2)
+    return adam(config.lr_actor), adam(config.lr_critic)
+
+
+def support_of(config: D4PGConfig) -> CategoricalSupport:
+    return make_support(config.dist.v_min, config.dist.v_max, config.dist.num_atoms)
+
+
+def create_train_state(config: D4PGConfig, key: jax.Array) -> TrainState:
+    """Initialize params, hard-copy targets (reference ``ddpg.py:57-64,92-94``)."""
+    actor, critic = build_networks(config)
+    k_actor, k_critic, k_state = jax.random.split(key, 3)
+    obs = jnp.zeros((1, config.obs_dim))
+    action = jnp.zeros((1, config.action_dim))
+    actor_params = actor.init(k_actor, obs)
+    critic_params = critic.init(k_critic, obs, action)
+    actor_opt, critic_opt = make_optimizers(config)
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        actor_params=actor_params,
+        critic_params=critic_params,
+        target_actor_params=jax.tree_util.tree_map(jnp.copy, actor_params),
+        target_critic_params=jax.tree_util.tree_map(jnp.copy, critic_params),
+        actor_opt_state=actor_opt.init(actor_params),
+        critic_opt_state=critic_opt.init(critic_params),
+        key=k_state,
+    )
+
+
+def act(
+    config: D4PGConfig,
+    actor_params: Any,
+    obs: jax.Array,
+    key: jax.Array,
+    noise_scale: jax.Array | float = 1.0,
+) -> jax.Array:
+    """Stateless exploration policy: tanh actor + scaled Gaussian noise,
+    clipped to [−1, 1] (reference ``main.py:145-147``). jit/vmap-able.
+
+    OU noise is stateful; use :func:`make_noise` + a stateful rollout policy
+    for it (``config.noise_kind`` is honored there, not here).
+    """
+    actor, _ = build_networks(config)
+    a = actor.apply(actor_params, obs)
+    noise = gaussian_noise_sample(
+        gaussian_noise_init(config.noise_epsilon),
+        key,
+        a.shape,
+        sigma=config.noise_sigma,
+    )
+    return jnp.clip(a + noise_scale * noise, -1.0, 1.0)
+
+
+def make_noise(config: D4PGConfig):
+    """Noise process selected by ``config.noise_kind`` as an (init, sample,
+    reset) triple of pure functions over an explicit state.
+
+    The reference hardcodes Gaussian and parses-but-ignores the ``ou_*``
+    flags (SURVEY.md quirk #13); here both are first-class:
+
+      - ``init() -> state``
+      - ``sample(state, key, shape) -> (noise, state)``
+      - ``reset(state) -> state``  (per-episode; applies the ε-decay the
+        reference defines but never triggers — quirk #10)
+    """
+    if config.noise_kind == "gaussian":
+        base = gaussian_noise_init(config.noise_epsilon)
+
+        def init():
+            return base
+
+        def sample(state, key, shape):
+            return (
+                gaussian_noise_sample(state, key, shape, sigma=config.noise_sigma),
+                state,
+            )
+
+        def reset(state):
+            return state  # ε-decay handled by the trainer's noise_scale schedule
+
+    elif config.noise_kind == "ou":
+
+        def init():
+            return ou_noise_init(config.action_dim, epsilon=config.noise_epsilon)
+
+        def sample(state, key, shape):
+            x, state = ou_noise_sample(
+                state,
+                key,
+                theta=config.ou_theta,
+                mu=config.ou_mu,
+                sigma=config.ou_sigma,
+            )
+            return jnp.broadcast_to(x, shape), state
+
+        def reset(state):
+            return ou_noise_reset(state, decay=0.0)
+
+    else:
+        raise ValueError(f"unknown noise kind: {config.noise_kind}")
+    return init, sample, reset
+
+
+def act_deterministic(config: D4PGConfig, actor_params: Any, obs: jax.Array) -> jax.Array:
+    """Greedy policy for evaluation (reference ``main.py:122,324``)."""
+    actor, _ = build_networks(config)
+    return actor.apply(actor_params, obs)
+
+
+def _critic_value(config: D4PGConfig, support, head: jax.Array) -> jax.Array:
+    """E[Z] under whichever head the critic is configured with."""
+    kind = config.dist.kind
+    if kind == "categorical":
+        return expected_value(support, jax.nn.softmax(head, axis=-1))
+    if kind == "scalar":
+        return head[..., 0]
+    if kind == "mixture_gaussian":
+        return mixture_gaussian_mean(head, config.dist.num_mixtures)
+    raise ValueError(kind)
+
+
+def train_step(
+    config: D4PGConfig,
+    state: TrainState,
+    batch: Mapping[str, jax.Array],
+    axis_name: str | None = None,
+) -> tuple[TrainState, Mapping[str, jax.Array], jax.Array]:
+    """One full D4PG SGD step (the reference §3.2 hot loop, fused).
+
+    Args:
+      config: static hyperparameters (close over it or mark static in jit).
+      state: complete learner state.
+      batch: obs [B,O], action [B,A], reward [B], next_obs [B,O],
+        discount [B] (= γ^m·(1−terminal), from the n-step writer), and
+        optionally weights [B] (PER importance weights; absent → ones).
+      axis_name: when running under ``shard_map`` over a device mesh, the
+        mesh axis to ``pmean`` gradients/metrics over. This single hook is
+        the synchronous-DP replacement for the reference's entire
+        shared-memory gradient scheme (``ddpg.py:104-108``,
+        ``shared_adam.py``): each device computes grads on its batch shard,
+        one AllReduce over ICI averages them, every replica applies the same
+        Adam update. ``None`` → single-device semantics.
+
+    Returns:
+      (new_state, metrics, priorities[B] — local shard under shard_map).
+    """
+
+    def _sync(tree):
+        if axis_name is None:
+            return tree
+        return jax.lax.pmean(tree, axis_name)
+
+    actor, critic = build_networks(config)
+    actor_opt, critic_opt = make_optimizers(config)
+    support = support_of(config)
+    weights = batch.get("weights")
+    if weights is None:
+        weights = jnp.ones_like(batch["reward"])
+
+    # ---- target: y = Φ(r + γ_eff · Z_target(s', μ_target(s'))) ----
+    next_action = actor.apply(state.target_actor_params, batch["next_obs"])
+    target_head = critic.apply(
+        state.target_critic_params, batch["next_obs"], next_action
+    )
+
+    if config.dist.kind == "categorical":
+        target_probs = jax.nn.softmax(target_head, axis=-1)
+        proj = categorical_projection(
+            support, target_probs, batch["reward"], batch["discount"]
+        )
+        proj = jax.lax.stop_gradient(proj)
+
+        def critic_loss_fn(critic_params):
+            pred = critic.apply(critic_params, batch["obs"], batch["action"])
+            loss, per_sample_ce = categorical_td_loss(pred, proj, weights)
+            if config.priority_kind == "overlap":
+                # Reference-compatible surrogate |−Σ m·p| (ddpg.py:220-222).
+                per_sample = jnp.abs(
+                    -jnp.sum(proj * jax.nn.softmax(pred, axis=-1), axis=-1)
+                )
+            else:
+                per_sample = per_sample_ce
+            return loss, per_sample
+    elif config.dist.kind == "scalar":
+        # Plain DDPG TD(0)/TD(n) target (BASELINE.json config 1).
+        y = batch["reward"] + batch["discount"] * target_head[..., 0]
+        y = jax.lax.stop_gradient(y)
+
+        def critic_loss_fn(critic_params):
+            pred = critic.apply(critic_params, batch["obs"], batch["action"])[..., 0]
+            td = pred - y
+            loss = jnp.mean(weights * jnp.square(td))
+            return loss, jnp.abs(td)
+    elif config.dist.kind == "mixture_gaussian":
+        # Sample-based mixture target: E-step free form — match the mixture's
+        # log-likelihood of the Bellman-transformed target mean (the D4PG
+        # paper's alternative head; reference declares but never implements
+        # it, ddpg.py:48-50).
+        y = batch["reward"] + batch["discount"] * _critic_value(
+            config, support, target_head
+        )
+        y = jax.lax.stop_gradient(y)
+
+        def critic_loss_fn(critic_params):
+            head = critic.apply(critic_params, batch["obs"], batch["action"])
+            from d4pg_tpu.models.critic import mixture_gaussian_params
+
+            log_w, means, stds = mixture_gaussian_params(
+                head, config.dist.num_mixtures
+            )
+            z = (y[:, None] - means) / stds
+            log_comp = log_w - 0.5 * z**2 - jnp.log(stds) - 0.5 * jnp.log(2 * jnp.pi)
+            nll = -jax.nn.logsumexp(log_comp, axis=-1)
+            return jnp.mean(weights * nll), nll
+    else:
+        raise ValueError(config.dist.kind)
+
+    (critic_loss, priorities), critic_grads = jax.value_and_grad(
+        critic_loss_fn, has_aux=True
+    )(state.critic_params)
+    critic_grads = _sync(critic_grads)
+    critic_updates, critic_opt_state = critic_opt.update(
+        critic_grads, state.critic_opt_state
+    )
+    critic_params = optax.apply_updates(state.critic_params, critic_updates)
+
+    # ---- actor: maximize E[Q(s, μ(s))] against the UPDATED critic ----
+    def actor_loss_fn(actor_params):
+        a = actor.apply(actor_params, batch["obs"])
+        head = critic.apply(critic_params, batch["obs"], a)
+        return -jnp.mean(_critic_value(config, support, head))
+
+    actor_loss, actor_grads = jax.value_and_grad(actor_loss_fn)(state.actor_params)
+    actor_grads = _sync(actor_grads)
+    actor_updates, actor_opt_state = actor_opt.update(
+        actor_grads, state.actor_opt_state
+    )
+    actor_params = optax.apply_updates(state.actor_params, actor_updates)
+
+    # ---- Polyak target updates (reference ddpg.py:250 → 110-116) ----
+    new_state = state.replace(
+        step=state.step + 1,
+        actor_params=actor_params,
+        critic_params=critic_params,
+        target_actor_params=polyak_update(
+            state.target_actor_params, actor_params, config.tau
+        ),
+        target_critic_params=polyak_update(
+            state.target_critic_params, critic_params, config.tau
+        ),
+        actor_opt_state=actor_opt_state,
+        critic_opt_state=critic_opt_state,
+    )
+    metrics = _sync(
+        {
+            "critic_loss": critic_loss,
+            "actor_loss": actor_loss,
+            "priority_mean": jnp.mean(priorities),
+            "q_mean": -actor_loss,
+        }
+    )
+    return new_state, metrics, priorities
+
+
+def jit_train_step(config: D4PGConfig, donate: bool = True):
+    """The train step specialized + jitted for a fixed config, with the state
+    buffer donated so params/moments update in place on device."""
+    fn = partial(train_step, config)
+    return jax.jit(fn, donate_argnums=(0,) if donate else ())

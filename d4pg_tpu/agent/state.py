@@ -1,0 +1,76 @@
+"""Train state and algorithm configuration.
+
+The reference scatters algorithm state across a ``DDPG`` object, two local
+Adams, two ``SharedAdam``s, a shared counter tensor, and three global RNGs
+(``ddpg.py:18-89``, ``main.py:382-386``). Here ALL mutable training state is
+one immutable pytree — params, targets, optimizer moments, step counter, PRNG
+key — so it jits, shards, donates, and checkpoints as a unit (SURVEY.md §5
+'checkpoint/resume' and 'distributed comm backend').
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+from flax import struct
+
+from d4pg_tpu.models.critic import DistConfig
+
+
+@dataclass(frozen=True)
+class D4PGConfig:
+    """Static algorithm hyperparameters.
+
+    Covers every in-code default the reference hides (SURVEY.md §5 'config'):
+    lrs (``ddpg.py:19``), tau (``main.py:40``), gamma, n-step, PER α/β/ε
+    (``ddpg.py:81-87``), Adam betas (``shared_adam.py:4``), noise scale
+    (``random_process.py:13``), support (``main.py:373-376``).
+    """
+
+    obs_dim: int = 3
+    action_dim: int = 1
+    hidden_sizes: tuple = (256, 256, 256)
+    dist: DistConfig = field(default_factory=DistConfig)
+    gamma: float = 0.99
+    n_step: int = 1
+    tau: float = 0.001
+    lr_actor: float = 1e-4
+    lr_critic: float = 1e-4
+    adam_b1: float = 0.9
+    adam_b2: float = 0.999
+    noise_kind: str = "gaussian"  # "gaussian" | "ou"
+    noise_epsilon: float = 0.3
+    noise_sigma: float = 1.0
+    ou_theta: float = 0.15
+    ou_sigma: float = 0.2
+    ou_mu: float = 0.0
+    # exploration-scale annealing over env steps (0 = constant, the
+    # reference's effective behavior — its ε-decay never fires, quirk #10)
+    noise_decay_steps: int = 0
+    noise_scale_final: float = 0.1
+    # PER
+    prioritized: bool = True
+    per_alpha: float = 0.6
+    per_beta0: float = 0.4
+    per_beta_steps: int = 100_000
+    per_eps: float = 1e-6
+    # priority signal: "ce" (true distributional TD) or "overlap"
+    # (reference-compatible surrogate, ddpg.py:220-222)
+    priority_kind: str = "ce"
+    # compute dtype for network matmuls ("float32" | "bfloat16")
+    compute_dtype: str = "float32"
+
+
+class TrainState(struct.PyTreeNode):
+    """The complete learner state as a single pytree."""
+
+    step: jax.Array
+    actor_params: Any
+    critic_params: Any
+    target_actor_params: Any
+    target_critic_params: Any
+    actor_opt_state: Any
+    critic_opt_state: Any
+    key: jax.Array

@@ -1,0 +1,96 @@
+"""One typed config covering every knob of the system.
+
+Replaces the reference's argparse namespace + runtime mutation + hidden
+in-code defaults (SURVEY.md §5 'config / flag system'): all 19 reference
+flags (``main.py:31-56``) have an equivalent here, plus the defaults the
+reference buries in code (lrs ``ddpg.py:19``, PER α/β/ε ``ddpg.py:81-87``,
+warmup ``main.py:204``, cycle structure ``main.py:300-303``, Adam betas
+``shared_adam.py:4``). Env presets replace ``configure_env_params``
+(``main.py:84-99``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Optional
+
+from d4pg_tpu.agent.state import D4PGConfig
+from d4pg_tpu.models.critic import DistConfig
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Full experiment configuration."""
+
+    # environment
+    env: str = "pendulum"
+    max_episode_steps: Optional[int] = None  # None → env default
+    num_envs: int = 16                 # vectorized on-device actors
+    her: bool = False                  # hindsight relabeling (goal envs)
+    her_k: int = 4
+
+    # run shape (reference: epochs × 50 cycles × (16 episodes + 40 steps))
+    total_steps: int = 100_000         # learner grad steps
+    warmup_steps: int = 1_000          # env steps before learning (main.py:204)
+    env_steps_per_train_step: float = 1.0  # collect:train ratio
+    batch_size: int = 256
+
+    # replay
+    replay_capacity: int = 1_000_000   # reference --rmsize
+    prioritized: bool = True           # reference --p_replay
+    n_step: int = 3                    # reference --n_steps
+    tree_backend: str = "auto"
+
+    # evaluation / logging / checkpoint
+    eval_interval: int = 2_000         # grad steps between evals
+    eval_episodes: int = 10            # reference main.py:309
+    ewma_alpha: float = 0.05           # reference main.py:131
+    log_dir: str = "runs/default"
+    checkpoint_interval: int = 10_000
+    resume: bool = False
+
+    # distribution
+    dp: Optional[int] = None           # None → single device
+    tp: int = 1
+
+    # algorithm
+    agent: D4PGConfig = field(default_factory=D4PGConfig)
+
+    seed: int = 0
+
+
+# Per-env presets: categorical support + episode limits (replaces
+# configure_env_params, main.py:84-99, which hardcodes Pendulum and comments
+# out the rest).
+ENV_PRESETS = {
+    "pendulum": dict(v_min=-300.0, v_max=0.0, obs_dim=3, action_dim=1, max_episode_steps=200),
+    "pointmass_goal": dict(v_min=-50.0, v_max=0.0, obs_dim=6, action_dim=2, max_episode_steps=50),
+    "Pendulum-v1": dict(v_min=-300.0, v_max=0.0, obs_dim=3, action_dim=1, max_episode_steps=200),
+    "HalfCheetah-v4": dict(v_min=0.0, v_max=1000.0, obs_dim=17, action_dim=6, max_episode_steps=1000),
+    "Humanoid-v4": dict(v_min=0.0, v_max=1000.0, obs_dim=348, action_dim=17, max_episode_steps=1000),
+}
+
+
+def apply_env_preset(config: TrainConfig) -> TrainConfig:
+    """Fill obs/action dims and categorical support from the env preset."""
+    preset = ENV_PRESETS.get(config.env)
+    if preset is None:
+        return config
+    dist = dataclasses.replace(
+        config.agent.dist, v_min=preset["v_min"], v_max=preset["v_max"]
+    )
+    agent = dataclasses.replace(
+        config.agent,
+        obs_dim=preset["obs_dim"],
+        action_dim=preset["action_dim"],
+        dist=dist,
+        n_step=config.n_step,
+        prioritized=config.prioritized,
+    )
+    max_steps = (
+        config.max_episode_steps
+        if config.max_episode_steps is not None
+        else preset["max_episode_steps"]
+    )
+    return dataclasses.replace(config, agent=agent, max_episode_steps=max_steps)

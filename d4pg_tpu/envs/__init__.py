@@ -1,0 +1,28 @@
+"""Environments: pure-JAX functional envs + gymnasium host adapter.
+
+The reference drives host gym/pybullet envs (``main.py:68``,
+``normalize_env.py``). We provide both worlds:
+
+- pure-JAX envs with a Brax-style functional API (:mod:`d4pg_tpu.envs.api`)
+  that roll out entirely on device under ``lax.scan``
+  (:mod:`d4pg_tpu.envs.rollout`) — BASELINE.json config 5;
+- a gymnasium adapter with the reference's action normalization and
+  goal-dict flattening for host-CPU actors (:mod:`d4pg_tpu.envs.gym_adapter`).
+"""
+
+from d4pg_tpu.envs.api import Env, EnvState
+from d4pg_tpu.envs.pendulum import Pendulum
+from d4pg_tpu.envs.pointmass_goal import PointMassGoal
+from d4pg_tpu.envs.rollout import rollout
+from d4pg_tpu.envs.gym_adapter import GymAdapter, NormalizeAction, make_env
+
+__all__ = [
+    "Env",
+    "EnvState",
+    "Pendulum",
+    "PointMassGoal",
+    "rollout",
+    "GymAdapter",
+    "NormalizeAction",
+    "make_env",
+]

@@ -1,0 +1,39 @@
+"""Functional environment API (Brax-style).
+
+An env is a pair of pure functions over an explicit state pytree:
+
+    state, obs = env.reset(key)
+    state, obs, reward, terminated, truncated = env.step(state, action)
+
+Both are jittable and vmappable, so a batch of envs is ``jax.vmap`` and a
+trajectory is ``lax.scan`` — rollouts compile into the same XLA program as
+the learner if desired. Actions are in the canonical (−1, 1) box; envs scale
+internally (the reference does this with the ``NormalizeAction`` wrapper,
+``normalize_env.py:4-8``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Protocol, Tuple
+
+import jax
+
+
+class EnvState(NamedTuple):
+    """Generic env state: physics pytree + step counter + PRNG key."""
+
+    physics: Any
+    t: jax.Array
+    key: jax.Array
+
+
+class Env(Protocol):
+    observation_dim: int
+    action_dim: int
+    max_episode_steps: int
+
+    def reset(self, key: jax.Array) -> Tuple[EnvState, jax.Array]: ...
+
+    def step(
+        self, state: EnvState, action: jax.Array
+    ) -> Tuple[EnvState, jax.Array, jax.Array, jax.Array, jax.Array]: ...

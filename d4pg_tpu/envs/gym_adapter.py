@@ -1,0 +1,126 @@
+"""Host-side gymnasium adapter with the reference's env conventions.
+
+Covers reference ``normalize_env.py`` (affine (−1,1)→[low,high] action map),
+the ``TimeLimit`` unwrap + ``_max_episode_steps`` override (``main.py:68-69``)
+and goal-dict flattening (``main.py:73-79,144``). gymnasium is optional: the
+adapter import-gates it so the pure-JAX path works without it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+try:
+    import gymnasium as _gym
+except ImportError:  # pragma: no cover
+    _gym = None
+
+
+class NormalizeAction:
+    """Affine map of canonical (−1, 1) actions onto the env's Box bounds and
+    back (reference ``normalize_env.py:4-14``)."""
+
+    def __init__(self, low: np.ndarray, high: np.ndarray):
+        self.low = np.asarray(low, np.float32)
+        self.high = np.asarray(high, np.float32)
+
+    def to_env(self, action: np.ndarray) -> np.ndarray:
+        action = np.clip(action, -1.0, 1.0)
+        return self.low + (action + 1.0) * 0.5 * (self.high - self.low)
+
+    def to_canonical(self, action: np.ndarray) -> np.ndarray:
+        scaled = 2.0 * (action - self.low) / (self.high - self.low) - 1.0
+        return np.clip(scaled, -1.0, 1.0)
+
+
+class GymAdapter:
+    """Flat functional-ish interface over a gymnasium env.
+
+    ``reset(seed) -> obs`` and ``step(action) -> (obs, reward, terminated,
+    truncated, info)`` with canonical (−1,1) actions and goal-dict obs
+    flattened to ``concat(observation, desired_goal)`` (reference
+    ``main.py:73-79``). Goal components stay available via
+    ``last_goal_obs`` for HER relabeling.
+    """
+
+    def __init__(self, env_id: str, max_episode_steps: Optional[int] = None):
+        if _gym is None:
+            raise ImportError(
+                "gymnasium is not installed; use the pure-JAX envs in d4pg_tpu.envs"
+            )
+        env = _gym.make(env_id)
+        if max_episode_steps is not None:
+            # reference overrides _max_episode_steps (main.py:69)
+            env = _gym.wrappers.TimeLimit(env.unwrapped, max_episode_steps)
+        self.env = env
+        space = env.action_space
+        if not hasattr(space, "high"):
+            raise ValueError(
+                f"{env_id} has a discrete action space; DDPG needs a Box "
+                "(reference exits likewise, main.py:70-72)"
+            )
+        self._normalize = NormalizeAction(space.low, space.high)
+        obs_space = env.observation_space
+        self.is_goal_env = hasattr(obs_space, "spaces") and "desired_goal" in getattr(
+            obs_space, "spaces", {}
+        )
+        if self.is_goal_env:
+            sp = obs_space.spaces
+            self.observation_dim = int(
+                np.prod(sp["observation"].shape) + np.prod(sp["desired_goal"].shape)
+            )
+        else:
+            self.observation_dim = int(np.prod(obs_space.shape))
+        self.action_dim = int(np.prod(space.shape))
+        self.last_goal_obs: Any = None
+
+    def _flatten(self, obs) -> np.ndarray:
+        if self.is_goal_env:
+            self.last_goal_obs = obs
+            return np.concatenate(
+                [np.ravel(obs["observation"]), np.ravel(obs["desired_goal"])]
+            ).astype(np.float32)
+        return np.ravel(obs).astype(np.float32)
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        obs, _ = self.env.reset(seed=seed)
+        return self._flatten(obs)
+
+    def step(self, action: np.ndarray):
+        obs, reward, terminated, truncated, info = self.env.step(
+            self._normalize.to_env(np.asarray(action))
+        )
+        return self._flatten(obs), float(reward), bool(terminated), bool(truncated), info
+
+    def compute_reward(self, achieved_goal, desired_goal) -> float:
+        return float(
+            self.env.unwrapped.compute_reward(achieved_goal, desired_goal, {})
+        )
+
+    def close(self):
+        self.env.close()
+
+
+# Value-range presets per env (replaces the reference's configure_env_params,
+# main.py:84-99, which hardcodes Pendulum and comments the rest out).
+ENV_VALUE_RANGES = {
+    "Pendulum-v1": (-300.0, 0.0),
+    "pendulum": (-300.0, 0.0),
+    "pointmass_goal": (-50.0, 0.0),
+    "HalfCheetah-v4": (0.0, 1000.0),
+    "Humanoid-v4": (0.0, 1000.0),
+}
+
+
+def make_env(name: str, max_episode_steps: Optional[int] = None):
+    """Build either a pure-JAX env (by short name) or a gymnasium adapter."""
+    from d4pg_tpu.envs.pendulum import Pendulum
+    from d4pg_tpu.envs.pointmass_goal import PointMassGoal
+
+    if name == "pendulum":
+        return Pendulum()
+    if name == "pointmass_goal":
+        return PointMassGoal()
+    return GymAdapter(name, max_episode_steps)

@@ -1,0 +1,70 @@
+"""Pure-JAX Pendulum, dynamics-equivalent to gym's Pendulum-v1.
+
+The reference's primary config is Pendulum (``main.py:84-88`` hardcodes its
+value range v_min=−300, v_max=0). This implementation reproduces the classic
+gym dynamics (g=10, m=1, l=1, dt=0.05, torque ∈ [−2, 2], reward
+−(θ² + 0.1·θ̇² + 0.001·u²)) as pure jittable functions so training can run
+actor-in-the-loop fully on device.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from d4pg_tpu.envs.api import EnvState
+
+
+def _angle_normalize(x):
+    return ((x + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+
+
+class Pendulum:
+    observation_dim = 3
+    action_dim = 1
+    max_episode_steps = 200
+    # Per-env categorical support (reference configure_env_params, main.py:84-88).
+    v_min = -300.0
+    v_max = 0.0
+
+    def __init__(self, g: float = 10.0, max_torque: float = 2.0, dt: float = 0.05):
+        self.g = g
+        self.max_torque = max_torque
+        self.dt = dt
+        self.m = 1.0
+        self.l = 1.0
+        self.max_speed = 8.0
+
+    def _obs(self, physics: jax.Array) -> jax.Array:
+        theta, thetadot = physics[0], physics[1]
+        return jnp.stack([jnp.cos(theta), jnp.sin(theta), thetadot])
+
+    def reset(self, key: jax.Array) -> Tuple[EnvState, jax.Array]:
+        key, sub = jax.random.split(key)
+        high = jnp.asarray([jnp.pi, 1.0])
+        physics = jax.random.uniform(sub, (2,), minval=-high, maxval=high)
+        state = EnvState(physics=physics, t=jnp.zeros((), jnp.int32), key=key)
+        return state, self._obs(physics)
+
+    def step(self, state: EnvState, action: jax.Array):
+        theta, thetadot = state.physics[0], state.physics[1]
+        # canonical (-1,1) action scaled to torque range (the NormalizeAction
+        # affine, normalize_env.py:4-8, folded into the env)
+        u = jnp.clip(action[..., 0], -1.0, 1.0) * self.max_torque
+        cost = (
+            _angle_normalize(theta) ** 2 + 0.1 * thetadot**2 + 0.001 * u**2
+        )
+        newthetadot = thetadot + (
+            3 * self.g / (2 * self.l) * jnp.sin(theta)
+            + 3.0 / (self.m * self.l**2) * u
+        ) * self.dt
+        newthetadot = jnp.clip(newthetadot, -self.max_speed, self.max_speed)
+        newtheta = theta + newthetadot * self.dt
+        physics = jnp.stack([newtheta, newthetadot])
+        t = state.t + 1
+        truncated = (t >= self.max_episode_steps).astype(jnp.float32)
+        terminated = jnp.zeros((), jnp.float32)  # pendulum never terminates
+        new_state = EnvState(physics=physics, t=t, key=state.key)
+        return new_state, self._obs(physics), -cost, terminated, truncated

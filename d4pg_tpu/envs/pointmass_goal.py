@@ -1,0 +1,89 @@
+"""Goal-conditioned 2-D point-mass — the HER test world.
+
+The reference's active loops are hardcoded for goal-dict robotics envs
+(``main.py:144,148`` index ``state['observation']`` / ``info['is_success']``
+— SURVEY.md quirk #2). This env provides that capability natively: dict-free
+functional API that exposes (observation, achieved_goal, desired_goal), a
+sparse 0/−1 reward, and a ``compute_reward`` usable for HER relabeling
+(reference ``env.compute_reward`` at ``main.py:178``).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from d4pg_tpu.envs.api import EnvState
+
+
+class GoalObs(NamedTuple):
+    observation: jax.Array    # [4] position + velocity
+    achieved_goal: jax.Array  # [2] current position
+    desired_goal: jax.Array   # [2] target position
+
+
+class PointMassGoal:
+    observation_dim = 4  # pos(2) + vel(2); goal adds 2 when flattened
+    goal_dim = 2
+    action_dim = 2
+    max_episode_steps = 50
+    v_min = -50.0
+    v_max = 0.0
+    success_threshold = 0.1
+
+    def __init__(self, arena: float = 1.0, dt: float = 0.1, max_accel: float = 1.0):
+        self.arena = arena
+        self.dt = dt
+        self.max_accel = max_accel
+
+    @property
+    def flat_obs_dim(self) -> int:
+        return self.observation_dim + self.goal_dim
+
+    def compute_reward(self, achieved_goal: jax.Array, desired_goal: jax.Array) -> jax.Array:
+        """Sparse reward: 0 at the goal, −1 elsewhere (robotics-suite style)."""
+        d = jnp.linalg.norm(achieved_goal - desired_goal, axis=-1)
+        return jnp.where(d < self.success_threshold, 0.0, -1.0)
+
+    def _goal_obs(self, physics) -> GoalObs:
+        pos, vel, goal = physics[:2], physics[2:4], physics[4:6]
+        return GoalObs(
+            observation=jnp.concatenate([pos, vel]),
+            achieved_goal=pos,
+            desired_goal=goal,
+        )
+
+    def _flat(self, physics) -> jax.Array:
+        g = self._goal_obs(physics)
+        return jnp.concatenate([g.observation, g.desired_goal])
+
+    def reset(self, key: jax.Array) -> Tuple[EnvState, jax.Array]:
+        key, k1, k2 = jax.random.split(key, 3)
+        pos = jax.random.uniform(k1, (2,), minval=-self.arena, maxval=self.arena)
+        goal = jax.random.uniform(k2, (2,), minval=-self.arena, maxval=self.arena)
+        physics = jnp.concatenate([pos, jnp.zeros(2), goal])
+        state = EnvState(physics=physics, t=jnp.zeros((), jnp.int32), key=key)
+        return state, self._flat(physics)
+
+    def step(self, state: EnvState, action: jax.Array):
+        pos, vel, goal = state.physics[:2], state.physics[2:4], state.physics[4:6]
+        accel = jnp.clip(action, -1.0, 1.0) * self.max_accel
+        vel = jnp.clip(vel + accel * self.dt, -2.0, 2.0) * 0.95
+        pos = jnp.clip(pos + vel * self.dt, -self.arena, self.arena)
+        physics = jnp.concatenate([pos, vel, goal])
+        reward = self.compute_reward(pos, goal)
+        # 'success' ends the episode (reference takes done from
+        # info['is_success'], main.py:148)
+        terminated = (reward >= 0.0).astype(jnp.float32)
+        t = state.t + 1
+        truncated = (t >= self.max_episode_steps).astype(jnp.float32) * (
+            1.0 - terminated
+        )
+        new_state = EnvState(physics=physics, t=t, key=state.key)
+        return new_state, self._flat(physics), reward, terminated, truncated
+
+    def goal_obs(self, state: EnvState) -> GoalObs:
+        """Structured view for the HER writer."""
+        return self._goal_obs(state.physics)

@@ -1,0 +1,97 @@
+"""On-device trajectory rollout via ``lax.scan``.
+
+The reference's rollout is a Python while-loop stepping a host env one
+transition at a time (``main.py:137-185``). Here a whole [T]-step trajectory
+(and with ``vmap``, a [N, T] batch of them) is one XLA computation:
+actor forward + env physics + auto-reset fused, no host in the loop —
+BASELINE.json config 5 ("Brax on-device envs, rollout + learn both on TPU").
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from d4pg_tpu.envs.api import EnvState
+
+
+class Trajectory(NamedTuple):
+    """[T, ...] stacked transitions from one rollout segment."""
+
+    obs: jax.Array
+    action: jax.Array
+    reward: jax.Array
+    next_obs: jax.Array
+    terminated: jax.Array
+    truncated: jax.Array
+
+
+def rollout(
+    env,
+    policy: Callable,
+    key: jax.Array,
+    num_steps: int,
+    init_state: EnvState | None = None,
+    init_obs: jax.Array | None = None,
+    policy_state: Any | None = None,
+    policy_state_reset: Callable | None = None,
+):
+    """Roll ``num_steps`` env steps under a (possibly stateful) policy.
+
+    Policy signature: ``policy(obs, key) -> action`` when ``policy_state`` is
+    None; ``policy(obs, key, pstate) -> (action, pstate)`` otherwise (used
+    for OU noise, whose mean-reverting state threads through the scan; on
+    auto-reset it passes through ``policy_state_reset``, mirroring the
+    per-episode ``noise.reset()`` the reference defines at
+    ``random_process.py:42-45``).
+
+    Auto-resets on episode end (terminated or truncated) so the segment is
+    always exactly [T] transitions — dynamic episode lengths never reach XLA
+    as dynamic shapes. Returns (final_state, final_obs, final_policy_state,
+    Trajectory).
+    """
+    key, reset_key = jax.random.split(key)
+    if init_state is None:
+        init_state, init_obs = env.reset(reset_key)
+    stateful = policy_state is not None
+
+    def body(carry, step_key):
+        state, obs, pstate = carry
+        act_key, reset_key = jax.random.split(step_key)
+        if stateful:
+            action, pstate = policy(obs, act_key, pstate)
+        else:
+            action = policy(obs, act_key)
+        state2, obs2, reward, terminated, truncated = env.step(state, action)
+        done = jnp.maximum(terminated, truncated)
+        # Auto-reset: lax.cond would introduce control flow per step; a
+        # where-select over the two candidate states is cheaper and fuses.
+        reset_state, reset_obs = env.reset(reset_key)
+        state3 = jax.tree_util.tree_map(
+            lambda r, s: jnp.where(done.astype(bool), r, s), reset_state, state2
+        )
+        obs3 = jnp.where(done.astype(bool), reset_obs, obs2)
+        if stateful and policy_state_reset is not None:
+            pstate_reset = policy_state_reset(pstate)
+            pstate = jax.tree_util.tree_map(
+                lambda r, s: jnp.where(done.astype(bool), r, s), pstate_reset, pstate
+            )
+        tr = Trajectory(
+            obs=obs,
+            action=action,
+            reward=reward,
+            next_obs=obs2,
+            terminated=terminated,
+            truncated=truncated,
+        )
+        return (state3, obs3, pstate), tr
+
+    step_keys = jax.random.split(key, num_steps)
+    (final_state, final_obs, final_pstate), traj = jax.lax.scan(
+        body, (init_state, init_obs, policy_state), step_keys
+    )
+    if stateful:
+        return final_state, final_obs, final_pstate, traj
+    return final_state, final_obs, traj

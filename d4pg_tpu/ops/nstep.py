@@ -5,8 +5,8 @@ The reference *intended* n-step returns but the accumulation code is dead
 SURVEY.md quirk #3). We make it a real feature in two places:
 
 - host-side at replay-insert time (``d4pg_tpu.replay.nstep_writer``), and
-- this on-device ``lax.scan`` version for fully-jitted Brax-style pipelines
-  where whole trajectories live in device memory.
+- this on-device version for fully-jitted Brax-style pipelines where whole
+  trajectories live in device memory.
 """
 
 from __future__ import annotations
@@ -20,19 +20,18 @@ def nstep_returns(
     dones: jax.Array,
     gamma: float,
     n: int,
-) -> tuple[jax.Array, jax.Array]:
-    """Per-timestep n-step discounted return windows over a trajectory.
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-timestep n-step discounted return windows over a trajectory chunk.
 
-    For each t: R_t = Σ_{k=0}^{m-1} γᵏ r_{t+k}, where the window stops early
-    (m < n) at episode termination or trajectory end. Also returns the
-    effective discount γ^m·(1−terminated_within_window) to apply to the
-    bootstrap value at t+m — exactly the per-sample ``discounts`` argument of
-    :func:`d4pg_tpu.ops.categorical.categorical_projection`.
+    For each t: R_t = Σ_{k=0}^{m_t−1} γᵏ r_{t+k}, where the window length
+    m_t ≤ n shrinks at episode termination (no bootstrap) or at the chunk
+    boundary (bootstrap still valid — the episode continues in the next
+    chunk, so the target bootstraps γ^{m_t} from the last in-chunk state,
+    matching the host-side :class:`~d4pg_tpu.replay.NStepWriter` truncation
+    semantics).
 
-    Implemented as a reverse ``lax.scan`` re-run n times is avoided: a single
-    forward loop over the (static) window size n keeps everything as [T]-wide
-    vector ops — n is tiny (≤ ~10) while T is large, so XLA sees n fused
-    vector passes, no dynamic control flow.
+    Implemented as n fused [T]-wide vector passes (n is tiny, T is large) —
+    no dynamic control flow reaches XLA.
 
     Args:
       rewards: [T] rewards r_t.
@@ -41,18 +40,25 @@ def nstep_returns(
       n: window length (static).
 
     Returns:
-      (returns [T], boot_discounts [T]) where boot_discounts[t] multiplies the
-      bootstrap distribution at state s_{t+m}.
+      (returns [T], boot_discounts [T], boot_offsets [T] int32):
+      ``boot_discounts[t]`` multiplies the bootstrap distribution at state
+      ``s_{t + boot_offsets[t]}`` (it is 0 when the window hit a terminal
+      step, in which case the offset points just past the terminal step).
     """
     T = rewards.shape[0]
+    t_idx = jnp.arange(T)
     returns = jnp.zeros_like(rewards)
-    # alive[k] at position t == 1 while no done occurred in r_t..r_{t+k-1}
-    alive = jnp.ones_like(rewards)
+    cont = jnp.ones_like(rewards)      # window still accumulating at step k
+    not_term = jnp.ones_like(rewards)  # no terminal among consumed steps
+    m = jnp.zeros_like(rewards)        # consumed window length
     for k in range(n):
-        # reward k steps ahead; out-of-range → 0 reward and treated as done.
-        r_k = jnp.where(jnp.arange(T) + k < T, jnp.roll(rewards, -k), 0.0)
-        d_k = jnp.where(jnp.arange(T) + k < T, jnp.roll(dones, -k), 1.0)
-        returns = returns + alive * (gamma**k) * r_k
-        alive = alive * (1.0 - d_k)
-    boot_discounts = alive * (gamma**n)
-    return returns, boot_discounts
+        in_range = (t_idx + k < T).astype(rewards.dtype)
+        r_k = jnp.roll(rewards, -k)
+        d_k = jnp.roll(dones, -k)
+        take = cont * in_range
+        returns = returns + take * (gamma**k) * r_k
+        m = m + take
+        not_term = not_term * (1.0 - take * d_k)
+        cont = take * (1.0 - d_k)
+    boot_discounts = not_term * gamma**m
+    return returns, boot_discounts, m.astype(jnp.int32)

@@ -1,0 +1,31 @@
+"""Distribution over TPU device meshes: DP via shard_map+psum, TP via GSPMD.
+
+This package is the TPU-native replacement for the reference's entire
+communication layer (SURVEY.md §5 'distributed communication backend'):
+shared-memory parameter publishing, gradient aliasing, and SharedAdam moments
+(``main.py:388``, ``ddpg.py:104-108``, ``shared_adam.py``) all become one
+``pmean`` over the ICI mesh inside the jitted train step, with replicated
+optimizer state and the step counter living in the train state itself.
+"""
+
+from d4pg_tpu.parallel.mesh import make_mesh
+from d4pg_tpu.parallel.dp import make_dp_train_step
+from d4pg_tpu.parallel.partition import (
+    DEFAULT_RULES,
+    auto_parallel_train_step,
+    match_partition_rules,
+    shard_batch,
+    shard_train_state,
+)
+from d4pg_tpu.parallel.distributed import initialize_distributed
+
+__all__ = [
+    "make_mesh",
+    "make_dp_train_step",
+    "DEFAULT_RULES",
+    "auto_parallel_train_step",
+    "match_partition_rules",
+    "shard_batch",
+    "shard_train_state",
+    "initialize_distributed",
+]

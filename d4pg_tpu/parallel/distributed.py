@@ -1,0 +1,37 @@
+"""Multi-host runtime bring-up.
+
+The reference's multi-"node" story is forked processes on one box
+(``main.py:393-405``); the TPU-native equivalent is ``jax.distributed``:
+every TPU-VM host runs the same program, ``jax.devices()`` spans the whole
+slice, and the collectives emitted by the jitted train step ride ICI within
+a slice and DCN across slices — no NCCL/MPI/process groups to manage.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> dict:
+    """Initialize the multi-host runtime (no-op on a single host).
+
+    On Cloud TPU pods, ``jax.distributed.initialize()`` with no arguments
+    autodetects everything from the TPU metadata server; explicit arguments
+    support other clusters. Returns a summary dict for logging.
+    """
+    if coordinator_address is not None or (num_processes or 0) > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_device_count": jax.local_device_count(),
+        "global_device_count": jax.device_count(),
+    }

@@ -1,0 +1,47 @@
+"""Explicit synchronous data parallelism: shard_map + pmean over ICI.
+
+One function replaces the reference's Hogwild machinery (async gradient
+aliasing ``ddpg.py:104-108``, shared Adam moments ``shared_adam.py:12-17``,
+LR/n_workers rescale ``main.py:384-385``): every device holds replicated
+params/optimizer state, computes gradients on its batch shard, and a single
+``pmean`` AllReduce (riding ICI within a slice) synchronizes them — so all
+replicas stay bit-identical and the reference's benign-by-design races
+(SURVEY.md §5) are structurally impossible. No LR rescaling needed: pmean
+averages, it does not sum.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from d4pg_tpu.agent.d4pg import train_step
+from d4pg_tpu.agent.state import D4PGConfig
+
+
+def make_dp_train_step(config: D4PGConfig, mesh: Mesh, donate: bool = True):
+    """Jitted (state, batch) → (state, metrics, priorities) over mesh axis "dp".
+
+    State is replicated (spec ``P()``); batch rows are sharded over "dp";
+    returned priorities come back fully assembled (spec ``P("dp")``) for the
+    host-side PER write-back. Batch size must be divisible by mesh.shape["dp"].
+    """
+    fn = partial(train_step, config, axis_name="dp")
+    batch_spec = P("dp")
+    mapped = jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(P(), {k: batch_spec for k in
+                        ("obs", "action", "reward", "next_obs", "discount", "weights")}),
+        out_specs=(P(), P(), batch_spec),
+        check_vma=False,
+    )
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
+def replicate(tree, mesh: Mesh):
+    """Place a host pytree replicated across every device of the mesh."""
+    sharding = NamedSharding(mesh, P())
+    return jax.device_put(tree, sharding)

@@ -1,0 +1,37 @@
+"""Device-mesh construction.
+
+Axis conventions:
+  - ``dp``: data parallel — batch sharded, gradients AllReduced over ICI;
+  - ``tp``: tensor parallel — hidden weight matrices sharded (GSPMD inserts
+    the collectives).
+
+On a multi-host pod slice, ``jax.devices()`` already spans hosts (after
+:func:`d4pg_tpu.parallel.initialize_distributed`), so the same mesh code
+scales from 1 chip to a pod: ICI carries the collectives inside a slice,
+DCN across slices, chosen by XLA from the device topology.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    dp: int | None = None,
+    tp: int = 1,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a ("dp", "tp") mesh. ``dp=None`` uses all remaining devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    if dp is None:
+        if len(devices) % tp != 0:
+            raise ValueError(f"{len(devices)} devices not divisible by tp={tp}")
+        dp = len(devices) // tp
+    if dp * tp > len(devices):
+        raise ValueError(f"mesh {dp}x{tp} needs {dp*tp} devices, have {len(devices)}")
+    grid = np.asarray(devices[: dp * tp]).reshape(dp, tp)
+    return Mesh(grid, axis_names=("dp", "tp"))

@@ -1,0 +1,163 @@
+"""GSPMD auto-parallelism: regex partition rules + sharded jit.
+
+The idiomatic XLA path (scaling-book recipe): pick a mesh, annotate the
+shardings of params and batch with ``NamedSharding``, ``jax.jit`` the
+unchanged train step, and let GSPMD insert the collectives. This gives
+tensor parallelism over hidden weight matrices (axis "tp") composed with
+batch data parallelism (axis "dp") without touching the algorithm code —
+the reference has no TP at all (SURVEY.md §2.2), so this is a new
+capability, trivial at 256-wide but load-bearing for large critics/pixel
+encoders.
+"""
+
+from __future__ import annotations
+
+import re
+from functools import partial
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from d4pg_tpu.agent.d4pg import train_step
+from d4pg_tpu.agent.state import D4PGConfig, TrainState
+
+
+# (regex over 'path/to/param', PartitionSpec). First match wins.
+# MLP kernels alternate column/row sharding so activations stay sharded on
+# "tp" through the trunk with one final AllReduce — the standard Megatron
+# pattern expressed as GSPMD annotations.
+DEFAULT_RULES: Sequence[tuple[str, P]] = (
+    (r"hidden_0/kernel", P(None, "tp")),
+    (r"hidden_1/kernel", P("tp", None)),
+    (r"hidden_2/kernel", P(None, "tp")),
+    (r"out/kernel", P("tp", None) ),
+    (r"hidden_0/bias", P("tp")),
+    (r"hidden_2/bias", P("tp")),
+    (r".*bias", P()),
+    (r".*", P()),
+)
+
+
+def _spec_fits(spec: P, shape, mesh: Mesh | None) -> bool:
+    """A spec fits iff every sharded dimension divides its mesh axis size."""
+    if mesh is None:
+        return True
+    for dim, axis in enumerate(spec):
+        if axis is None:
+            continue
+        axes = axis if isinstance(axis, tuple) else (axis,)
+        size = int(np.prod([mesh.shape[a] for a in axes]))
+        if dim >= len(shape) or shape[dim] % size != 0:
+            return False
+    return True
+
+
+def match_partition_rules(rules: Sequence[tuple[str, P]], tree, mesh: Mesh | None = None):
+    """Map each param leaf to the PartitionSpec of its first matching rule
+    (pattern as in public fmengine/EasyLM-style ``match_partition_rules``).
+
+    With ``mesh`` given, a matched spec that does not divide the leaf's shape
+    (e.g. the critic's concat layer whose fan-in is hidden+action_dim) falls
+    back to replication instead of erroring — odd-shaped leaves replicate,
+    big regular matmuls shard.
+    """
+
+    flat = jax.tree_util.tree_flatten_with_path(tree)
+    specs = []
+    for path, leaf in flat[0]:
+        name = "/".join(
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in path
+        )
+        shape = getattr(leaf, "shape", ())
+        if np.ndim(leaf) == 0 or np.size(leaf) == 1:
+            specs.append(P())
+            continue
+        for pattern, spec in rules:
+            if re.search(pattern, name):
+                specs.append(spec if _spec_fits(spec, shape, mesh) else P())
+                break
+        else:
+            specs.append(P())
+    return jax.tree_util.tree_unflatten(flat[1], specs)
+
+
+def _state_specs(state: TrainState, rules, mesh: Mesh | None = None) -> TrainState:
+    """PartitionSpecs for a whole TrainState: params & targets & optimizer
+    moments follow the param rules (optax moments mirror param pytrees);
+    step/key replicated."""
+
+    def spec_like(tree):
+        return match_partition_rules(rules, tree, mesh)
+
+    return TrainState(
+        step=P(),
+        actor_params=spec_like(state.actor_params),
+        critic_params=spec_like(state.critic_params),
+        target_actor_params=spec_like(state.target_actor_params),
+        target_critic_params=spec_like(state.target_critic_params),
+        actor_opt_state=spec_like(state.actor_opt_state),
+        critic_opt_state=spec_like(state.critic_opt_state),
+        key=P(),
+    )
+
+
+def shard_train_state(state: TrainState, mesh: Mesh, rules=DEFAULT_RULES) -> TrainState:
+    """Place a TrainState onto the mesh per the partition rules."""
+    specs = _state_specs(state, rules, mesh)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        state,
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_batch(batch, mesh: Mesh):
+    """Shard batch rows over "dp" (replicated over "tp")."""
+    sharding = NamedSharding(mesh, P("dp"))
+    return {k: jax.device_put(np.asarray(v), sharding) for k, v in batch.items()}
+
+
+def auto_parallel_train_step(
+    config: D4PGConfig, mesh: Mesh, rules=DEFAULT_RULES, donate: bool = True
+):
+    """jit(train_step) with dp×tp shardings; GSPMD inserts all collectives.
+
+    Unlike :func:`d4pg_tpu.parallel.make_dp_train_step` (explicit psum),
+    gradients here are synchronized implicitly by GSPMD because the loss is a
+    mean over the full (sharded) batch — the AllReduce appears in the lowered
+    HLO. Use this path when tensor parallelism is on.
+    """
+    # Build spec templates from an abstract state (no device memory).
+    dummy = jax.eval_shape(lambda k: _abstract_state(config, k), jax.random.PRNGKey(0))
+    state_specs = _state_specs(dummy, rules, mesh)
+    state_shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        state_specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    batch_sharding = NamedSharding(mesh, P("dp"))
+    batch_shardings = {
+        k: batch_sharding
+        for k in ("obs", "action", "reward", "next_obs", "discount", "weights")
+    }
+    metric_sharding = NamedSharding(mesh, P())
+    fn = partial(train_step, config)
+    return jax.jit(
+        fn,
+        in_shardings=(state_shardings, batch_shardings),
+        out_shardings=(
+            state_shardings,
+            {k: metric_sharding for k in ("critic_loss", "actor_loss", "priority_mean", "q_mean")},
+            batch_sharding,
+        ),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
+def _abstract_state(config: D4PGConfig, key):
+    from d4pg_tpu.agent.d4pg import create_train_state
+
+    return create_train_state(config, key)

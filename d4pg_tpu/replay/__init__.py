@@ -1,0 +1,26 @@
+"""Host-side experience storage: ring buffers, PER segment trees, n-step, HER.
+
+Replay stays on the TPU-VM host CPU (BASELINE.json north star): actors write
+transitions here, the learner streams batches to device and TD priorities
+back. Everything is vectorized NumPy (no Python tree walks — contrast the
+reference's pointer-chasing ``prioritized_replay_memory.py:61-112``), with an
+optional native C++ tree backend (``d4pg_tpu.replay.native``).
+"""
+
+from d4pg_tpu.replay.schedules import linear_schedule
+from d4pg_tpu.replay.segment_tree import MinTree, SumTree
+from d4pg_tpu.replay.uniform import ReplayBuffer, Transition
+from d4pg_tpu.replay.per import PrioritizedReplayBuffer
+from d4pg_tpu.replay.nstep_writer import NStepWriter
+from d4pg_tpu.replay.her import HindsightWriter
+
+__all__ = [
+    "linear_schedule",
+    "MinTree",
+    "SumTree",
+    "ReplayBuffer",
+    "Transition",
+    "PrioritizedReplayBuffer",
+    "NStepWriter",
+    "HindsightWriter",
+]

@@ -1,0 +1,143 @@
+"""Hindsight experience replay ("future" strategy).
+
+Capability parity with the reference's inline HER at ``main.py:154-184``:
+after an episode, each transition is additionally stored with its desired
+goal replaced by an achieved goal sampled from a *future* timestep of the
+same episode, reward recomputed under the substituted goal. Two deliberate
+fixes over the reference:
+
+- the relabeled transition stores its own action, not the loop-final action
+  (reference bug at ``main.py:184``, SURVEY.md quirk #6);
+- original transitions are always stored (the reference gates ALL stores on
+  ``args.her and not done`` — quirk #14), HER only adds relabeled copies.
+
+Observations are goal-env dicts flattened as ``concat(observation, goal)``
+exactly as the reference does (``main.py:73-79,144``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, List
+
+import numpy as np
+
+from d4pg_tpu.replay.nstep_writer import NStepWriter
+
+
+@dataclass
+class _Step:
+    observation: np.ndarray
+    achieved_goal: np.ndarray
+    desired_goal: np.ndarray
+    action: np.ndarray
+    reward: float
+    next_observation: np.ndarray
+    next_achieved_goal: np.ndarray
+    terminated: bool
+
+
+class HindsightWriter:
+    """Buffers one episode, then writes original + k relabeled copies.
+
+    ``compute_reward(achieved_goal, desired_goal) -> reward`` mirrors gym's
+    ``env.compute_reward`` used by the reference at ``main.py:178``.
+    ``done_on_success`` reproduces the reference's relabeled done flag
+    (``main.py:183``): a relabeled transition is terminal iff its reward
+    signals success.
+    """
+
+    def __init__(
+        self,
+        writer_factory: Callable[[], NStepWriter],
+        compute_reward: Callable[[np.ndarray, np.ndarray], float],
+        k_future: int = 4,
+        rng: np.random.Generator | None = None,
+        done_on_success: bool = True,
+        success_reward: float = 0.0,
+    ):
+        self.writer_factory = writer_factory
+        self.compute_reward = compute_reward
+        self.k_future = k_future
+        self.rng = rng or np.random.default_rng()
+        self.done_on_success = done_on_success
+        self.success_reward = success_reward
+        self._episode: List[_Step] = []
+
+    @staticmethod
+    def flatten(observation: np.ndarray, goal: np.ndarray) -> np.ndarray:
+        return np.concatenate([np.asarray(observation), np.asarray(goal)], axis=-1)
+
+    def add(
+        self,
+        observation,
+        achieved_goal,
+        desired_goal,
+        action,
+        reward,
+        next_observation,
+        next_achieved_goal,
+        terminated: bool,
+    ) -> None:
+        self._episode.append(
+            _Step(
+                np.asarray(observation),
+                np.asarray(achieved_goal),
+                np.asarray(desired_goal),
+                np.asarray(action),
+                float(reward),
+                np.asarray(next_observation),
+                np.asarray(next_achieved_goal),
+                bool(terminated),
+            )
+        )
+
+    def end_episode(self, truncated: bool = True) -> int:
+        """Flush the episode: original + relabeled transitions. Returns the
+        number of (raw) transitions written (before n-step collapse)."""
+        ep = self._episode
+        self._episode = []
+        if not ep:
+            return 0
+        count = 0
+        # Original trajectory through a fresh n-step window.
+        w = self.writer_factory()
+        for t, s in enumerate(ep):
+            last = t == len(ep) - 1
+            w.add(
+                self.flatten(s.observation, s.desired_goal),
+                s.action,
+                s.reward,
+                self.flatten(s.next_observation, s.desired_goal),
+                terminated=s.terminated,
+                truncated=last and truncated and not s.terminated,
+            )
+            count += 1
+        # "future" relabels: each pass substitutes goals drawn from future
+        # steps of the same episode (reference main.py:170-171).
+        for _ in range(self.k_future):
+            w = self.writer_factory()
+            # Per-timestep future index f >= t (reference draws uniformly
+            # from [t, T)).
+            future = np.array(
+                [self.rng.integers(t, len(ep)) for t in range(len(ep))]
+            )
+            for t, s in enumerate(ep):
+                goal = ep[future[t]].next_achieved_goal
+                r = float(self.compute_reward(s.next_achieved_goal, goal))
+                done = self.done_on_success and (r >= self.success_reward)
+                last = t == len(ep) - 1
+                w.add(
+                    self.flatten(s.observation, goal),
+                    s.action,  # this step's action (fixes reference main.py:184)
+                    r,
+                    self.flatten(s.next_observation, goal),
+                    terminated=done,
+                    truncated=last and not done,
+                )
+                count += 1
+                if done:
+                    # Relabeled episode ends at success; later steps belong to
+                    # a "different" hindsight episode — start a new window.
+                    w = self.writer_factory()
+        return count

@@ -1,0 +1,55 @@
+"""n-step transition accumulation at insert time.
+
+The reference *intended* this (dead code at ``replay_memory.py:21-58`` and
+``main.py:209-242``, SURVEY.md quirk #3) and its active projection then used
+the wrong discount (quirk #5). Here n-step is a real feature: the writer
+maintains a sliding window per actor, emits ``(s_t, a_t, R_t^{(m)},
+s_{t+m}, γ^m·(1−terminal))`` transitions, and handles episode ends exactly:
+
+- termination: every partial window flushes with bootstrap discount 0;
+- truncation (timeout): partial windows flush with discount γ^m — the value
+  bootstrap is still valid at a timeout cut.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+from d4pg_tpu.replay.uniform import ReplayBuffer, Transition
+
+
+class NStepWriter:
+    """Per-actor n-step window over a target buffer (uniform or PER)."""
+
+    def __init__(self, buffer: ReplayBuffer, n: int, gamma: float):
+        assert n >= 1
+        self.buffer = buffer
+        self.n = n
+        self.gamma = gamma
+        self._window: deque = deque()
+
+    def _emit_front(self, next_obs: np.ndarray, terminal: bool, m: int) -> None:
+        obs, action, _ = self._window[0]
+        ret = 0.0
+        for k, (_, _, r) in enumerate(self._window):
+            ret += (self.gamma**k) * r
+        discount = 0.0 if terminal else self.gamma**m
+        self.buffer.add(obs, action, ret, next_obs, discount)
+        self._window.popleft()
+
+    def add(self, obs, action, reward, next_obs, terminated: bool, truncated: bool = False) -> None:
+        """Feed one raw env step; emits ready n-step transitions to the buffer."""
+        self._window.append((np.asarray(obs), np.asarray(action), float(reward)))
+        if len(self._window) == self.n:
+            self._emit_front(np.asarray(next_obs), terminated, self.n)
+        if terminated or truncated:
+            # Flush remaining partial windows against the episode's last state.
+            while self._window:
+                m = len(self._window)
+                self._emit_front(np.asarray(next_obs), terminated, m)
+
+    def reset(self) -> None:
+        """Drop any un-flushed window (e.g. on actor restart)."""
+        self._window.clear()

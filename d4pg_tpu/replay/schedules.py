@@ -1,0 +1,15 @@
+"""Pure annealing schedules.
+
+Reference ``LinearSchedule`` (``prioritized_replay_memory.py:5-29``) mutates
+an internal counter on every ``value()`` call (SURVEY.md quirk #8); here the
+schedule is a pure function of the learner step, so it is reproducible,
+checkpoint-friendly, and usable inside jit.
+"""
+
+from __future__ import annotations
+
+
+def linear_schedule(step: int, total_steps: int, start: float, end: float) -> float:
+    """Linear interpolation start→end over total_steps, clamped after."""
+    frac = min(max(float(step) / max(total_steps, 1), 0.0), 1.0)
+    return start + frac * (end - start)

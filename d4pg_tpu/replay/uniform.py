@@ -1,0 +1,94 @@
+"""Uniform ring-buffer replay on preallocated NumPy arrays.
+
+Capability parity with reference ``replay_memory.py:4-80`` (``Replay.add`` /
+``sample``) — but columnar storage with O(1) vectorized batched writes and
+gather-based sampling: a sampled batch is a set of contiguous-dtype arrays
+ready for a single ``jax.device_put`` (the host→TPU boundary), not a Python
+list of tuples re-stacked per sample (``replay_memory.py:61-80``).
+
+Transitions carry an explicit per-sample ``discount`` = γ^m·(1−terminal) so
+the device-side projection needs no gamma/n plumbing — this is how the build
+fixes the reference's dead/inconsistent n-step path (SURVEY.md quirk #3/#5).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Mapping, NamedTuple
+
+import numpy as np
+
+
+class Transition(NamedTuple):
+    """One (possibly n-step-collapsed) transition."""
+
+    obs: np.ndarray        # s_t
+    action: np.ndarray     # a_t
+    reward: np.ndarray     # R_t = sum_{k<m} gamma^k r_{t+k}
+    next_obs: np.ndarray   # s_{t+m}
+    discount: np.ndarray   # gamma^m * (1 - terminal)
+
+
+class ReplayBuffer:
+    """Thread-safe columnar ring buffer.
+
+    Writes (actor threads) and reads (learner thread) take a lock; the
+    critical sections are pure NumPy slice ops so contention stays tiny.
+    The reference's equivalent races (per-process buffers, SURVEY.md §5
+    'race detection') are structurally removed.
+    """
+
+    def __init__(self, capacity: int, obs_dim: int, action_dim: int):
+        self.capacity = int(capacity)
+        self.obs = np.zeros((capacity, obs_dim), np.float32)
+        self.action = np.zeros((capacity, action_dim), np.float32)
+        self.reward = np.zeros((capacity,), np.float32)
+        self.next_obs = np.zeros((capacity, obs_dim), np.float32)
+        self.discount = np.zeros((capacity,), np.float32)
+        self._pos = 0
+        self._size = 0
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return self._size
+
+    def add_batch(self, t: Transition) -> np.ndarray:
+        """Insert a batch of transitions; returns the slot indices written."""
+        obs = np.atleast_2d(np.asarray(t.obs, np.float32))
+        n = obs.shape[0]
+        with self._lock:
+            idx = (self._pos + np.arange(n)) % self.capacity
+            self.obs[idx] = obs
+            self.action[idx] = np.atleast_2d(np.asarray(t.action, np.float32))
+            self.reward[idx] = np.asarray(t.reward, np.float32).reshape(n)
+            self.next_obs[idx] = np.atleast_2d(np.asarray(t.next_obs, np.float32))
+            self.discount[idx] = np.asarray(t.discount, np.float32).reshape(n)
+            self._pos = int((self._pos + n) % self.capacity)
+            self._size = int(min(self._size + n, self.capacity))
+        return idx
+
+    def add(self, obs, action, reward, next_obs, discount) -> np.ndarray:
+        return self.add_batch(
+            Transition(
+                np.asarray(obs)[None],
+                np.asarray(action)[None],
+                np.asarray([reward]),
+                np.asarray(next_obs)[None],
+                np.asarray([discount]),
+            )
+        )
+
+    def gather(self, idx: np.ndarray) -> Mapping[str, np.ndarray]:
+        with self._lock:
+            return {
+                "obs": self.obs[idx],
+                "action": self.action[idx],
+                "reward": self.reward[idx],
+                "next_obs": self.next_obs[idx],
+                "discount": self.discount[idx],
+            }
+
+    def sample(self, batch_size: int, rng: np.random.Generator):
+        """Uniform sample of stacked arrays (reference ``replay_memory.py:61-80``)."""
+        idx = rng.integers(0, self._size, size=batch_size)
+        return self.gather(idx)

@@ -1,0 +1,16 @@
+"""Actor/learner runtime: the host-side orchestration around the jitted core.
+
+The reference's runtime is forked processes + shared memory
+(``main.py:371-405``); ours is a single process per TPU host: on-device
+vectorized rollouts (or host env threads for gymnasium), a lock-guarded host
+replay, a learner loop with double-buffered device prefetch and priority
+write-back, a greedy evaluator, TensorBoard/JSONL metrics, and Orbax
+checkpoint/resume.
+"""
+
+from d4pg_tpu.runtime.metrics import MetricsLogger
+from d4pg_tpu.runtime.checkpoint import CheckpointManager
+from d4pg_tpu.runtime.evaluator import evaluate
+from d4pg_tpu.runtime.trainer import Trainer
+
+__all__ = ["MetricsLogger", "CheckpointManager", "evaluate", "Trainer"]

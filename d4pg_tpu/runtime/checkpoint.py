@@ -1,0 +1,52 @@
+"""Orbax checkpointing of the FULL training state.
+
+The reference saves only actor/critic weights (``torch.save``,
+``main.py:367-368``) with no optimizer/step/RNG state and no resume CLI
+(SURVEY.md §5). Here one checkpoint captures the entire
+:class:`~d4pg_tpu.agent.TrainState` pytree — params, targets, both Adam
+moment sets, step counter, PRNG key — so ``--resume`` is bit-exact.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+from d4pg_tpu.agent.state import TrainState
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
+        )
+
+    def save(self, step: int, state: TrainState) -> None:
+        self._mgr.save(step, args=ocp.args.StandardSave(jax.device_get(state)))
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, template: TrainState, step: Optional[int] = None) -> TrainState:
+        """Restore into the structure of ``template`` (a freshly-created
+        state provides dtypes/shapes)."""
+        step = step if step is not None else self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {self.directory}")
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(jax.device_get(template))
+        )
+        return restored
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()

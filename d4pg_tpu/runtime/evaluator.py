@@ -1,0 +1,73 @@
+"""Greedy-policy evaluation.
+
+Reference: a separate evaluator process copying global weights and running
+one greedy episode per 10 s with EWMA smoothing (``main.py:103-134``), and
+the per-cycle 10-episode test block with success rate (``main.py:309-347``).
+Here evaluation is a jitted batched rollout — all episodes in parallel on
+device — compiled ONCE per (config, env, episode-count) and reused across
+eval intervals; params enter as a traced argument so weight updates never
+retrigger compilation.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from d4pg_tpu.agent import D4PGConfig, act_deterministic
+
+
+@functools.lru_cache(maxsize=32)
+def make_evaluator(config: D4PGConfig, env, num_episodes: int, max_steps: int):
+    """Jitted ``(actor_params, key) -> (returns [E], successes [E])``.
+
+    Cached on (config, env identity, episode count, horizon) — the trainer
+    hits the cache every eval interval. An episode "succeeds" if it
+    terminates before truncation (the goal-env convention the reference
+    reads from ``info['is_success']``, ``main.py:327``).
+    """
+
+    def one_episode(actor_params, k):
+        state, obs = env.reset(k)
+
+        def body(carry, _):
+            state, obs, ret, done, succ = carry
+            action = act_deterministic(config, actor_params, obs[None])[0]
+            state2, obs2, r, term, trunc = env.step(state, action)
+            ret = ret + r * (1.0 - done)
+            succ = jnp.maximum(succ, term * (1.0 - done))
+            done = jnp.maximum(done, jnp.maximum(term, trunc))
+            return (state2, obs2, ret, done, succ), None
+
+        init = (state, obs, jnp.zeros(()), jnp.zeros(()), jnp.zeros(()))
+        (_, _, ret, _, succ), _ = jax.lax.scan(body, init, None, length=max_steps)
+        return ret, succ
+
+    @jax.jit
+    def run(actor_params, key):
+        keys = jax.random.split(key, num_episodes)
+        return jax.vmap(one_episode, in_axes=(None, 0))(actor_params, keys)
+
+    return run
+
+
+def evaluate(
+    config: D4PGConfig,
+    env,
+    actor_params,
+    key: jax.Array,
+    num_episodes: int = 10,
+    max_steps: Optional[int] = None,
+) -> dict:
+    """Run ``num_episodes`` greedy episodes (vmapped) and return metrics."""
+    T = max_steps or env.max_episode_steps
+    run = make_evaluator(config, env, num_episodes, T)
+    rets, succs = run(actor_params, key)
+    return {
+        "eval_return_mean": float(jnp.mean(rets)),
+        "eval_return_std": float(jnp.std(rets)),
+        "success_rate": float(jnp.mean(succs)),
+    }

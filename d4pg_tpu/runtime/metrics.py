@@ -1,0 +1,45 @@
+"""Metrics: TensorBoard scalars + append-only JSONL.
+
+Same scalar surface as the reference (``avg_test_reward``/``success_rate``
+via ``SummaryWriter``, ``main.py:352-353``) plus the throughput counters the
+BASELINE targets (grad-steps/sec, env-steps/sec, replay occupancy, per-step
+losses). JSONL is the machine-readable log the reference's pickle dicts
+(``main.py:255-265``) wanted to be.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Mapping, Optional
+
+
+class MetricsLogger:
+    def __init__(self, log_dir: str, use_tensorboard: bool = True):
+        self.log_dir = log_dir
+        os.makedirs(log_dir, exist_ok=True)
+        self._jsonl = open(os.path.join(log_dir, "metrics.jsonl"), "a")
+        self._tb = None
+        if use_tensorboard:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(log_dir)
+            except Exception:
+                self._tb = None
+        self._t0 = time.monotonic()
+
+    def log(self, step: int, scalars: Mapping[str, float]) -> None:
+        rec = {"step": int(step), "t": time.monotonic() - self._t0}
+        rec.update({k: float(v) for k, v in scalars.items()})
+        self._jsonl.write(json.dumps(rec) + "\n")
+        self._jsonl.flush()
+        if self._tb is not None:
+            for k, v in scalars.items():
+                self._tb.add_scalar(k, float(v), int(step))
+
+    def close(self) -> None:
+        self._jsonl.close()
+        if self._tb is not None:
+            self._tb.close()

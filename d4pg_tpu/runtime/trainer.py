@@ -1,0 +1,517 @@
+"""The training orchestrator.
+
+Replaces the reference's ``Worker.work`` nested loops + process forking
+(``main.py:188-405``) with a single-process design around the jitted core:
+
+- **sync mode** (pure-JAX envs): exploration rollouts run vmapped on device
+  (``lax.scan``), segments stream to the host n-step writers, the learner
+  consumes batches with a one-step pipeline lag so the next batch is being
+  sampled/transferred while the TPU executes the current step, and PER
+  priorities write back when the step's results materialize.
+- **host mode** (gymnasium adapters, incl. goal-dict envs with HER):
+  per-step host env loop feeding the same writers — the reference's actor
+  loop, minus processes.
+
+Both modes share: warmup, exploration-noise schedule (Gaussian or OU), eval
+cadence, EWMA return, metrics, Orbax checkpoints, and optional DP over a
+device mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from d4pg_tpu.agent import (
+    act_deterministic,
+    create_train_state,
+    jit_train_step,
+)
+from d4pg_tpu.agent.d4pg import make_noise
+from d4pg_tpu.config import ENV_PRESETS, TrainConfig
+from d4pg_tpu.envs import make_env, rollout
+from d4pg_tpu.envs.pointmass_goal import PointMassGoal
+from d4pg_tpu.models.critic import DistConfig
+from d4pg_tpu.replay import (
+    HindsightWriter,
+    NStepWriter,
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+    linear_schedule,
+)
+from d4pg_tpu.runtime.checkpoint import CheckpointManager
+from d4pg_tpu.runtime.evaluator import evaluate
+from d4pg_tpu.runtime.metrics import MetricsLogger
+
+
+def _env_dims(env) -> tuple[int, int]:
+    """Ground-truth obs/action dims from a constructed env."""
+    if isinstance(env, PointMassGoal):
+        return env.flat_obs_dim, env.action_dim
+    return env.observation_dim, env.action_dim
+
+
+def _reconcile_config(config: TrainConfig, env) -> TrainConfig:
+    """Make the agent config consistent with the actual env.
+
+    Dims always come from the env (the reference introspects the gym space
+    the same way, ``main.py:70-80``). The categorical support comes from the
+    env preset ONLY if the user left the DistConfig defaults — an explicit
+    ``--v-min/--v-max`` is never clobbered.
+    """
+    obs_dim, action_dim = _env_dims(env)
+    agent = dataclasses.replace(
+        config.agent,
+        obs_dim=obs_dim,
+        action_dim=action_dim,
+        n_step=config.n_step,
+        prioritized=config.prioritized,
+    )
+    defaults = DistConfig()
+    if (
+        agent.dist.kind == "categorical"
+        and agent.dist.v_min == defaults.v_min
+        and agent.dist.v_max == defaults.v_max
+    ):
+        preset = ENV_PRESETS.get(config.env)
+        v_min = preset["v_min"] if preset else getattr(env, "v_min", defaults.v_min)
+        v_max = preset["v_max"] if preset else getattr(env, "v_max", defaults.v_max)
+        agent = dataclasses.replace(
+            agent, dist=dataclasses.replace(agent.dist, v_min=v_min, v_max=v_max)
+        )
+    max_steps = config.max_episode_steps
+    if max_steps is None:
+        max_steps = getattr(env, "max_episode_steps", 1000)
+    return dataclasses.replace(config, agent=agent, max_episode_steps=max_steps)
+
+
+class Trainer:
+    def __init__(self, config: TrainConfig):
+        self.env = make_env(config.env, config.max_episode_steps)
+        if hasattr(self.env, "max_episode_steps") is False and config.max_episode_steps:
+            self.env.max_episode_steps = config.max_episode_steps
+        config = _reconcile_config(config, self.env)
+        self.config = config
+        self.is_jax_env = not hasattr(self.env, "last_goal_obs")
+        agent_cfg = config.agent
+
+        # replay
+        obs_dim, act_dim = agent_cfg.obs_dim, agent_cfg.action_dim
+        if config.prioritized:
+            self.buffer = PrioritizedReplayBuffer(
+                config.replay_capacity,
+                obs_dim,
+                act_dim,
+                alpha=agent_cfg.per_alpha,
+                beta0=agent_cfg.per_beta0,
+                beta_steps=agent_cfg.per_beta_steps,
+                eps=agent_cfg.per_eps,
+                tree_backend=config.tree_backend,
+            )
+        else:
+            self.buffer = ReplayBuffer(config.replay_capacity, obs_dim, act_dim)
+
+        # learner
+        self.key = jax.random.PRNGKey(config.seed)
+        self.key, init_key = jax.random.split(self.key)
+        self.state = create_train_state(agent_cfg, init_key)
+        if config.dp:
+            from d4pg_tpu.parallel import make_dp_train_step, make_mesh
+            from d4pg_tpu.parallel.dp import replicate
+
+            self.mesh = make_mesh(dp=config.dp, tp=config.tp)
+            self.state = replicate(self.state, self.mesh)
+            self._train_step = make_dp_train_step(agent_cfg, self.mesh)
+        else:
+            self.mesh = None
+            self._train_step = jit_train_step(agent_cfg)
+
+        self.metrics = MetricsLogger(config.log_dir)
+        self.ckpt = CheckpointManager(f"{config.log_dir}/checkpoints")
+        self.grad_steps = 0
+        if config.resume and self.ckpt.latest_step() is not None:
+            self.state = self.ckpt.restore(self.state)
+            self.grad_steps = int(jax.device_get(self.state.step))
+
+        self.env_steps = 0
+        self.ewma_return: Optional[float] = None
+        self._rng = np.random.default_rng(config.seed)
+        self._noise_init, self._noise_sample, self._noise_reset = make_noise(agent_cfg)
+
+        if config.her:
+            self._setup_her()
+        elif self.is_jax_env:
+            self._setup_sync_collect()
+        else:
+            self._setup_host_collect()
+
+    def _noise_scale(self) -> float:
+        """Exploration scale schedule over env steps (constant when
+        noise_decay_steps == 0 — the reference's effective behavior)."""
+        decay = self.config.agent.noise_decay_steps
+        if decay <= 0:
+            return 1.0
+        return linear_schedule(
+            self.env_steps, decay, 1.0, self.config.agent.noise_scale_final
+        )
+
+    # ------------------------------------------------------------------ sync
+    def _setup_sync_collect(self, segment_len: int = 32):
+        cfg = self.config
+        self.segment_len = segment_len
+        self.writers = [
+            NStepWriter(self.buffer, cfg.n_step, cfg.agent.gamma)
+            for _ in range(cfg.num_envs)
+        ]
+        env, agent_cfg = self.env, cfg.agent
+        noise_sample, noise_reset = self._noise_sample, self._noise_reset
+
+        def collect(actor_params, env_states, obs, noise_states, key, noise_scale):
+            def policy(o, k, nstate):
+                a = act_deterministic(agent_cfg, actor_params, o[None])[0]
+                n, nstate = noise_sample(nstate, k, a.shape)
+                return jnp.clip(a + noise_scale * n, -1.0, 1.0), nstate
+
+            def one(env_state, o, nstate, k):
+                return rollout(
+                    env, policy, k, segment_len,
+                    init_state=env_state, init_obs=o,
+                    policy_state=nstate, policy_state_reset=noise_reset,
+                )
+
+            keys = jax.random.split(key, cfg.num_envs)
+            return jax.vmap(one)(env_states, obs, noise_states, keys)
+
+        self._collect = jax.jit(collect)
+        self.key, reset_key = jax.random.split(self.key)
+        reset_keys = jax.random.split(reset_key, cfg.num_envs)
+        self.env_states, self.obs = jax.vmap(env.reset)(reset_keys)
+        self.noise_states = jax.vmap(lambda _: self._noise_init())(
+            jnp.arange(cfg.num_envs)
+        )
+
+    def _drain_segment(self, traj) -> None:
+        """Feed a [N, L] device segment into the host n-step writers."""
+        t = jax.device_get(traj)
+        N, L = t.reward.shape
+        for i in range(N):
+            w = self.writers[i]
+            for j in range(L):
+                w.add(
+                    t.obs[i, j],
+                    t.action[i, j],
+                    float(t.reward[i, j]),
+                    t.next_obs[i, j],
+                    terminated=bool(t.terminated[i, j]),
+                    truncated=bool(t.truncated[i, j]),
+                )
+        self.env_steps += N * L
+
+    def _collect_once(self, noise_scale: Optional[float] = None) -> None:
+        self.key, k = jax.random.split(self.key)
+        scale = self._noise_scale() if noise_scale is None else noise_scale
+        self.env_states, self.obs, self.noise_states, traj = self._collect(
+            self.state.actor_params, self.env_states, self.obs,
+            self.noise_states, k, scale,
+        )
+        self._drain_segment(traj)
+
+    # ------------------------------------------------------------------ host
+    def _setup_host_collect(self):
+        cfg = self.config
+        self.writers = [NStepWriter(self.buffer, cfg.n_step, cfg.agent.gamma)]
+        self._host_obs = self.env.reset(seed=cfg.seed)
+        self._host_noise = self._noise_init()
+        agent_cfg = cfg.agent
+        noise_sample = self._noise_sample
+
+        def host_act(params, o, k, nstate, scale):
+            a = act_deterministic(agent_cfg, params, o)[0]
+            n, nstate = noise_sample(nstate, k, a.shape)
+            return jnp.clip(a + scale * n, -1.0, 1.0), nstate
+
+        self._host_act = jax.jit(host_act)
+
+    def _host_collect_steps(self, num_steps: int, noise_scale: Optional[float] = None):
+        w = self.writers[0]
+        scale = self._noise_scale() if noise_scale is None else noise_scale
+        for _ in range(num_steps):
+            self.key, k = jax.random.split(self.key)
+            a_dev, self._host_noise = self._host_act(
+                self.state.actor_params,
+                jnp.asarray(self._host_obs)[None],
+                k,
+                self._host_noise,
+                scale,
+            )
+            a = np.asarray(a_dev)
+            obs2, r, term, trunc, info = self.env.step(a)
+            w.add(self._host_obs, a, r, obs2, terminated=term, truncated=trunc)
+            if term or trunc:
+                self._host_obs = self.env.reset()
+                self._host_noise = self._noise_reset(self._host_noise)
+            else:
+                self._host_obs = obs2
+            self.env_steps += 1
+
+    # ------------------------------------------------------------------- HER
+    def _setup_her(self):
+        cfg = self.config
+        env = self.env
+        if isinstance(env, PointMassGoal):
+            reward_fn = lambda ag, dg: float(
+                env.compute_reward(jnp.asarray(ag), jnp.asarray(dg))
+            )
+        elif hasattr(env, "compute_reward") and getattr(env, "is_goal_env", False):
+            reward_fn = env.compute_reward
+        else:
+            raise ValueError(f"--her needs a goal env, got {cfg.env}")
+        self.her_writer = HindsightWriter(
+            writer_factory=lambda: NStepWriter(
+                self.buffer, cfg.n_step, cfg.agent.gamma
+            ),
+            compute_reward=reward_fn,
+            k_future=cfg.her_k,
+            rng=self._rng,
+        )
+        agent_cfg = cfg.agent
+        noise_sample = self._noise_sample
+        self._her_noise = self._noise_init()
+
+        def her_act(params, o, k, nstate, scale):
+            a = act_deterministic(agent_cfg, params, o)[0]
+            n, nstate = noise_sample(nstate, k, a.shape)
+            return jnp.clip(a + scale * n, -1.0, 1.0), nstate
+
+        self._her_act = jax.jit(her_act)
+
+    def _her_collect_episode(self, noise_scale: Optional[float] = None) -> float:
+        if isinstance(self.env, PointMassGoal):
+            return self._her_collect_episode_jax(noise_scale)
+        return self._her_collect_episode_host(noise_scale)
+
+    def _her_collect_episode_jax(self, noise_scale: Optional[float] = None) -> float:
+        """One exploratory episode through the HER writer (pure-JAX goal env)."""
+        env = self.env
+        scale = self._noise_scale() if noise_scale is None else noise_scale
+        self.key, rk = jax.random.split(self.key)
+        state, obs = env.reset(rk)
+        ep_return = 0.0
+        term = False
+        for _ in range(env.max_episode_steps):
+            self.key, ak = jax.random.split(self.key)
+            a, self._her_noise = self._her_act(
+                self.state.actor_params, obs[None], ak, self._her_noise, scale
+            )
+            g0 = env.goal_obs(state)
+            state2, obs2, r, term, trunc = env.step(state, a)
+            g1 = env.goal_obs(state2)
+            self.her_writer.add(
+                observation=np.asarray(g0.observation),
+                achieved_goal=np.asarray(g0.achieved_goal),
+                desired_goal=np.asarray(g0.desired_goal),
+                action=np.asarray(a),
+                reward=float(r),
+                next_observation=np.asarray(g1.observation),
+                next_achieved_goal=np.asarray(g1.achieved_goal),
+                terminated=bool(term),
+            )
+            ep_return += float(r)
+            self.env_steps += 1
+            state = state2
+            obs = obs2
+            if bool(term) or bool(trunc):
+                break
+        self.her_writer.end_episode(truncated=not bool(term))
+        self._her_noise = self._noise_reset(self._her_noise)
+        return ep_return
+
+    def _her_collect_episode_host(self, noise_scale: Optional[float] = None) -> float:
+        """One exploratory episode through the HER writer (gymnasium goal env).
+
+        Uses the adapter's structured goal view (``last_goal_obs``) the same
+        way the reference indexes the obs dict at ``main.py:144,161-184``.
+        """
+        env = self.env
+        scale = self._noise_scale() if noise_scale is None else noise_scale
+        obs = env.reset()
+        ep_return, term, trunc = 0.0, False, False
+        max_steps = self.config.max_episode_steps or 1000
+        for _ in range(max_steps):
+            g0 = env.last_goal_obs
+            self.key, ak = jax.random.split(self.key)
+            a_dev, self._her_noise = self._her_act(
+                self.state.actor_params, jnp.asarray(obs)[None], ak,
+                self._her_noise, scale,
+            )
+            a = np.asarray(a_dev)
+            obs2, r, term, trunc, info = env.step(a)
+            g1 = env.last_goal_obs
+            self.her_writer.add(
+                observation=np.ravel(g0["observation"]),
+                achieved_goal=np.ravel(g0["achieved_goal"]),
+                desired_goal=np.ravel(g0["desired_goal"]),
+                action=a,
+                reward=float(r),
+                next_observation=np.ravel(g1["observation"]),
+                next_achieved_goal=np.ravel(g1["achieved_goal"]),
+                terminated=bool(term),
+            )
+            ep_return += float(r)
+            self.env_steps += 1
+            obs = obs2
+            if term or trunc:
+                break
+        self.her_writer.end_episode(truncated=not term)
+        self._her_noise = self._noise_reset(self._her_noise)
+        return ep_return
+
+    # ---------------------------------------------------------------- warmup
+    def warmup(self) -> None:
+        """Pre-fill replay with high-noise exploration (reference
+        ``warmup()``, ``main.py:200-207``)."""
+        cfg = self.config
+        while self.env_steps < cfg.warmup_steps:
+            if cfg.her:
+                self._her_collect_episode(noise_scale=3.0)
+            elif self.is_jax_env:
+                self._collect_once(noise_scale=3.0)
+            else:
+                self._host_collect_steps(64, noise_scale=3.0)
+
+    # ----------------------------------------------------------------- train
+    def _sample(self):
+        if self.config.prioritized:
+            batch = self.buffer.sample(
+                self.config.batch_size, self._rng, step=self.grad_steps
+            )
+        else:
+            batch = dict(self.buffer.sample(self.config.batch_size, self._rng))
+            batch["weights"] = np.ones(self.config.batch_size, np.float32)
+        return batch
+
+    def train(self, total_steps: Optional[int] = None) -> dict:
+        """Run the full loop; returns final metrics."""
+        cfg = self.config
+        total = total_steps or cfg.total_steps
+        self.warmup()
+
+        t_start = time.monotonic()
+        grad_steps_done = 0
+        pending = None  # (indices, priorities future) — one-step pipeline lag
+        last = {}
+        collect_budget = 0.0
+
+        while grad_steps_done < total:
+            # interleave collection to hold the env:train ratio
+            collect_budget += cfg.env_steps_per_train_step
+            if cfg.her:
+                max_steps = self.config.max_episode_steps or 1000
+                if collect_budget >= max_steps:
+                    self._her_collect_episode()
+                    collect_budget -= max_steps
+            elif self.is_jax_env:
+                per_iter = cfg.num_envs * self.segment_len
+                if collect_budget >= per_iter:
+                    self._collect_once()
+                    collect_budget -= per_iter
+            else:
+                n = int(collect_budget)
+                if n > 0:
+                    self._host_collect_steps(n)
+                    collect_budget -= n
+
+            batch = self._sample()
+            indices = batch.pop("indices", None)
+            dev_batch = {k: jnp.asarray(v) for k, v in batch.items()}
+            # dispatch is async: the TPU runs while we write back the
+            # PREVIOUS step's priorities and sample the next batch
+            self.state, metrics, priorities = self._train_step(self.state, dev_batch)
+            if pending is not None and self.config.prioritized:
+                prev_idx, prev_pri = pending
+                self.buffer.update_priorities(prev_idx, np.asarray(prev_pri))
+            pending = (indices, priorities)
+            grad_steps_done += 1
+            self.grad_steps += 1
+
+            step = grad_steps_done
+            if step % cfg.eval_interval == 0 or step == total:
+                last = self._periodic(step, metrics, t_start, grad_steps_done)
+            if step % cfg.checkpoint_interval == 0 or step == total:
+                self.ckpt.save(self.grad_steps, self.state)
+        if pending is not None and self.config.prioritized:
+            self.buffer.update_priorities(pending[0], np.asarray(pending[1]))
+        self.ckpt.wait()
+        return last
+
+    def _host_eval(self) -> dict:
+        """Greedy eval episodes through a host env (reference main.py:309-347)."""
+        cfg = self.config
+        rets, succ = [], 0
+        eval_act = jax.jit(
+            lambda p, o: act_deterministic(cfg.agent, p, o)
+        )
+        for _ in range(cfg.eval_episodes):
+            obs = self.env.reset()
+            ep_ret, term, trunc = 0.0, False, False
+            for _ in range(cfg.max_episode_steps or 1000):
+                a = np.asarray(eval_act(self.state.actor_params, jnp.asarray(obs)[None])[0])
+                obs, r, term, trunc, info = self.env.step(a)
+                ep_ret += r
+                if term or trunc:
+                    break
+            succ += int(bool(info.get("is_success", term))) if isinstance(info, dict) else int(term)
+            rets.append(ep_ret)
+        return {
+            "eval_return_mean": float(np.mean(rets)),
+            "eval_return_std": float(np.std(rets)),
+            "success_rate": succ / cfg.eval_episodes,
+        }
+
+    def _periodic(self, step, metrics, t_start, grad_steps_done) -> dict:
+        cfg = self.config
+        scalars = {k: float(v) for k, v in jax.device_get(metrics).items()}
+        if self.is_jax_env:
+            self.key, ek = jax.random.split(self.key)
+            ev = evaluate(
+                cfg.agent, self.env, self.state.actor_params, ek, cfg.eval_episodes
+            )
+        else:
+            ev = self._host_eval()
+        # EWMA smoothing (reference main.py:131)
+        if self.ewma_return is None:
+            self.ewma_return = ev["eval_return_mean"]
+        else:
+            self.ewma_return = (
+                (1 - cfg.ewma_alpha) * self.ewma_return
+                + cfg.ewma_alpha * ev["eval_return_mean"]
+            )
+        scalars.update(ev)
+        scalars["avg_test_reward_ewma"] = self.ewma_return
+        scalars["noise_scale"] = self._noise_scale()
+        dt = time.monotonic() - t_start
+        scalars.update(
+            {
+                "grad_steps_per_sec": grad_steps_done / dt,
+                "env_steps_per_sec": self.env_steps / dt,
+                "replay_size": len(self.buffer),
+                "env_steps": self.env_steps,
+            }
+        )
+        self.metrics.log(step, scalars)
+        print(
+            f"[step {step}] "
+            + " ".join(f"{k}={v:.3f}" for k, v in scalars.items() if k != "replay_size")
+        )
+        return scalars
+
+    def close(self):
+        self.metrics.close()
+        self.ckpt.close()
+        if hasattr(self.env, "close"):
+            self.env.close()

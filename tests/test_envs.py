@@ -1,0 +1,109 @@
+"""Tests for pure-JAX envs, on-device rollout, and the gymnasium adapter."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from d4pg_tpu.envs import Pendulum, PointMassGoal, rollout
+from d4pg_tpu.envs.gym_adapter import NormalizeAction
+
+
+def test_pendulum_reset_and_step():
+    env = Pendulum()
+    state, obs = env.reset(jax.random.PRNGKey(0))
+    assert obs.shape == (3,)
+    state, obs, r, term, trunc = env.step(state, jnp.asarray([0.5]))
+    assert float(r) <= 0.0
+    assert float(term) == 0.0
+    # cos^2 + sin^2 == 1
+    assert float(obs[0] ** 2 + obs[1] ** 2) == pytest.approx(1.0, abs=1e-5)
+
+
+def test_pendulum_truncates_at_limit():
+    env = Pendulum()
+    state, obs = env.reset(jax.random.PRNGKey(0))
+    for _ in range(env.max_episode_steps):
+        state, obs, r, term, trunc = env.step(state, jnp.asarray([0.0]))
+    assert float(trunc) == 1.0
+
+
+def test_pendulum_matches_gym_dynamics():
+    gymnasium = pytest.importorskip("gymnasium")
+    genv = gymnasium.make("Pendulum-v1").unwrapped
+    genv.reset(seed=0)
+    theta, thetadot = 0.3, -0.5
+    genv.state = np.array([theta, thetadot])
+    from d4pg_tpu.envs.api import EnvState
+
+    jenv = Pendulum()
+    jstate = EnvState(
+        physics=jnp.asarray([theta, thetadot]),
+        t=jnp.zeros((), jnp.int32),
+        key=jax.random.PRNGKey(0),
+    )
+    # torque 1.0 == canonical action 0.5 (max_torque 2)
+    gobs, grew, *_ = genv.step(np.array([1.0]))
+    jstate, jobs, jrew, *_ = jenv.step(jstate, jnp.asarray([0.5]))
+    np.testing.assert_allclose(np.asarray(jobs), gobs, rtol=1e-5, atol=1e-5)
+    assert float(jrew) == pytest.approx(float(grew), abs=1e-5)
+
+
+def test_pointmass_goal_success_and_reward():
+    env = PointMassGoal()
+    state, obs = env.reset(jax.random.PRNGKey(1))
+    assert obs.shape == (6,)
+    r_far = env.compute_reward(jnp.asarray([0.0, 0.0]), jnp.asarray([1.0, 1.0]))
+    r_near = env.compute_reward(jnp.asarray([0.0, 0.0]), jnp.asarray([0.01, 0.0]))
+    assert float(r_far) == -1.0
+    assert float(r_near) == 0.0
+
+
+def test_rollout_scan_shapes_and_autoreset():
+    env = PointMassGoal()
+    env.max_episode_steps = 10
+
+    def policy(obs, key):
+        return jax.random.uniform(key, (2,), minval=-1, maxval=1)
+
+    final_state, final_obs, traj = rollout(env, policy, jax.random.PRNGKey(0), 35)
+    assert traj.obs.shape == (35, 6)
+    assert traj.action.shape == (35, 2)
+    # at least 3 truncations/terminations happened in 35 steps of <=10-step eps
+    assert float(jnp.sum(jnp.maximum(traj.terminated, traj.truncated))) >= 3
+
+
+def test_rollout_is_jittable_and_vmappable():
+    env = Pendulum()
+
+    def policy(obs, key):
+        return jnp.tanh(obs[:1]) * 0.0
+
+    f = jax.jit(lambda k: rollout(env, policy, k, 16)[2].reward)
+    r = f(jax.random.PRNGKey(0))
+    assert r.shape == (16,)
+    batched = jax.vmap(lambda k: rollout(env, policy, k, 8)[2].reward)(
+        jax.random.split(jax.random.PRNGKey(1), 4)
+    )
+    assert batched.shape == (4, 8)
+
+
+def test_normalize_action_affine_roundtrip():
+    n = NormalizeAction(low=np.array([-2.0, 0.0]), high=np.array([2.0, 10.0]))
+    np.testing.assert_allclose(n.to_env(np.array([0.0, 0.0])), [0.0, 5.0])
+    np.testing.assert_allclose(n.to_env(np.array([-1.0, 1.0])), [-2.0, 10.0])
+    a = np.array([0.3, -0.7])
+    np.testing.assert_allclose(n.to_canonical(n.to_env(a)), a, atol=1e-6)
+
+
+def test_gym_adapter_pendulum():
+    pytest.importorskip("gymnasium")
+    from d4pg_tpu.envs import make_env
+
+    env = make_env("Pendulum-v1")
+    obs = env.reset(seed=0)
+    assert obs.shape == (3,)
+    assert env.action_dim == 1
+    obs, r, term, trunc, info = env.step(np.array([0.5]))
+    assert isinstance(r, float)
+    env.close()

@@ -8,23 +8,21 @@ from d4pg_tpu.ops import nstep_returns
 
 def oracle(rewards, dones, gamma, n):
     T = len(rewards)
-    rets = np.zeros(T)
-    boot = np.zeros(T)
+    rets, boot, offs = np.zeros(T), np.zeros(T), np.zeros(T, int)
     for t in range(T):
-        g, alive = 0.0, True
-        steps = 0
+        g, m, terminated = 0.0, 0, False
         for k in range(n):
-            if t + k >= T or not alive:
-                alive = False
-                break
+            if t + k >= T:
+                break  # chunk boundary: stop, bootstrap still valid
             g += gamma**k * rewards[t + k]
-            steps += 1
+            m += 1
             if dones[t + k]:
-                alive = False
+                terminated = True
                 break
         rets[t] = g
-        boot[t] = (gamma**n) if (alive and steps == n) else 0.0
-    return rets, boot
+        offs[t] = m
+        boot[t] = 0.0 if terminated else gamma**m
+    return rets, boot, offs
 
 
 def test_nstep_matches_oracle():
@@ -33,17 +31,32 @@ def test_nstep_matches_oracle():
     rewards = rng.normal(size=T)
     dones = (rng.uniform(size=T) < 0.15).astype(np.float64)
     for n in (1, 3, 5):
-        got_r, got_b = nstep_returns(
+        got_r, got_b, got_m = nstep_returns(
             jnp.asarray(rewards, jnp.float32), jnp.asarray(dones, jnp.float32), 0.99, n
         )
-        want_r, want_b = oracle(rewards, dones, 0.99, n)
+        want_r, want_b, want_m = oracle(rewards, dones, 0.99, n)
         np.testing.assert_allclose(np.asarray(got_r), want_r, rtol=1e-5, atol=1e-5)
         np.testing.assert_allclose(np.asarray(got_b), want_b, rtol=1e-5, atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(got_m), want_m)
 
 
 def test_one_step_reduces_to_rewards():
     rewards = jnp.asarray([1.0, 2.0, 3.0])
     dones = jnp.asarray([0.0, 0.0, 1.0])
-    r, b = nstep_returns(rewards, dones, 0.9, 1)
+    r, b, m = nstep_returns(rewards, dones, 0.9, 1)
     np.testing.assert_allclose(np.asarray(r), [1, 2, 3], atol=1e-6)
     np.testing.assert_allclose(np.asarray(b), [0.9, 0.9, 0.0], atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(m), [1, 1, 1])
+
+
+def test_chunk_boundary_keeps_bootstrap():
+    # No dones: windows at the end of the chunk shrink but still bootstrap
+    # with gamma^m (NOT treated as termination).
+    rewards = jnp.ones(5)
+    dones = jnp.zeros(5)
+    r, b, m = nstep_returns(rewards, dones, 0.5, 3)
+    np.testing.assert_array_equal(np.asarray(m), [3, 3, 3, 2, 1])
+    np.testing.assert_allclose(
+        np.asarray(b), [0.125, 0.125, 0.125, 0.25, 0.5], atol=1e-6
+    )
+    np.testing.assert_allclose(np.asarray(r[3]), 1 + 0.5, atol=1e-6)

@@ -1,0 +1,159 @@
+"""Tests for uniform/PER buffers, n-step writer, HER, schedules."""
+
+import numpy as np
+import pytest
+
+from d4pg_tpu.replay import (
+    HindsightWriter,
+    NStepWriter,
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+    Transition,
+    linear_schedule,
+)
+
+
+def _fill(buf, n, obs_dim=3, act_dim=2, rng=None):
+    rng = rng or np.random.default_rng(0)
+    for i in range(n):
+        buf.add(rng.normal(size=obs_dim), rng.normal(size=act_dim), float(i), rng.normal(size=obs_dim), 0.99)
+
+
+def test_ring_buffer_wraps():
+    buf = ReplayBuffer(8, 3, 2)
+    _fill(buf, 10)
+    assert len(buf) == 8
+    # oldest two entries overwritten: rewards now 8,9,2..7 in ring order
+    assert set(buf.reward.tolist()) == {8.0, 9.0, 2.0, 3.0, 4.0, 5.0, 6.0, 7.0}
+
+
+def test_uniform_sample_shapes():
+    buf = ReplayBuffer(100, 3, 2)
+    _fill(buf, 50)
+    batch = buf.sample(16, np.random.default_rng(0))
+    assert batch["obs"].shape == (16, 3)
+    assert batch["action"].shape == (16, 2)
+    assert batch["reward"].shape == (16,)
+    assert batch["discount"].shape == (16,)
+
+
+def test_per_new_samples_get_max_priority_and_weights_one():
+    buf = PrioritizedReplayBuffer(64, 3, 2, alpha=0.6, tree_backend="numpy")
+    _fill(buf, 20)
+    batch = buf.sample(8, np.random.default_rng(0), step=0)
+    # all priorities equal => all IS weights 1
+    np.testing.assert_allclose(batch["weights"], 1.0, atol=1e-6)
+
+
+def test_per_prioritized_sampling_prefers_high_td():
+    buf = PrioritizedReplayBuffer(64, 1, 1, alpha=1.0, tree_backend="numpy")
+    for i in range(10):
+        buf.add(np.array([float(i)]), np.array([0.0]), 0.0, np.array([0.0]), 0.99)
+    # slot 3 gets enormous priority
+    pri = np.full(10, 1e-3)
+    pri[3] = 1e3
+    buf.update_priorities(np.arange(10), pri)
+    batch = buf.sample(256, np.random.default_rng(1), step=0)
+    frac3 = np.mean(batch["obs"][:, 0] == 3.0)
+    assert frac3 > 0.95
+    # and its IS weight is the smallest
+    w = batch["weights"][batch["obs"][:, 0] == 3.0]
+    assert np.all(w <= batch["weights"].max())
+    assert w.max() < 1e-2
+
+
+def test_per_beta_anneals():
+    buf = PrioritizedReplayBuffer(64, 1, 1, beta0=0.4, beta_steps=100, tree_backend="numpy")
+    assert buf.beta(0) == pytest.approx(0.4)
+    assert buf.beta(50) == pytest.approx(0.7)
+    assert buf.beta(1000) == pytest.approx(1.0)
+
+
+def test_per_update_priorities_roundtrip():
+    buf = PrioritizedReplayBuffer(32, 1, 1, alpha=1.0, eps=0.0, tree_backend="numpy")
+    _fill(buf, 4, obs_dim=1, act_dim=1)
+    buf.update_priorities(np.array([0, 1, 2, 3]), np.array([1.0, 2.0, 3.0, 4.0]))
+    assert buf._sum.sum() == pytest.approx(10.0)
+    assert buf._min.min() == pytest.approx(1.0)
+
+
+def test_nstep_writer_steady_state():
+    buf = ReplayBuffer(100, 1, 1)
+    w = NStepWriter(buf, n=3, gamma=0.9)
+    obs = [np.array([float(i)]) for i in range(10)]
+    for t in range(6):
+        w.add(obs[t], np.array([0.0]), 1.0, obs[t + 1], terminated=False)
+    # windows [0..2],[1..3],[2..4],[3..5] -> 4 emitted
+    assert len(buf) == 4
+    np.testing.assert_allclose(buf.reward[:4], 1 + 0.9 + 0.81, atol=1e-6)
+    np.testing.assert_allclose(buf.discount[:4], 0.9**3, atol=1e-6)
+    # s_{t+3} stored as next_obs
+    np.testing.assert_allclose(buf.next_obs[0], [3.0])
+
+
+def test_nstep_writer_termination_flush():
+    buf = ReplayBuffer(100, 1, 1)
+    w = NStepWriter(buf, n=3, gamma=0.5)
+    o = [np.array([float(i)]) for i in range(4)]
+    w.add(o[0], np.array([0.0]), 1.0, o[1], terminated=False)
+    w.add(o[1], np.array([0.0]), 2.0, o[2], terminated=True)
+    # Partial windows flush: [r0, r1] and [r1], both terminal (discount 0)
+    assert len(buf) == 2
+    np.testing.assert_allclose(sorted(buf.reward[:2]), [2.0, 1 + 0.5 * 2])
+    np.testing.assert_allclose(buf.discount[:2], 0.0)
+
+
+def test_nstep_writer_truncation_keeps_bootstrap():
+    buf = ReplayBuffer(100, 1, 1)
+    w = NStepWriter(buf, n=3, gamma=0.5)
+    o = [np.array([float(i)]) for i in range(3)]
+    w.add(o[0], np.array([0.0]), 1.0, o[1], terminated=False)
+    w.add(o[1], np.array([0.0]), 2.0, o[2], terminated=False, truncated=True)
+    assert len(buf) == 2
+    # window [r0,r1]: m=2 discount 0.25; window [r1]: m=1 discount 0.5
+    np.testing.assert_allclose(sorted(buf.discount[:2]), [0.25, 0.5])
+
+
+def test_her_relabels_with_future_goals_and_own_actions():
+    buf = ReplayBuffer(1000, 2, 1)  # obs = [x, goal]
+    rng = np.random.default_rng(0)
+
+    def reward_fn(achieved, goal):
+        return 0.0 if abs(float(achieved[0] - goal[0])) < 0.5 else -1.0
+
+    her = HindsightWriter(
+        writer_factory=lambda: NStepWriter(buf, n=1, gamma=0.99),
+        compute_reward=reward_fn,
+        k_future=2,
+        rng=rng,
+    )
+    # 1-D walk: position t -> t+1, desired goal 10 (never achieved)
+    for t in range(5):
+        her.add(
+            observation=np.array([float(t)]),
+            achieved_goal=np.array([float(t)]),
+            desired_goal=np.array([10.0]),
+            action=np.array([float(t)]),  # action == t so we can check pairing
+            reward=-1.0,
+            next_observation=np.array([float(t + 1)]),
+            next_achieved_goal=np.array([float(t + 1)]),
+            terminated=False,
+        )
+    n = her.end_episode(truncated=True)
+    assert n >= 5 * 3  # may truncate relabeled episodes early at success
+    data = buf.gather(np.arange(len(buf)))
+    # Every stored transition's action matches its own obs x-coordinate
+    # (the reference bug stored the final action everywhere).
+    np.testing.assert_allclose(data["action"][:, 0], data["obs"][:, 0])
+    # Some relabeled transitions achieved their substituted goal.
+    assert np.any(data["reward"] == 0.0)
+    # Original (goal=10) transitions are present too (quirk #14 fix).
+    assert np.sum(data["obs"][:, 1] == 10.0) == 5
+
+
+def test_linear_schedule_pure():
+    assert linear_schedule(0, 10, 1.0, 0.0) == 1.0
+    assert linear_schedule(5, 10, 1.0, 0.0) == 0.5
+    assert linear_schedule(20, 10, 1.0, 0.0) == 0.0
+    # calling twice does not change the result (no reference quirk #8)
+    assert linear_schedule(5, 10, 1.0, 0.0) == 0.5

@@ -1,0 +1,57 @@
+"""Property tests: tree invariants + proportional-sampling statistics."""
+
+import numpy as np
+import pytest
+
+from d4pg_tpu.replay import MinTree, SumTree
+
+
+def test_sum_tree_invariant_random_updates():
+    rng = np.random.default_rng(0)
+    t = SumTree(100)
+    ref = np.zeros(t.capacity)
+    for _ in range(50):
+        idx = rng.integers(0, 100, size=17)
+        vals = rng.uniform(0, 5, size=17)
+        # emulate last-write-wins for duplicates like the tree does
+        t.set(idx, vals)
+        ref[idx] = vals
+        assert t.sum() == pytest.approx(ref.sum())
+        np.testing.assert_allclose(t.get(np.arange(100)), ref[:100])
+
+
+def test_min_tree_invariant():
+    rng = np.random.default_rng(1)
+    t = MinTree(64)
+    ref = np.full(t.capacity, np.inf)
+    for _ in range(30):
+        idx = rng.integers(0, 64, size=9)
+        vals = rng.uniform(0.1, 5, size=9)
+        t.set(idx, vals)
+        ref[idx] = vals
+        assert t.min() == pytest.approx(ref.min())
+
+
+def test_prefixsum_idx_definition():
+    t = SumTree(8)
+    t.set(np.arange(4), np.array([1.0, 2.0, 3.0, 4.0]))  # cumsum 1,3,6,10
+    got = t.find_prefixsum_idx(np.array([0.0, 0.5, 1.0, 2.99, 3.0, 9.99]))
+    np.testing.assert_array_equal(got, [0, 0, 1, 1, 2, 3])
+
+
+def test_proportional_sampling_statistics():
+    rng = np.random.default_rng(2)
+    t = SumTree(16)
+    p = np.array([1.0, 2.0, 4.0, 8.0])
+    t.set(np.arange(4), p)
+    draws = t.find_prefixsum_idx(rng.uniform(0, t.sum(), size=200_000))
+    freq = np.bincount(draws, minlength=4)[:4] / 200_000
+    np.testing.assert_allclose(freq, p / p.sum(), atol=0.01)
+
+
+def test_non_pow2_capacity_padding():
+    t = SumTree(100)
+    assert t.capacity == 128
+    t.set(np.array([99]), np.array([7.0]))
+    assert t.sum() == pytest.approx(7.0)
+    assert t.find_prefixsum_idx(np.array([3.0]))[0] == 99

@@ -1,0 +1,154 @@
+"""Training CLI.
+
+Flag surface covers the reference's 19 argparse flags (``main.py:31-56``)
+with TPU-native equivalents: ``--n-workers`` becomes ``--num-envs`` (on-device
+vectorized actors) and ``--dp`` (synchronous data-parallel devices, replacing
+Hogwild workers); ``--multithread`` is gone (the single-process design is
+always "multithreaded" via async dispatch).
+
+Examples:
+    python train.py --env pendulum --total-steps 50000
+    python train.py --env pointmass_goal --her --n-step 1
+    python train.py --env pendulum --dp 8 --batch-size 512   # 8-chip DP
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+
+from d4pg_tpu.agent.state import D4PGConfig
+from d4pg_tpu.config import TrainConfig
+from d4pg_tpu.models.critic import DistConfig
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="TPU-native D4PG")
+    # reference-parity flags (main.py:31-56)
+    p.add_argument("--env", default="pendulum",
+                   help="pendulum | pointmass_goal | any gymnasium id")
+    p.add_argument("--rmsize", "--replay-capacity", dest="replay_capacity",
+                   type=int, default=1_000_000)
+    p.add_argument("--tau", type=float, default=0.001)
+    p.add_argument("--bsize", "--batch-size", dest="batch_size", type=int, default=256)
+    p.add_argument("--gamma", type=float, default=0.99)
+    p.add_argument("--max-steps", dest="max_episode_steps", type=int, default=None)
+    p.add_argument("--warmup", dest="warmup_steps", type=int, default=1_000)
+    p.add_argument("--p-replay", "--prioritized", dest="prioritized",
+                   action=argparse.BooleanOptionalAction, default=True)
+    p.add_argument("--v-min", type=float, default=None)
+    p.add_argument("--v-max", type=float, default=None)
+    p.add_argument("--n-atoms", type=int, default=51)
+    p.add_argument("--n-step", "--n-steps", dest="n_step", type=int, default=3)
+    p.add_argument("--her", action="store_true")
+    p.add_argument("--her-k", type=int, default=4)
+    p.add_argument("--log-dir", default=None)
+    p.add_argument("--ou-theta", type=float, default=0.15)
+    p.add_argument("--ou-sigma", type=float, default=0.2)
+    p.add_argument("--ou-mu", type=float, default=0.0)
+    p.add_argument("--noise", choices=["gaussian", "ou"], default="gaussian")
+    p.add_argument("--noise-epsilon", type=float, default=0.3)
+    # TPU-native flags
+    p.add_argument("--num-envs", type=int, default=16,
+                   help="vectorized on-device exploration envs (was --n_workers)")
+    p.add_argument("--dp", type=int, default=None,
+                   help="data-parallel device count (None = single device)")
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--critic-head", choices=["categorical", "scalar", "mixture_gaussian"],
+                   default="categorical")
+    p.add_argument("--compute-dtype", choices=["float32", "bfloat16"], default="float32")
+    p.add_argument("--total-steps", type=int, default=100_000,
+                   help="learner grad steps to run")
+    p.add_argument("--eval-interval", type=int, default=2_000)
+    p.add_argument("--eval-episodes", type=int, default=10)
+    p.add_argument("--checkpoint-interval", type=int, default=10_000)
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--lr-actor", type=float, default=1e-4)
+    p.add_argument("--lr-critic", type=float, default=1e-4)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--tree-backend", choices=["auto", "numpy", "native"], default="auto")
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> TrainConfig:
+    dist = DistConfig(
+        kind=args.critic_head,
+        num_atoms=args.n_atoms,
+        v_min=args.v_min if args.v_min is not None else -10.0,
+        v_max=args.v_max if args.v_max is not None else 10.0,
+    )
+    agent = D4PGConfig(
+        dist=dist,
+        gamma=args.gamma,
+        n_step=args.n_step,
+        tau=args.tau,
+        lr_actor=args.lr_actor,
+        lr_critic=args.lr_critic,
+        noise_kind=args.noise,
+        noise_epsilon=args.noise_epsilon,
+        ou_theta=args.ou_theta,
+        ou_sigma=args.ou_sigma,
+        ou_mu=args.ou_mu,
+        prioritized=args.prioritized,
+        compute_dtype=args.compute_dtype,
+    )
+    # run-identity log dir (reference main.py:59-66)
+    log_dir = args.log_dir or (
+        f"runs/{args.env}_{'PER' if args.prioritized else 'UNI'}"
+        f"{'_HER' if args.her else ''}_n{args.n_step}_{args.num_envs}env"
+    )
+    cfg = TrainConfig(
+        env=args.env,
+        max_episode_steps=args.max_episode_steps,
+        num_envs=args.num_envs,
+        her=args.her,
+        her_k=args.her_k,
+        total_steps=args.total_steps,
+        warmup_steps=args.warmup_steps,
+        batch_size=args.batch_size,
+        replay_capacity=args.replay_capacity,
+        prioritized=args.prioritized,
+        n_step=args.n_step,
+        tree_backend=args.tree_backend,
+        eval_interval=args.eval_interval,
+        eval_episodes=args.eval_episodes,
+        log_dir=log_dir,
+        checkpoint_interval=args.checkpoint_interval,
+        resume=args.resume,
+        dp=args.dp,
+        tp=args.tp,
+        agent=agent,
+        seed=args.seed,
+    )
+    # explicit --v-min/--v-max beat the env preset
+    if args.v_min is not None or args.v_max is not None:
+        from d4pg_tpu.config import apply_env_preset
+
+        cfg = apply_env_preset(cfg)
+        dist = dataclasses.replace(
+            cfg.agent.dist,
+            v_min=args.v_min if args.v_min is not None else cfg.agent.dist.v_min,
+            v_max=args.v_max if args.v_max is not None else cfg.agent.dist.v_max,
+        )
+        cfg = dataclasses.replace(
+            cfg, agent=dataclasses.replace(cfg.agent, dist=dist)
+        )
+    return cfg
+
+
+def main(argv=None) -> None:
+    from d4pg_tpu.runtime import Trainer
+
+    args = build_parser().parse_args(argv)
+    cfg = config_from_args(args)
+    print(f"config: {cfg}")
+    trainer = Trainer(cfg)
+    try:
+        final = trainer.train()
+        print(f"done: {final}")
+    finally:
+        trainer.close()
+
+
+if __name__ == "__main__":
+    main()
