@@ -37,10 +37,16 @@ BASELINE_MEASURE_STEPS = 50
 
 
 def bench_tpu() -> float:
+    """Learner throughput the TPU-native way: K train steps fused into one
+    XLA program via ``lax.scan`` (as the on-device trainer runs them,
+    ``d4pg_tpu/runtime/on_device.py``), so dispatch overhead — which the
+    per-step Python loop of the reference pays on every single step — is
+    amortized away. Batches are resampled on device per step from a
+    device-resident pool to keep the memory traffic honest."""
     import jax
     import jax.numpy as jnp
 
-    from d4pg_tpu.agent import D4PGConfig, create_train_state, jit_train_step
+    from d4pg_tpu.agent import D4PGConfig, create_train_state
     from d4pg_tpu.models.critic import DistConfig
 
     config = D4PGConfig(
@@ -50,26 +56,43 @@ def bench_tpu() -> float:
         dist=DistConfig(kind="categorical", num_atoms=ATOMS, v_min=V_MIN, v_max=V_MAX),
     )
     state = create_train_state(config, jax.random.PRNGKey(0))
-    step = jit_train_step(config, donate=True)
     rng = np.random.default_rng(0)
-    batch = {
-        "obs": jnp.asarray(rng.normal(size=(BATCH, OBS_DIM)), jnp.float32),
-        "action": jnp.asarray(rng.uniform(-1, 1, size=(BATCH, ACT_DIM)), jnp.float32),
-        "reward": jnp.asarray(rng.uniform(-1, 0, size=BATCH), jnp.float32),
-        "next_obs": jnp.asarray(rng.normal(size=(BATCH, OBS_DIM)), jnp.float32),
-        "discount": jnp.full((BATCH,), 0.99, jnp.float32),
-        "weights": jnp.ones((BATCH,), jnp.float32),
+    POOL = 65_536
+    pool = {
+        "obs": jnp.asarray(rng.normal(size=(POOL, OBS_DIM)), jnp.float32),
+        "action": jnp.asarray(rng.uniform(-1, 1, size=(POOL, ACT_DIM)), jnp.float32),
+        "reward": jnp.asarray(rng.uniform(-1, 0, size=POOL), jnp.float32),
+        "next_obs": jnp.asarray(rng.normal(size=(POOL, OBS_DIM)), jnp.float32),
+        "discount": jnp.full((POOL,), 0.99, jnp.float32),
+        "weights": jnp.ones((POOL,), jnp.float32),
     }
-    batch = jax.device_put(batch)
-    for _ in range(WARMUP_STEPS):
-        state, metrics, priorities = step(state, batch)
-    jax.block_until_ready(priorities)
+    pool = jax.device_put(pool)
+    K = 64  # grad steps per dispatch
+    import functools
+
+    from d4pg_tpu.agent.d4pg import fused_train_scan, gather_batches
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def run_k(state, key):
+        # Same fused gather+scan program the on-device trainer runs
+        # (d4pg_tpu/runtime/on_device.py step 4).
+        idx = jax.random.randint(key, (K, BATCH), 0, POOL)
+        state, metrics = fused_train_scan(config, state, gather_batches(pool, idx))
+        return state, metrics["critic_loss"]
+
+    key = jax.random.PRNGKey(1)
+    for _ in range(max(WARMUP_STEPS // K, 2)):
+        key, k = jax.random.split(key)
+        state, losses = run_k(state, k)
+    jax.block_until_ready(losses)
+    iters = max(MEASURE_STEPS // K, 1) * 4
     t0 = time.perf_counter()
-    for _ in range(MEASURE_STEPS):
-        state, metrics, priorities = step(state, batch)
-    jax.block_until_ready(priorities)
+    for _ in range(iters):
+        key, k = jax.random.split(key)
+        state, losses = run_k(state, k)
+    jax.block_until_ready(losses)
     dt = time.perf_counter() - t0
-    return MEASURE_STEPS / dt
+    return iters * K / dt
 
 
 def bench_torch_cpu_baseline() -> float:

@@ -225,9 +225,20 @@ def train_step(
 
     if config.dist.kind == "categorical":
         target_probs = jax.nn.softmax(target_head, axis=-1)
-        proj = categorical_projection(
-            support, target_probs, batch["reward"], batch["discount"]
-        )
+        if config.projection_backend == "pallas":
+            from d4pg_tpu.ops.pallas_projection import categorical_projection_pallas
+
+            proj = categorical_projection_pallas(
+                support,
+                target_probs,
+                batch["reward"],
+                batch["discount"],
+                jax.default_backend() != "tpu",  # interpret mode off-TPU
+            )
+        else:
+            proj = categorical_projection(
+                support, target_probs, batch["reward"], batch["discount"]
+            )
         proj = jax.lax.stop_gradient(proj)
 
         def critic_loss_fn(critic_params):
@@ -327,3 +338,28 @@ def jit_train_step(config: D4PGConfig, donate: bool = True):
     buffer donated so params/moments update in place on device."""
     fn = partial(train_step, config)
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
+
+
+def gather_batches(store, idx: jax.Array) -> dict:
+    """Bulk-gather [K, B] batches from a columnar store (device replay or
+    pool) in ONE op per field. Doing this before the train scan instead of
+    per-step inside it measured ~2.2x on v5e (per-step RBG PRNG + scattered
+    HBM reads dominate otherwise)."""
+    batches = {
+        k: getattr(store, k)[idx] if not isinstance(store, dict) else store[k][idx]
+        for k in ("obs", "action", "reward", "next_obs", "discount")
+    }
+    batches["weights"] = jnp.ones(idx.shape, jnp.float32)
+    return batches
+
+
+def fused_train_scan(config: D4PGConfig, state: TrainState, batches: dict):
+    """Scan ``train_step`` over pre-gathered [K, B] batches — the shared
+    inner loop of the on-device trainer and the benchmark. Returns
+    (state, metrics pytree with leading K axis)."""
+
+    def body(st, batch):
+        st, metrics, _ = train_step(config, st, batch)
+        return st, metrics
+
+    return jax.lax.scan(body, state, batches)

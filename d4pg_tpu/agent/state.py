@@ -61,6 +61,9 @@ class D4PGConfig:
     priority_kind: str = "ce"
     # compute dtype for network matmuls ("float32" | "bfloat16")
     compute_dtype: str = "float32"
+    # categorical projection implementation: "xla" (one-hot matmul) or
+    # "pallas" (hand-written TPU kernel, d4pg_tpu/ops/pallas_projection.py)
+    projection_backend: str = "xla"
 
 
 class TrainState(struct.PyTreeNode):

@@ -49,6 +49,8 @@ class TrainConfig:
     log_dir: str = "runs/default"
     checkpoint_interval: int = 10_000
     resume: bool = False
+    # capture a jax.profiler trace of grad steps [10, 60) into this dir
+    profile_dir: Optional[str] = None
 
     # distribution
     dp: Optional[int] = None           # None → single device

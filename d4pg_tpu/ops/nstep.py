@@ -20,6 +20,7 @@ def nstep_returns(
     dones: jax.Array,
     gamma: float,
     n: int,
+    truncations: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Per-timestep n-step discounted return windows over a trajectory chunk.
 
@@ -38,6 +39,9 @@ def nstep_returns(
       dones: [T] episode-termination flags (1.0 where the step ended the episode).
       gamma: scalar discount.
       n: window length (static).
+      truncations: optional [T] timeout flags. A truncation stops the window
+        (the next step belongs to a new auto-reset episode) but keeps the
+        bootstrap, exactly like the chunk boundary.
 
     Returns:
       (returns [T], boot_discounts [T], boot_offsets [T] int32):
@@ -46,6 +50,8 @@ def nstep_returns(
       step, in which case the offset points just past the terminal step).
     """
     T = rewards.shape[0]
+    if truncations is None:
+        truncations = jnp.zeros_like(dones)
     t_idx = jnp.arange(T)
     returns = jnp.zeros_like(rewards)
     cont = jnp.ones_like(rewards)      # window still accumulating at step k
@@ -55,10 +61,11 @@ def nstep_returns(
         in_range = (t_idx + k < T).astype(rewards.dtype)
         r_k = jnp.roll(rewards, -k)
         d_k = jnp.roll(dones, -k)
+        stop_k = jnp.clip(d_k + jnp.roll(truncations, -k), 0.0, 1.0)
         take = cont * in_range
         returns = returns + take * (gamma**k) * r_k
         m = m + take
         not_term = not_term * (1.0 - take * d_k)
-        cont = take * (1.0 - d_k)
+        cont = take * (1.0 - stop_k)
     boot_discounts = not_term * gamma**m
     return returns, boot_discounts, m.astype(jnp.int32)

@@ -1,0 +1,93 @@
+"""Pallas TPU kernel for the categorical Bellman projection.
+
+Same math as :func:`d4pg_tpu.ops.categorical_projection` (cites reference
+``ddpg.py:122-185``), but as a hand-written VMEM-resident kernel using the
+gather ("hat function") identity instead of a scatter:
+
+    m[b, i] = Σ_j p[b, j] · max(0, 1 − |bfrac[b, j] − i|)
+
+where ``bfrac`` is the fractional atom index of the Bellman-mapped source
+atom. The linear split onto floor/ceil neighbors (including the l == u
+fixup) is exactly the triangular hat evaluated at integer dst atoms, so no
+scatter/one-hot materialization is needed: the kernel is A source-atom
+passes of [TB, A] VPU work per batch tile, everything staged in VMEM once.
+
+The XLA path materializes a [B, A, A] one-hot weight tensor in HBM; this
+kernel's working set is O(TB·A), which matters once A grows (pixel-control
+C51 variants use 101+ atoms).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from d4pg_tpu.ops.categorical import CategoricalSupport
+
+_TILE_B = 128
+
+
+def _projection_kernel(num_atoms, v_min, v_max, p_ref, r_ref, d_ref, out_ref):
+    delta = (v_max - v_min) / (num_atoms - 1)
+    # z for source atoms as a [1, A] row (TPU iota must be integer-typed)
+    col = jax.lax.broadcasted_iota(jnp.int32, (1, num_atoms), dimension=1).astype(
+        jnp.float32
+    )
+    z = v_min + col * delta
+    tz = jnp.clip(r_ref[:] + d_ref[:] * z, v_min, v_max)  # [TB, A]
+    bfrac = (tz - v_min) / delta                           # [TB, A]
+    p = p_ref[:]
+    acc = jnp.zeros_like(p)
+    # dst-atom index row [1, A]
+    dst = col
+    for j in range(num_atoms):
+        # contribution of source atom j to every dst atom (hat function)
+        w = jnp.maximum(0.0, 1.0 - jnp.abs(bfrac[:, j : j + 1] - dst))  # [TB, A]
+        acc = acc + p[:, j : j + 1] * w
+    out_ref[:] = acc
+
+
+@functools.partial(jax.jit, static_argnums=(0, 4))
+def categorical_projection_pallas(
+    support: CategoricalSupport,
+    target_probs: jax.Array,
+    rewards: jax.Array,
+    discounts: jax.Array,
+    interpret: bool = False,
+) -> jax.Array:
+    """Drop-in replacement for :func:`categorical_projection` on TPU.
+
+    ``interpret=True`` runs the kernel in the Pallas interpreter (for CPU
+    tests). Batch is padded to the 128-row tile internally.
+    """
+    B, A = target_probs.shape
+    padded = pl.cdiv(B, _TILE_B) * _TILE_B
+    if padded != B:
+        pad = padded - B
+        target_probs = jnp.pad(target_probs, ((0, pad), (0, 0)))
+        rewards = jnp.pad(rewards, (0, pad))
+        discounts = jnp.pad(discounts, (0, pad))
+    r2 = rewards[:, None].astype(jnp.float32)
+    d2 = discounts[:, None].astype(jnp.float32)
+    kernel = functools.partial(
+        _projection_kernel, A, support.v_min, support.v_max
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((padded, A), jnp.float32),
+        grid=(padded // _TILE_B,),
+        in_specs=[
+            pl.BlockSpec((_TILE_B, A), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_TILE_B, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_TILE_B, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (_TILE_B, A), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        interpret=interpret,
+    )(target_probs.astype(jnp.float32), r2, d2)
+    return out[:B]

@@ -1,0 +1,136 @@
+"""ctypes bindings for the native C++ segment trees (``native/sumtree.cpp``).
+
+Compiled on first use with g++ into a repo-local build dir (pybind11 is not
+available in the image; the C ABI + ctypes keeps the binding dependency-free).
+API-compatible with :class:`d4pg_tpu.replay.SumTree` / ``MinTree`` so
+:class:`~d4pg_tpu.replay.PrioritizedReplayBuffer` swaps backends via its
+``tree_backend`` argument ("auto" prefers native, falls back to NumPy).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_LIB = None
+_LIB_LOCK = threading.Lock()
+
+
+def _source_path() -> str:
+    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(here, "native", "sumtree.cpp")
+
+
+def _build_dir() -> str:
+    d = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_native_build")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def load_library() -> ctypes.CDLL:
+    """Compile (if stale) and load the shared library. Raises on any failure;
+    callers with ``tree_backend='auto'`` catch and fall back to NumPy."""
+    global _LIB
+    with _LIB_LOCK:
+        if _LIB is not None:
+            return _LIB
+        src = _source_path()
+        so = os.path.join(_build_dir(), "libsumtree.so")
+        # <= so a fresh checkout (equal mtimes) rebuilds rather than loading
+        # a foreign binary; no -march=native for the same reason (the build
+        # dir is gitignored, but belt and braces).
+        if not os.path.exists(so) or os.path.getmtime(so) <= os.path.getmtime(src):
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-o", so, src],
+                check=True,
+                capture_output=True,
+            )
+        lib = ctypes.CDLL(so)
+        lib.st_create.restype = ctypes.c_void_p
+        lib.st_create.argtypes = [ctypes.c_int64, ctypes.c_int]
+        lib.st_destroy.argtypes = [ctypes.c_void_p]
+        lib.st_capacity.restype = ctypes.c_int64
+        lib.st_capacity.argtypes = [ctypes.c_void_p]
+        lib.st_set.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int64,
+        ]
+        lib.st_get.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int64,
+        ]
+        lib.st_root.restype = ctypes.c_double
+        lib.st_root.argtypes = [ctypes.c_void_p]
+        lib.st_find_prefix.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+        ]
+        _LIB = lib
+        return _LIB
+
+
+def _i64(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _f64(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+class _NativeTreeBase:
+    def __init__(self, capacity: int, is_min: bool):
+        self._lib = load_library()
+        self._h = self._lib.st_create(capacity, 1 if is_min else 0)
+        self.capacity = self._lib.st_capacity(self._h)
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.st_destroy(self._h)
+            self._h = None
+
+    def set(self, indices, values) -> None:
+        idx = np.ascontiguousarray(np.atleast_1d(indices), np.int64)
+        vals = np.ascontiguousarray(np.atleast_1d(values), np.float64)
+        self._lib.st_set(self._h, _i64(idx), _f64(vals), idx.size)
+
+    def get(self, indices) -> np.ndarray:
+        idx = np.ascontiguousarray(np.atleast_1d(indices), np.int64)
+        out = np.empty(idx.size, np.float64)
+        self._lib.st_get(self._h, _i64(idx), _f64(out), idx.size)
+        return out
+
+    @property
+    def root(self) -> float:
+        return self._lib.st_root(self._h)
+
+
+class NativeSumTree(_NativeTreeBase):
+    def __init__(self, capacity: int):
+        super().__init__(capacity, is_min=False)
+
+    def sum(self) -> float:
+        return self.root
+
+    def find_prefixsum_idx(self, prefixes) -> np.ndarray:
+        p = np.ascontiguousarray(np.atleast_1d(prefixes), np.float64)
+        out = np.empty(p.size, np.int64)
+        self._lib.st_find_prefix(self._h, _f64(p), _i64(out), p.size)
+        return out
+
+
+class NativeMinTree(_NativeTreeBase):
+    def __init__(self, capacity: int):
+        super().__init__(capacity, is_min=True)
+
+    def min(self) -> float:
+        return self.root

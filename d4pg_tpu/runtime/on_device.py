@@ -1,0 +1,177 @@
+"""Fully on-device training: rollout + replay + learn as ONE XLA program.
+
+BASELINE.json config 5 ("Brax on-device envs: rollout + learn both on TPU,
+end-to-end jit"). Where the reference round-trips host↔framework on every
+single transition and train step (``utils.py:7-10``, ``ddpg.py:214``), here
+one jitted ``train_iteration``:
+
+  1. rolls a [num_envs, segment_len] exploration segment with ``lax.scan``
+     (auto-reset, noise-state threading),
+  2. collapses it to n-step transitions with truncation-exact windows
+     (:func:`d4pg_tpu.ops.nstep_returns`, vmapped over envs),
+  3. appends them to a device-resident uniform ring buffer
+     (``lax.dynamic_update_slice`` — static shapes, no host),
+  4. runs K train steps on uniform samples (``lax.scan`` over
+     :func:`d4pg_tpu.agent.train_step`).
+
+The host only orchestrates iteration counts and reads metrics. Uniform
+replay only — prioritized sampling needs the host trees (sequential tree
+descent is hostile to SIMD; PER stays a host capability, SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from d4pg_tpu.agent import TrainState
+from d4pg_tpu.agent.d4pg import fused_train_scan, gather_batches, make_noise
+from d4pg_tpu.agent.state import D4PGConfig
+from d4pg_tpu.envs.rollout import rollout
+from d4pg_tpu.ops import nstep_returns
+
+
+class DeviceReplay(NamedTuple):
+    """Device-resident uniform ring buffer (columnar, static shapes)."""
+
+    obs: jax.Array        # [C, O]
+    action: jax.Array     # [C, A]
+    reward: jax.Array     # [C]
+    next_obs: jax.Array   # [C, O]
+    discount: jax.Array   # [C]
+    pos: jax.Array        # scalar int32 — next write slot
+    size: jax.Array       # scalar int32 — filled entries
+
+
+def device_replay_init(capacity: int, obs_dim: int, action_dim: int) -> DeviceReplay:
+    return DeviceReplay(
+        obs=jnp.zeros((capacity, obs_dim), jnp.float32),
+        action=jnp.zeros((capacity, action_dim), jnp.float32),
+        reward=jnp.zeros((capacity,), jnp.float32),
+        next_obs=jnp.zeros((capacity, obs_dim), jnp.float32),
+        discount=jnp.zeros((capacity,), jnp.float32),
+        pos=jnp.zeros((), jnp.int32),
+        size=jnp.zeros((), jnp.int32),
+    )
+
+
+def _append(replay: DeviceReplay, batch: dict, count: int) -> DeviceReplay:
+    """Write ``count`` rows at the ring position. Requires capacity % count
+    == 0 so a write never wraps mid-block (enforced by the factory)."""
+    p = replay.pos
+    return DeviceReplay(
+        obs=jax.lax.dynamic_update_slice(replay.obs, batch["obs"], (p, 0)),
+        action=jax.lax.dynamic_update_slice(replay.action, batch["action"], (p, 0)),
+        reward=jax.lax.dynamic_update_slice(replay.reward, batch["reward"], (p,)),
+        next_obs=jax.lax.dynamic_update_slice(
+            replay.next_obs, batch["next_obs"], (p, 0)
+        ),
+        discount=jax.lax.dynamic_update_slice(
+            replay.discount, batch["discount"], (p,)
+        ),
+        pos=(p + count) % replay.obs.shape[0],
+        size=jnp.minimum(replay.size + count, replay.obs.shape[0]),
+    )
+
+
+def make_on_device_trainer(
+    config: D4PGConfig,
+    env,
+    num_envs: int = 64,
+    segment_len: int = 32,
+    replay_capacity: int = 131_072,
+    batch_size: int = 256,
+    train_steps_per_iter: int = 32,
+):
+    """Build (init_fn, iterate_fn) for the fully-jitted loop.
+
+    ``init_fn(state, key) -> carry``; ``iterate_fn(carry) -> (carry,
+    metrics)`` where one call = num_envs×segment_len env steps +
+    train_steps_per_iter grad steps, entirely on device.
+    """
+    n_new = num_envs * segment_len
+    if replay_capacity % n_new != 0:
+        raise ValueError(
+            f"replay_capacity ({replay_capacity}) must be a multiple of "
+            f"num_envs*segment_len ({n_new})"
+        )
+    noise_init, noise_sample, noise_reset = make_noise(config)
+
+    def init_fn(state: TrainState, key: jax.Array):
+        k_reset, k_carry = jax.random.split(key)
+        reset_keys = jax.random.split(k_reset, num_envs)
+        env_states, obs = jax.vmap(env.reset)(reset_keys)
+        noise_states = jax.vmap(lambda _: noise_init())(jnp.arange(num_envs))
+        replay = device_replay_init(
+            replay_capacity, config.obs_dim, config.action_dim
+        )
+        return (state, env_states, obs, noise_states, replay, k_carry)
+
+    @jax.jit
+    def iterate_fn(carry):
+        state, env_states, obs, noise_states, replay, key = carry
+        key, k_roll, k_train = jax.random.split(key, 3)
+
+        # ---- 1. vmapped exploration rollout --------------------------------
+        def policy(o, k, nstate):
+            from d4pg_tpu.agent import act_deterministic
+
+            a = act_deterministic(config, state.actor_params, o[None])[0]
+            n, nstate = noise_sample(nstate, k, a.shape)
+            return jnp.clip(a + n, -1.0, 1.0), nstate
+
+        def one(env_state, o, nstate, k):
+            return rollout(
+                env, policy, k, segment_len,
+                init_state=env_state, init_obs=o,
+                policy_state=nstate, policy_state_reset=noise_reset,
+            )
+
+        keys = jax.random.split(k_roll, num_envs)
+        env_states, obs, noise_states, traj = jax.vmap(one)(
+            env_states, obs, noise_states, keys
+        )
+
+        # ---- 2. n-step collapse (per env row) ------------------------------
+        def collapse(rew, term, trunc, tr_obs, tr_act, tr_next):
+            rets, boots, offs = nstep_returns(
+                rew, term, config.gamma, config.n_step, truncations=trunc
+            )
+            # bootstrap state s_{t+m} is next_obs[t + m - 1]
+            idx = jnp.clip(jnp.arange(rew.shape[0]) + offs - 1, 0, rew.shape[0] - 1)
+            return {
+                "obs": tr_obs,
+                "action": tr_act,
+                "reward": rets,
+                "next_obs": tr_next[idx],
+                "discount": boots,
+            }
+
+        flat = jax.vmap(collapse)(
+            traj.reward, traj.terminated, traj.truncated,
+            traj.obs, traj.action, traj.next_obs,
+        )
+        flat = jax.tree_util.tree_map(
+            lambda x: x.reshape((n_new,) + x.shape[2:]), flat
+        )
+
+        # ---- 3. ring append ------------------------------------------------
+        replay = _append(replay, flat, n_new)
+
+        # ---- 4. K train steps on uniform samples ---------------------------
+        idx = jax.random.randint(
+            k_train, (train_steps_per_iter, batch_size), 0, replay.size
+        )
+        state, metrics = fused_train_scan(
+            config, state, gather_batches(replay, idx)
+        )
+        metrics = jax.tree_util.tree_map(jnp.mean, metrics)
+        metrics["episode_return_proxy"] = jnp.sum(traj.reward) / jnp.maximum(
+            jnp.sum(jnp.maximum(traj.terminated, traj.truncated)), 1.0
+        )
+        return (state, env_states, obs, noise_states, replay, key), metrics
+
+    return init_fn, iterate_fn

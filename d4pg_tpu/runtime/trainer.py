@@ -47,6 +47,7 @@ from d4pg_tpu.replay import (
 from d4pg_tpu.runtime.checkpoint import CheckpointManager
 from d4pg_tpu.runtime.evaluator import evaluate
 from d4pg_tpu.runtime.metrics import MetricsLogger
+from d4pg_tpu.utils.profiling import annotate
 
 
 def _env_dims(env) -> tuple[int, int]:
@@ -406,8 +407,15 @@ class Trainer:
         pending = None  # (indices, priorities future) — one-step pipeline lag
         last = {}
         collect_budget = 0.0
+        tracing = False
 
         while grad_steps_done < total:
+            if cfg.profile_dir and grad_steps_done == 10 and not tracing:
+                jax.profiler.start_trace(cfg.profile_dir)
+                tracing = True
+            if tracing and grad_steps_done == 60:
+                jax.profiler.stop_trace()
+                tracing = False
             # interleave collection to hold the env:train ratio
             collect_budget += cfg.env_steps_per_train_step
             if cfg.her:
@@ -426,15 +434,20 @@ class Trainer:
                     self._host_collect_steps(n)
                     collect_budget -= n
 
-            batch = self._sample()
+            with annotate("host/sample"):
+                batch = self._sample()
             indices = batch.pop("indices", None)
             dev_batch = {k: jnp.asarray(v) for k, v in batch.items()}
             # dispatch is async: the TPU runs while we write back the
             # PREVIOUS step's priorities and sample the next batch
-            self.state, metrics, priorities = self._train_step(self.state, dev_batch)
+            with annotate("host/dispatch"):
+                self.state, metrics, priorities = self._train_step(
+                    self.state, dev_batch
+                )
             if pending is not None and self.config.prioritized:
                 prev_idx, prev_pri = pending
-                self.buffer.update_priorities(prev_idx, np.asarray(prev_pri))
+                with annotate("host/priority_writeback"):
+                    self.buffer.update_priorities(prev_idx, np.asarray(prev_pri))
             pending = (indices, priorities)
             grad_steps_done += 1
             self.grad_steps += 1
@@ -444,6 +457,8 @@ class Trainer:
                 last = self._periodic(step, metrics, t_start, grad_steps_done)
             if step % cfg.checkpoint_interval == 0 or step == total:
                 self.ckpt.save(self.grad_steps, self.state)
+        if tracing:
+            jax.profiler.stop_trace()
         if pending is not None and self.config.prioritized:
             self.buffer.update_priorities(pending[0], np.asarray(pending[1]))
         self.ckpt.wait()

@@ -1,0 +1,5 @@
+"""Shared utilities: profiling, tree helpers."""
+
+from d4pg_tpu.utils.profiling import annotate, profile_trace
+
+__all__ = ["annotate", "profile_trace"]

@@ -1,0 +1,37 @@
+"""Profiling: XLA trace capture + named annotations.
+
+The reference's only timing is wall-clock deltas in train logs
+(``main.py:250,359``; SURVEY.md §5 'tracing/profiling'). Here:
+
+- :func:`profile_trace` captures a TensorBoard-viewable XLA trace (HLO
+  timelines, per-op device time) for a bounded window;
+- :func:`annotate` tags host-side phases (sample/dispatch/priority-writeback)
+  so host stalls show up next to device ops in the trace viewer.
+
+Throughput counters (grad-steps/sec, env-steps/sec, replay occupancy) are
+emitted continuously by :class:`d4pg_tpu.runtime.MetricsLogger`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str | None):
+    """Capture a jax.profiler trace into ``log_dir`` (no-op when None)."""
+    if not log_dir:
+        yield
+        return
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region that appears on the host timeline of the trace."""
+    return jax.profiler.TraceAnnotation(name)

@@ -1,0 +1,94 @@
+// Native segment trees for prioritized replay.
+//
+// The host-side PER trees are the one part of the data path that must keep
+// up with a TPU-speed learner from plain CPU code (SURVEY.md §7 hard part
+// (b): the reference's pointer-chasing Python trees,
+// prioritized_replay_memory.py:61-112, top out far below learner rate).
+// Layout matches d4pg_tpu/replay/segment_tree.py: tree[1] is the root,
+// leaves at [capacity, 2*capacity). Batched ops are scalar loops here —
+// O(log C) per element with no interpreter overhead, which beats the
+// vectorized-NumPy level passes at typical batch sizes (256) and large
+// capacities (1e6).
+//
+// Exposed via a C ABI for ctypes (no pybind11 in the image).
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <limits>
+#include <vector>
+
+namespace {
+
+struct Tree {
+  int64_t capacity;       // power of two
+  bool is_min;
+  std::vector<double> v;  // size 2*capacity
+
+  double combine(double a, double b) const {
+    return is_min ? std::min(a, b) : a + b;
+  }
+};
+
+int64_t next_pow2(int64_t n) {
+  int64_t p = 1;
+  while (p < n) p <<= 1;
+  return p;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* st_create(int64_t capacity, int is_min) {
+  Tree* t = new Tree();
+  t->capacity = next_pow2(capacity);
+  t->is_min = is_min != 0;
+  double neutral = is_min ? std::numeric_limits<double>::infinity() : 0.0;
+  t->v.assign(2 * t->capacity, neutral);
+  return t;
+}
+
+void st_destroy(void* h) { delete static_cast<Tree*>(h); }
+
+int64_t st_capacity(void* h) { return static_cast<Tree*>(h)->capacity; }
+
+void st_set(void* h, const int64_t* idx, const double* vals, int64_t n) {
+  Tree* t = static_cast<Tree*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t pos = idx[i] + t->capacity;
+    t->v[pos] = vals[i];
+    for (pos >>= 1; pos >= 1; pos >>= 1) {
+      t->v[pos] = t->combine(t->v[2 * pos], t->v[2 * pos + 1]);
+    }
+  }
+}
+
+void st_get(void* h, const int64_t* idx, double* out, int64_t n) {
+  Tree* t = static_cast<Tree*>(h);
+  for (int64_t i = 0; i < n; ++i) out[i] = t->v[idx[i] + t->capacity];
+}
+
+double st_root(void* h) { return static_cast<Tree*>(h)->v[1]; }
+
+// Batched proportional-sampling descent; boundary convention matches the
+// NumPy tree (prefix == left-subtree mass goes right, skipping zero leaves).
+void st_find_prefix(void* h, const double* prefixes, int64_t* out, int64_t n) {
+  Tree* t = static_cast<Tree*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    double p = prefixes[i];
+    int64_t pos = 1;
+    while (pos < t->capacity) {
+      double left = t->v[2 * pos];
+      if (p >= left) {
+        p -= left;
+        pos = 2 * pos + 1;
+      } else {
+        pos = 2 * pos;
+      }
+    }
+    out[i] = pos - t->capacity;
+  }
+}
+
+}  // extern "C"

@@ -57,6 +57,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--critic-head", choices=["categorical", "scalar", "mixture_gaussian"],
                    default="categorical")
     p.add_argument("--compute-dtype", choices=["float32", "bfloat16"], default="float32")
+    p.add_argument("--projection", choices=["xla", "pallas"], default="xla",
+                   help="categorical projection backend (pallas = custom TPU kernel)")
     p.add_argument("--total-steps", type=int, default=100_000,
                    help="learner grad steps to run")
     p.add_argument("--eval-interval", type=int, default=2_000)
@@ -67,6 +69,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lr-critic", type=float, default=1e-4)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--tree-backend", choices=["auto", "numpy", "native"], default="auto")
+    p.add_argument("--profile-dir", default=None,
+                   help="capture a jax.profiler trace of grad steps 10-60 here")
     return p
 
 
@@ -91,6 +95,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         ou_mu=args.ou_mu,
         prioritized=args.prioritized,
         compute_dtype=args.compute_dtype,
+        projection_backend=args.projection,
     )
     # run-identity log dir (reference main.py:59-66)
     log_dir = args.log_dir or (
@@ -115,6 +120,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         log_dir=log_dir,
         checkpoint_interval=args.checkpoint_interval,
         resume=args.resume,
+        profile_dir=args.profile_dir,
         dp=args.dp,
         tp=args.tp,
         agent=agent,
