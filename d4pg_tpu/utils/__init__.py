@@ -1,5 +1,22 @@
-"""Shared utilities: profiling, tree helpers."""
+"""Shared utilities: profiling, offline plotting/run analysis."""
 
 from d4pg_tpu.utils.profiling import annotate, profile_trace
 
-__all__ = ["annotate", "profile_trace"]
+__all__ = [
+    "annotate",
+    "profile_trace",
+    "compare_runs",
+    "ewma",
+    "load_run",
+    "plot_run",
+]
+
+
+def __getattr__(name):
+    # Lazy: keeps `python -m d4pg_tpu.utils.plotting` clean and the training
+    # path free of any matplotlib-adjacent imports.
+    if name in ("compare_runs", "ewma", "load_run", "plot_run"):
+        from d4pg_tpu.utils import plotting
+
+        return getattr(plotting, name)
+    raise AttributeError(name)

@@ -1,0 +1,180 @@
+"""Offline plotting & run analysis (SURVEY.md §2 component #22).
+
+Covers both of the reference's offline tools:
+
+- ``plots/plots.py``: EWMA-smoothed score-vs-steps curves rendered to PNG.
+  Its ``numpy_ewma_vectorized_v2`` (``plots/plots.py:6-21``) computes the
+  smoothing with explicit powers ``(1-α)^n``, which underflows/overflows for
+  long runs; :func:`ewma` here is the same recurrence computed stably in
+  O(n) without forming large powers, and is unit-tested against the naive
+  loop oracle.
+- ``plotUtil.ipynb``'s ``Logger`` class: a multi-run store with
+  reward-vs-steps and reward-vs-wall-clock comparison plots. Here runs are
+  not pickles but the ``metrics.jsonl`` files every training run already
+  writes (``d4pg_tpu/runtime/metrics.py``), so analysis needs no separate
+  logging path — :func:`load_run` reads any run directory, and
+  :func:`compare_runs` overlays any scalar across runs against steps or
+  time.
+
+matplotlib is imported lazily so the training path never depends on it.
+
+CLI::
+
+    python -m d4pg_tpu.utils.plotting runs/* --metric avg_test_reward \
+        --x step --smooth 20 --out compare.png
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Mapping, Optional, Sequence
+
+import numpy as np
+
+
+def ewma(data: np.ndarray, window: int) -> np.ndarray:
+    """Exponentially-weighted moving average with span ``window``.
+
+    Same semantics as the reference's vectorized EWMA (α = 2/(window+1),
+    seeded at ``data[0]``) but computed via the stable recurrence
+    ``y[t] = (1-α)·y[t-1] + α·x[t]`` instead of explicit ``(1-α)^n`` powers,
+    so it neither under- nor over-flows for runs of any length.
+    """
+    data = np.asarray(data, np.float64)
+    if data.ndim != 1:
+        raise ValueError(f"ewma expects 1-D data, got shape {data.shape}")
+    if window < 1:
+        raise ValueError(f"ewma window must be >= 1, got {window}")
+    if data.size == 0:
+        return data.copy()
+    alpha = 2.0 / (window + 1.0)
+    out = np.empty_like(data)
+    out[0] = data[0]
+    for t in range(1, data.size):
+        out[t] = (1.0 - alpha) * out[t - 1] + alpha * data[t]
+    return out
+
+
+def load_run(log_dir: str) -> Dict[str, np.ndarray]:
+    """Load one run's ``metrics.jsonl`` into column arrays.
+
+    Rows may have heterogeneous keys (train-step rows vs eval rows); each
+    scalar becomes a pair of arrays: ``<name>`` (values) and ``<name>/step``
+    / ``<name>/t`` (the step counter / wall-clock second it was logged at).
+    """
+    path = os.path.join(log_dir, "metrics.jsonl")
+    rows: List[Mapping[str, float]] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+    columns: Dict[str, List[float]] = {}
+    for row in rows:
+        step = row.get("step", 0)
+        t = row.get("t", 0.0)
+        for key, value in row.items():
+            if key in ("step", "t"):
+                continue
+            columns.setdefault(key, []).append(float(value))
+            columns.setdefault(f"{key}/step", []).append(float(step))
+            columns.setdefault(f"{key}/t", []).append(float(t))
+    return {k: np.asarray(v) for k, v in columns.items()}
+
+
+def available_metrics(run: Mapping[str, np.ndarray]) -> List[str]:
+    return sorted(k for k in run if "/" not in k)
+
+
+def plot_run(
+    log_dir: str,
+    metric: str = "eval_return_mean",
+    x: str = "step",
+    smooth: int = 20,
+    out: Optional[str] = None,
+    title: Optional[str] = None,
+):
+    """Single-run score curve (the ``plots/plots.py`` capability)."""
+    return compare_runs([log_dir], metric=metric, x=x, smooth=smooth, out=out,
+                        title=title)
+
+
+def compare_runs(
+    log_dirs: Sequence[str],
+    metric: str = "eval_return_mean",
+    x: str = "step",
+    smooth: int = 20,
+    out: Optional[str] = None,
+    title: Optional[str] = None,
+    labels: Optional[Sequence[str]] = None,
+):
+    """Overlay ``metric`` across runs against ``x`` ("step" or "t").
+
+    The multi-run comparison the notebook ``Logger`` provided
+    (reward vs steps / reward vs time), over ``metrics.jsonl`` run dirs.
+    ``eval_return_mean`` is the raw per-eval score (smooth it here); the
+    trainer also logs ``avg_test_reward_ewma``, already smoothed — pass
+    ``smooth=0`` for that one. Returns the matplotlib figure; saves a PNG
+    when ``out`` is given.
+    """
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    if x not in ("step", "t"):
+        raise ValueError(f"x must be 'step' or 't', got {x!r}")
+    if labels is not None and len(labels) != len(log_dirs):
+        raise ValueError(f"{len(labels)} labels for {len(log_dirs)} run dirs")
+    labels = list(labels) if labels is not None else [
+        os.path.basename(os.path.normpath(d)) for d in log_dirs
+    ]
+    fig, ax = plt.subplots(figsize=(8, 5))
+    plotted = 0
+    for log_dir, label in zip(log_dirs, labels):
+        try:
+            run = load_run(log_dir)
+        except (FileNotFoundError, NotADirectoryError):
+            print(f"[plotting] {log_dir}: no metrics.jsonl, skipped")
+            continue
+        if metric not in run:
+            print(f"[plotting] {log_dir}: no metric {metric!r} "
+                  f"(has {available_metrics(run)})")
+            continue
+        ys = run[metric]
+        xs = run[f"{metric}/{x}"]
+        if smooth and ys.size > 2:
+            ys = ewma(ys, smooth)
+        ax.plot(xs, ys, label=label)
+        plotted += 1
+    ax.set_xlabel("grad steps" if x == "step" else "wall-clock (s)")
+    ax.set_ylabel(metric)
+    ax.set_title(title or f"{metric} vs {'steps' if x == 'step' else 'time'}")
+    if plotted > 1:
+        ax.legend(fontsize=8)
+    ax.grid(True, alpha=0.3)
+    fig.tight_layout()
+    if out:
+        fig.savefig(out, dpi=120)
+        print(f"[plotting] wrote {out}")
+    return fig
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    import argparse
+
+    p = argparse.ArgumentParser(description="Plot/compare d4pg_tpu runs")
+    p.add_argument("log_dirs", nargs="+", help="run directories (metrics.jsonl inside)")
+    p.add_argument("--metric", default="eval_return_mean")
+    p.add_argument("--x", choices=["step", "t"], default="step")
+    p.add_argument("--smooth", type=int, default=20)
+    p.add_argument("--out", default="compare.png")
+    p.add_argument("--title", default=None)
+    args = p.parse_args(argv)
+    compare_runs(args.log_dirs, metric=args.metric, x=args.x,
+                 smooth=args.smooth, out=args.out, title=args.title)
+
+
+if __name__ == "__main__":
+    main()

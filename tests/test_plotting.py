@@ -1,0 +1,114 @@
+"""Offline plotting tools (SURVEY.md §2 #22: plots/plots.py + notebook Logger)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from d4pg_tpu.utils.plotting import available_metrics, compare_runs, ewma, load_run
+
+
+def ewma_oracle(data, window):
+    # direct recurrence, the semantics plots/plots.py:6-21 computes
+    alpha = 2.0 / (window + 1.0)
+    out = np.empty(len(data))
+    out[0] = data[0]
+    for t in range(1, len(data)):
+        out[t] = (1 - alpha) * out[t - 1] + alpha * data[t]
+    return out
+
+
+class TestEwma:
+    def test_matches_oracle(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=500)
+        np.testing.assert_allclose(ewma(x, 20), ewma_oracle(x, 20), rtol=1e-12)
+
+    def test_long_run_stable(self):
+        # the reference's pow-based version underflows (1-α)^n for n ≫ 1/α;
+        # ours must stay finite and track the signal on 100k points
+        x = np.ones(100_000) * 5.0
+        y = ewma(x, 10)
+        assert np.all(np.isfinite(y))
+        np.testing.assert_allclose(y, 5.0)
+
+    def test_edge_cases(self):
+        assert ewma(np.array([]), 5).size == 0
+        np.testing.assert_allclose(ewma(np.array([3.0]), 5), [3.0])
+        with pytest.raises(ValueError):
+            ewma(np.zeros((3, 3)), 5)
+        with pytest.raises(ValueError):
+            ewma(np.zeros(4), 0)
+
+
+@pytest.fixture
+def run_dir(tmp_path):
+    d = tmp_path / "run_a"
+    d.mkdir()
+    with open(d / "metrics.jsonl", "w") as f:
+        for i in range(10):
+            f.write(json.dumps({"step": i * 100, "t": i * 1.5,
+                                "critic_loss": 1.0 / (i + 1)}) + "\n")
+            if i % 2 == 0:
+                f.write(json.dumps({"step": i * 100, "t": i * 1.5 + 0.1,
+                                    "avg_test_reward": -200.0 + 10 * i}) + "\n")
+    return str(d)
+
+
+class TestLoadRun:
+    def test_columns_and_axes(self, run_dir):
+        run = load_run(run_dir)
+        assert set(available_metrics(run)) == {"critic_loss", "avg_test_reward"}
+        assert run["critic_loss"].shape == (10,)
+        # eval rows are sparser and keep their own x-axes
+        assert run["avg_test_reward"].shape == (5,)
+        np.testing.assert_allclose(run["avg_test_reward/step"],
+                                   [0, 200, 400, 600, 800])
+        assert run["avg_test_reward/t"][0] == pytest.approx(0.1)
+
+    def test_logger_roundtrip(self, tmp_path):
+        # what MetricsLogger writes, load_run reads
+        from d4pg_tpu.runtime.metrics import MetricsLogger
+
+        d = str(tmp_path / "rt")
+        logger = MetricsLogger(d, use_tensorboard=False)
+        logger.log(1, {"a": 1.0})
+        logger.log(2, {"a": 2.0, "b": 0.5})
+        logger.close()
+        run = load_run(d)
+        np.testing.assert_allclose(run["a"], [1.0, 2.0])
+        np.testing.assert_allclose(run["b/step"], [2.0])
+
+
+class TestComparePlots:
+    def test_png_written(self, run_dir, tmp_path):
+        out = str(tmp_path / "curve.png")
+        fig = compare_runs([run_dir], metric="avg_test_reward", smooth=3, out=out)
+        assert os.path.exists(out) and os.path.getsize(out) > 0
+        import matplotlib.pyplot as plt
+
+        plt.close(fig)
+
+    def test_time_axis_and_missing_metric(self, run_dir, tmp_path):
+        out = str(tmp_path / "t.png")
+        # one run missing the metric, one dir with no metrics.jsonl at all:
+        # both skipped without raising; file still produced
+        empty = tmp_path / "empty_run"
+        empty.mkdir()
+        fig = compare_runs([run_dir, str(empty)], metric="nope", x="t", out=out)
+        assert os.path.exists(out)
+        import matplotlib.pyplot as plt
+
+        plt.close(fig)
+
+    def test_label_mismatch_raises(self, run_dir):
+        with pytest.raises(ValueError):
+            compare_runs([run_dir, run_dir], labels=["only-one"])
+
+    def test_cli(self, run_dir, tmp_path):
+        from d4pg_tpu.utils.plotting import main
+
+        out = str(tmp_path / "cli.png")
+        main([run_dir, "--metric", "critic_loss", "--out", out, "--smooth", "0"])
+        assert os.path.exists(out)
