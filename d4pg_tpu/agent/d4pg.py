@@ -44,15 +44,20 @@ def _dtype(config: D4PGConfig):
 
 
 def build_networks(config: D4PGConfig) -> tuple[Actor, Critic]:
+    pixel_shape = tuple(config.pixel_shape) if config.pixel_shape else None
     actor = Actor(
         action_dim=config.action_dim,
         hidden_sizes=tuple(config.hidden_sizes),
         dtype=_dtype(config),
+        pixel_shape=pixel_shape,
+        encoder_embed_dim=config.encoder_embed_dim,
     )
     critic = Critic(
         dist=config.dist,
         hidden_sizes=tuple(config.hidden_sizes),
         dtype=_dtype(config),
+        pixel_shape=pixel_shape,
+        encoder_embed_dim=config.encoder_embed_dim,
     )
     return actor, critic
 

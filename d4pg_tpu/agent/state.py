@@ -32,6 +32,11 @@ class D4PGConfig:
     obs_dim: int = 3
     action_dim: int = 1
     hidden_sizes: tuple = (256, 256, 256)
+    # Pixel observations (BASELINE.json config 4): when set to (H, W, C),
+    # obs arrive flattened with obs_dim == H·W·C and both networks conv-encode
+    # them (d4pg_tpu/models/encoders.py) in front of the MLP trunk.
+    pixel_shape: tuple | None = None
+    encoder_embed_dim: int = 50
     dist: DistConfig = field(default_factory=DistConfig)
     gamma: float = 0.99
     n_step: int = 1

@@ -68,6 +68,13 @@ class TrainConfig:
 ENV_PRESETS = {
     "pendulum": dict(v_min=-300.0, v_max=0.0, obs_dim=3, action_dim=1, max_episode_steps=200),
     "pointmass_goal": dict(v_min=-50.0, v_max=0.0, obs_dim=6, action_dim=2, max_episode_steps=50),
+    # Pixel env: obs is a flattened 48×48×2 render. replay_capacity caps the
+    # default 1M ring — at 4608 bytes/obs (uint8-quantized storage) 100k
+    # transitions ≈ 0.9 GB host RAM; 1M would be ~9 GB.
+    "pixel_pendulum": dict(
+        v_min=-300.0, v_max=0.0, obs_dim=48 * 48 * 2, action_dim=1,
+        max_episode_steps=200, pixel_shape=(48, 48, 2), replay_capacity=100_000,
+    ),
     "Pendulum-v1": dict(v_min=-300.0, v_max=0.0, obs_dim=3, action_dim=1, max_episode_steps=200),
     "HalfCheetah-v4": dict(v_min=0.0, v_max=1000.0, obs_dim=17, action_dim=6, max_episode_steps=1000),
     "Humanoid-v4": dict(v_min=0.0, v_max=1000.0, obs_dim=348, action_dim=17, max_episode_steps=1000),
@@ -89,10 +96,18 @@ def apply_env_preset(config: TrainConfig) -> TrainConfig:
         dist=dist,
         n_step=config.n_step,
         prioritized=config.prioritized,
+        pixel_shape=preset.get("pixel_shape", config.agent.pixel_shape),
     )
     max_steps = (
         config.max_episode_steps
         if config.max_episode_steps is not None
         else preset["max_episode_steps"]
     )
-    return dataclasses.replace(config, agent=agent, max_episode_steps=max_steps)
+    replay_capacity = config.replay_capacity
+    default_capacity = TrainConfig.__dataclass_fields__["replay_capacity"].default
+    if replay_capacity == default_capacity and "replay_capacity" in preset:
+        replay_capacity = preset["replay_capacity"]
+    return dataclasses.replace(
+        config, agent=agent, max_episode_steps=max_steps,
+        replay_capacity=replay_capacity,
+    )

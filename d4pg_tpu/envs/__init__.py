@@ -12,6 +12,7 @@ The reference drives host gym/pybullet envs (``main.py:68``,
 
 from d4pg_tpu.envs.api import Env, EnvState
 from d4pg_tpu.envs.pendulum import Pendulum
+from d4pg_tpu.envs.pixel_pendulum import PixelPendulum
 from d4pg_tpu.envs.pointmass_goal import PointMassGoal
 from d4pg_tpu.envs.rollout import rollout
 from d4pg_tpu.envs.gym_adapter import GymAdapter, NormalizeAction, make_env
@@ -20,6 +21,7 @@ __all__ = [
     "Env",
     "EnvState",
     "Pendulum",
+    "PixelPendulum",
     "PointMassGoal",
     "rollout",
     "GymAdapter",

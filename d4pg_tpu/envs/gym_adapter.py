@@ -117,10 +117,13 @@ ENV_VALUE_RANGES = {
 def make_env(name: str, max_episode_steps: Optional[int] = None):
     """Build either a pure-JAX env (by short name) or a gymnasium adapter."""
     from d4pg_tpu.envs.pendulum import Pendulum
+    from d4pg_tpu.envs.pixel_pendulum import PixelPendulum
     from d4pg_tpu.envs.pointmass_goal import PointMassGoal
 
     if name == "pendulum":
         return Pendulum()
+    if name == "pixel_pendulum":
+        return PixelPendulum()
     if name == "pointmass_goal":
         return PointMassGoal()
     return GymAdapter(name, max_episode_steps)

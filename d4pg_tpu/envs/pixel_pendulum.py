@@ -1,0 +1,90 @@
+"""Pure-JAX pixel-observation Pendulum (BASELINE.json config 4).
+
+The reference has no pixel tasks; this is the "dm_control pixels → conv
+encoder" capability from ``BASELINE.json``. Same physics as
+:class:`d4pg_tpu.envs.Pendulum`, but the observation is a rendered image of
+the pendulum arm, produced **on device** by pure ``jnp`` math — no host
+renderer in the loop, so pixel rollouts still compile into one XLA program
+under ``lax.scan``/``vmap``.
+
+Rendering: the arm is a line segment from the image center at angle θ; pixel
+intensity is a smooth indicator of distance-to-segment (an anti-aliased
+stroke). Velocity is made observable the dm_control way — a second channel
+renders the arm at its *previous* position θ − θ̇·dt (a 2-frame stack folded
+into channels), keeping the observation Markovian without carrying frame
+history in the env state.
+
+Observations are emitted **flattened** ([H·W·C] float32 in [0, 1]) so the
+entire existing pipeline — replay rings, n-step writers, ``lax.scan``
+rollouts, device replay — handles pixels with zero changes (everything is a
+flat static-shape column). The networks reshape back to [H, W, C] in front
+of :class:`d4pg_tpu.models.PixelEncoder` (``Actor``/``Critic``
+``pixel_shape``).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from d4pg_tpu.envs.api import EnvState
+from d4pg_tpu.envs.pendulum import Pendulum
+
+
+def render_arm(
+    theta: jax.Array, size: int, arm_frac: float = 0.4, width_px: float = 1.2
+) -> jax.Array:
+    """Render one [size, size] frame of a pendulum arm at angle ``theta``.
+
+    θ = 0 is 'up' (gym convention). Smooth stroke: intensity
+    ``sigmoid((width − dist_to_segment)/aa)`` — differentiable, no dynamic
+    shapes, vmap/scan-friendly.
+    """
+    c = (size - 1) / 2.0
+    length = arm_frac * size
+    # Arm endpoint in pixel coords; rows grow downward so 'up' is −row.
+    ex = c + length * jnp.sin(theta)
+    ey = c - length * jnp.cos(theta)
+    rows = jnp.arange(size, dtype=jnp.float32)
+    cols = jnp.arange(size, dtype=jnp.float32)
+    py, px = jnp.meshgrid(rows, cols, indexing="ij")
+    # Distance from each pixel to the segment (center → endpoint).
+    dx, dy = ex - c, ey - c
+    seg_len_sq = dx * dx + dy * dy + 1e-8
+    t = jnp.clip(((px - c) * dx + (py - c) * dy) / seg_len_sq, 0.0, 1.0)
+    nearest_x = c + t * dx
+    nearest_y = c + t * dy
+    dist = jnp.sqrt((px - nearest_x) ** 2 + (py - nearest_y) ** 2)
+    return jax.nn.sigmoid((width_px - dist) / 0.5)
+
+
+class PixelPendulum:
+    """Pendulum with rendered-image observations, flattened to [H·W·2]."""
+
+    action_dim = 1
+    max_episode_steps = 200
+    v_min = -300.0
+    v_max = 0.0
+
+    def __init__(self, size: int = 48, **pendulum_kwargs):
+        self.size = size
+        self.pixel_shape = (size, size, 2)
+        self.observation_dim = size * size * 2
+        self._core = Pendulum(**pendulum_kwargs)
+        self.dt = self._core.dt
+
+    def _obs(self, physics: jax.Array) -> jax.Array:
+        theta, thetadot = physics[0], physics[1]
+        now = render_arm(theta, self.size)
+        prev = render_arm(theta - thetadot * self.dt, self.size)
+        return jnp.stack([now, prev], axis=-1).reshape(-1)
+
+    def reset(self, key: jax.Array) -> Tuple[EnvState, jax.Array]:
+        state, _ = self._core.reset(key)
+        return state, self._obs(state.physics)
+
+    def step(self, state: EnvState, action: jax.Array):
+        new_state, _, reward, terminated, truncated = self._core.step(state, action)
+        return new_state, self._obs(new_state.physics), reward, terminated, truncated

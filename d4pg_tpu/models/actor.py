@@ -11,23 +11,34 @@ Compute dtype is configurable (bfloat16 for TPU MXU); params stay float32.
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Optional, Sequence, Tuple
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from d4pg_tpu.models.encoders import PixelEncoder
 from d4pg_tpu.models.init import fanin_uniform
 
 
 class Actor(nn.Module):
+    """When ``pixel_shape`` is set, observations arrive flattened ([..., H·W·C]
+    — the pipeline-wide convention, see ``envs/pixel_pendulum.py``), are
+    reshaped back to [H, W, C] and passed through a conv encoder before the
+    MLP trunk."""
+
     action_dim: int
     hidden_sizes: Sequence[int] = (256, 256, 256)
     final_init_scale: float = 3e-3
     dtype: jnp.dtype = jnp.float32
+    pixel_shape: Optional[Tuple[int, int, int]] = None
+    encoder_embed_dim: int = 50
 
     @nn.compact
     def __call__(self, obs: jax.Array) -> jax.Array:
+        if self.pixel_shape is not None:
+            obs = obs.reshape(*obs.shape[:-1], *self.pixel_shape)
+            obs = PixelEncoder(embed_dim=self.encoder_embed_dim, dtype=self.dtype)(obs)
         x = obs.astype(self.dtype)
         for i, width in enumerate(self.hidden_sizes):
             x = nn.Dense(

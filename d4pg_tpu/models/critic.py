@@ -15,13 +15,14 @@ layer, action concatenated at the second layer (``models.py:80``), two more
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Optional, Sequence, Tuple
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 from flax.struct import dataclass as flax_dataclass
 
+from d4pg_tpu.models.encoders import PixelEncoder
 from d4pg_tpu.models.init import fanin_uniform
 
 
@@ -52,9 +53,16 @@ class Critic(nn.Module):
     hidden_sizes: Sequence[int] = (256, 256, 256)
     final_init_scale: float = 3e-4
     dtype: jnp.dtype = jnp.float32
+    # Flattened-pixel observations: reshape to [H, W, C] and conv-encode
+    # before the trunk (same convention as Actor).
+    pixel_shape: Optional[Tuple[int, int, int]] = None
+    encoder_embed_dim: int = 50
 
     @nn.compact
     def __call__(self, obs: jax.Array, action: jax.Array) -> jax.Array:
+        if self.pixel_shape is not None:
+            obs = obs.reshape(*obs.shape[:-1], *self.pixel_shape)
+            obs = PixelEncoder(embed_dim=self.encoder_embed_dim, dtype=self.dtype)(obs)
         x = obs.astype(self.dtype)
         x = nn.Dense(
             self.hidden_sizes[0],

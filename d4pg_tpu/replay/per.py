@@ -38,8 +38,9 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         beta_steps: int = 100_000,
         eps: float = 1e-6,
         tree_backend: str = "auto",
+        obs_dtype=np.float32,
     ):
-        super().__init__(capacity, obs_dim, action_dim)
+        super().__init__(capacity, obs_dim, action_dim, obs_dtype=obs_dtype)
         assert alpha >= 0
         self.alpha = alpha
         self.beta0 = beta0

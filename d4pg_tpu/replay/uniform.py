@@ -38,30 +38,52 @@ class ReplayBuffer:
     'race detection') are structurally removed.
     """
 
-    def __init__(self, capacity: int, obs_dim: int, action_dim: int):
+    def __init__(self, capacity: int, obs_dim: int, action_dim: int,
+                 obs_dtype=np.float32):
+        """``obs_dtype=np.uint8`` quantizes [0,1]-float observations to bytes
+        in storage (×255 on write, ÷255 on read) — 4× less host RAM for
+        pixel envs, the standard pixel-replay layout. Flat envs keep f32."""
         self.capacity = int(capacity)
-        self.obs = np.zeros((capacity, obs_dim), np.float32)
+        self.obs_dtype = np.dtype(obs_dtype)
+        self._quantized = self.obs_dtype == np.uint8
+        self.obs = np.zeros((capacity, obs_dim), self.obs_dtype)
         self.action = np.zeros((capacity, action_dim), np.float32)
         self.reward = np.zeros((capacity,), np.float32)
-        self.next_obs = np.zeros((capacity, obs_dim), np.float32)
+        self.next_obs = np.zeros((capacity, obs_dim), self.obs_dtype)
         self.discount = np.zeros((capacity,), np.float32)
         self._pos = 0
         self._size = 0
         self._lock = threading.Lock()
+
+    def _encode_obs(self, obs: np.ndarray) -> np.ndarray:
+        obs = np.atleast_2d(np.asarray(obs, np.float32))
+        if self._quantized:
+            # Accept either pixel convention — [0,1] floats (our on-device
+            # renderers) or [0,255] (byte-image envs); same max>2 heuristic
+            # as models/encoders.py. Decoded batches are always [0,1].
+            if obs.size and np.abs(obs).max() > 2.0:
+                return np.clip(np.rint(obs), 0.0, 255.0).astype(np.uint8)
+            return np.clip(np.rint(obs * 255.0), 0.0, 255.0).astype(np.uint8)
+        return obs
+
+    def _decode_obs(self, stored: np.ndarray) -> np.ndarray:
+        if self._quantized:
+            return stored.astype(np.float32) / 255.0
+        return stored
 
     def __len__(self) -> int:
         return self._size
 
     def add_batch(self, t: Transition) -> np.ndarray:
         """Insert a batch of transitions; returns the slot indices written."""
-        obs = np.atleast_2d(np.asarray(t.obs, np.float32))
+        obs = self._encode_obs(t.obs)
         n = obs.shape[0]
         with self._lock:
             idx = (self._pos + np.arange(n)) % self.capacity
             self.obs[idx] = obs
             self.action[idx] = np.atleast_2d(np.asarray(t.action, np.float32))
             self.reward[idx] = np.asarray(t.reward, np.float32).reshape(n)
-            self.next_obs[idx] = np.atleast_2d(np.asarray(t.next_obs, np.float32))
+            self.next_obs[idx] = self._encode_obs(t.next_obs)
             self.discount[idx] = np.asarray(t.discount, np.float32).reshape(n)
             self._pos = int((self._pos + n) % self.capacity)
             self._size = int(min(self._size + n, self.capacity))
@@ -81,10 +103,10 @@ class ReplayBuffer:
     def gather(self, idx: np.ndarray) -> Mapping[str, np.ndarray]:
         with self._lock:
             return {
-                "obs": self.obs[idx],
+                "obs": self._decode_obs(self.obs[idx]),
                 "action": self.action[idx],
                 "reward": self.reward[idx],
-                "next_obs": self.next_obs[idx],
+                "next_obs": self._decode_obs(self.next_obs[idx]),
                 "discount": self.discount[idx],
             }
 

@@ -72,6 +72,9 @@ def _reconcile_config(config: TrainConfig, env) -> TrainConfig:
         action_dim=action_dim,
         n_step=config.n_step,
         prioritized=config.prioritized,
+        # Pixel envs advertise (H, W, C); networks then conv-encode the
+        # flattened columns the pipeline carries (envs/pixel_pendulum.py).
+        pixel_shape=tuple(env.pixel_shape) if hasattr(env, "pixel_shape") else config.agent.pixel_shape,
     )
     defaults = DistConfig()
     if (
@@ -101,8 +104,10 @@ class Trainer:
         self.is_jax_env = not hasattr(self.env, "last_goal_obs")
         agent_cfg = config.agent
 
-        # replay
+        # replay — pixel observations are stored uint8-quantized (4× less
+        # host RAM; [0,1] floats round-trip through ×255)
         obs_dim, act_dim = agent_cfg.obs_dim, agent_cfg.action_dim
+        obs_dtype = np.uint8 if agent_cfg.pixel_shape else np.float32
         if config.prioritized:
             self.buffer = PrioritizedReplayBuffer(
                 config.replay_capacity,
@@ -113,9 +118,12 @@ class Trainer:
                 beta_steps=agent_cfg.per_beta_steps,
                 eps=agent_cfg.per_eps,
                 tree_backend=config.tree_backend,
+                obs_dtype=obs_dtype,
             )
         else:
-            self.buffer = ReplayBuffer(config.replay_capacity, obs_dim, act_dim)
+            self.buffer = ReplayBuffer(
+                config.replay_capacity, obs_dim, act_dim, obs_dtype=obs_dtype
+            )
 
         # learner
         self.key = jax.random.PRNGKey(config.seed)

@@ -1,0 +1,205 @@
+"""Pixel path end-to-end: on-device renderer env, conv-encoded networks,
+fused train step over flattened-pixel batches (BASELINE.json config 4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from d4pg_tpu.agent import D4PGConfig, create_train_state, jit_train_step
+from d4pg_tpu.envs import PixelPendulum, rollout
+from d4pg_tpu.envs.pixel_pendulum import render_arm
+from d4pg_tpu.models.critic import DistConfig
+
+
+def test_render_arm_orientation():
+    size = 32
+    up = np.asarray(render_arm(jnp.asarray(0.0), size))
+    down = np.asarray(render_arm(jnp.asarray(np.pi), size))
+    c = size // 2
+    # θ=0 is 'up': mass above the center row; θ=π below.
+    assert up[: c - 2].sum() > up[c + 2 :].sum()
+    assert down[c + 2 :].sum() > down[: c - 2].sum()
+    assert 0.0 <= up.min() and up.max() <= 1.0
+    # the stroke actually lights pixels (anti-aliased peak ≈ 0.8)
+    assert up.max() > 0.7
+
+
+def test_pixel_pendulum_shapes_and_jit():
+    env = PixelPendulum(size=24)
+    state, obs = jax.jit(env.reset)(jax.random.PRNGKey(0))
+    assert obs.shape == (24 * 24 * 2,)
+    state2, obs2, r, term, trunc = jax.jit(env.step)(state, jnp.asarray([0.5]))
+    assert obs2.shape == (24 * 24 * 2,)
+    assert float(r) <= 0.0
+    assert float(term) == 0.0
+    np.testing.assert_array_less(-1e-6, np.asarray(obs2))
+    np.testing.assert_array_less(np.asarray(obs2), 1.0 + 1e-6)
+
+
+def test_pixel_pendulum_velocity_channel():
+    """The two channels differ when the pendulum moves (Markovian obs)."""
+    env = PixelPendulum(size=24)
+    state, _ = env.reset(jax.random.PRNGKey(1))
+    # Force a fast-moving state: θ=π/2, θ̇=max speed.
+    physics = jnp.asarray([jnp.pi / 2, 8.0])
+    obs = env._obs(physics)
+    frames = np.asarray(obs).reshape(24, 24, 2)
+    assert np.abs(frames[..., 0] - frames[..., 1]).max() > 0.5
+    # And match when static.
+    obs_static = env._obs(jnp.asarray([jnp.pi / 2, 0.0]))
+    frames_s = np.asarray(obs_static).reshape(24, 24, 2)
+    np.testing.assert_allclose(frames_s[..., 0], frames_s[..., 1], atol=1e-5)
+
+
+def test_pixel_rollout_scans_on_device():
+    env = PixelPendulum(size=16)
+    policy = lambda obs, key: jax.random.uniform(key, (1,), minval=-1.0, maxval=1.0)
+    _, _, traj = rollout(env, policy, jax.random.PRNGKey(0), num_steps=8)
+    assert traj.obs.shape == (8, 16 * 16 * 2)
+    assert traj.next_obs.shape == (8, 16 * 16 * 2)
+
+
+def test_pixel_train_step_runs_and_learns():
+    H, W, C = 16, 16, 2
+    config = D4PGConfig(
+        obs_dim=H * W * C,
+        action_dim=1,
+        hidden_sizes=(32, 32),
+        pixel_shape=(H, W, C),
+        encoder_embed_dim=16,
+        dist=DistConfig(kind="categorical", num_atoms=21, v_min=-5, v_max=5),
+    )
+    state = create_train_state(config, jax.random.PRNGKey(0))
+    # Encoder params exist in BOTH networks.
+    assert any("PixelEncoder" in k for k in state.actor_params["params"])
+    assert any("PixelEncoder" in k for k in state.critic_params["params"])
+    step = jit_train_step(config, donate=False)
+    rng = np.random.default_rng(0)
+    B = 16
+    batch = {
+        "obs": jnp.asarray(rng.uniform(0, 1, size=(B, H * W * C)), jnp.float32),
+        "action": jnp.asarray(rng.uniform(-1, 1, size=(B, 1)), jnp.float32),
+        "reward": jnp.asarray(rng.uniform(-1, 0, size=B), jnp.float32),
+        "next_obs": jnp.asarray(rng.uniform(0, 1, size=(B, H * W * C)), jnp.float32),
+        "discount": jnp.full((B,), 0.99, jnp.float32),
+        "weights": jnp.ones((B,), jnp.float32),
+    }
+    state2, metrics, priorities = step(state, batch)
+    assert int(state2.step) == 1
+    for v in metrics.values():
+        assert np.isfinite(float(v))
+    # The conv encoder itself receives gradient.
+    enc_before = [
+        v for k, v in jax.tree_util.tree_leaves_with_path(state.critic_params)
+        if "PixelEncoder" in jax.tree_util.keystr(k)
+    ]
+    enc_after = [
+        v for k, v in jax.tree_util.tree_leaves_with_path(state2.critic_params)
+        if "PixelEncoder" in jax.tree_util.keystr(k)
+    ]
+    deltas = [float(jnp.abs(a - b).max()) for a, b in zip(enc_before, enc_after)]
+    assert max(deltas) > 0
+
+
+def test_pixel_trainer_smoke(tmp_path):
+    """Trainer end-to-end on the pixel env: warmup, a few fused grad steps
+    over conv-encoded flattened-pixel batches, eval — no host renderer."""
+    import dataclasses
+
+    from train import build_parser, config_from_args
+    from d4pg_tpu.runtime import Trainer
+
+    args = build_parser().parse_args(
+        [
+            "--env", "pixel_pendulum",
+            "--total-steps", "4",
+            "--warmup", "64",
+            "--eval-interval", "1000000",
+            "--checkpoint-interval", "1000000",
+            "--num-envs", "2",
+            "--bsize", "8",
+            "--log-dir", str(tmp_path / "pix"),
+        ]
+    )
+    cfg = config_from_args(args)
+    cfg = dataclasses.replace(
+        cfg, agent=dataclasses.replace(cfg.agent, hidden_sizes=(32, 32), encoder_embed_dim=16)
+    )
+    trainer = Trainer(cfg)
+    assert trainer.config.agent.pixel_shape == (48, 48, 2)
+    trainer.warmup()
+    out = trainer.train(total_steps=4)
+    trainer.close()
+    assert np.isfinite(out["critic_loss"])
+
+
+def test_uint8_replay_roundtrip():
+    """Pixel replay stores uint8 (4x less RAM); [0,1] floats round-trip
+    within quantization error 1/255."""
+    from d4pg_tpu.replay import PrioritizedReplayBuffer, ReplayBuffer
+    from d4pg_tpu.replay.uniform import Transition
+
+    rng = np.random.default_rng(0)
+    obs = rng.uniform(0, 1, size=(16, 32)).astype(np.float32)
+    nxt = rng.uniform(0, 1, size=(16, 32)).astype(np.float32)
+    for buf in (
+        ReplayBuffer(64, 32, 2, obs_dtype=np.uint8),
+        PrioritizedReplayBuffer(64, 32, 2, obs_dtype=np.uint8),
+    ):
+        assert buf.obs.dtype == np.uint8 and buf.next_obs.dtype == np.uint8
+        idx = buf.add_batch(
+            Transition(obs, np.zeros((16, 2), np.float32),
+                       np.zeros(16, np.float32), nxt, np.ones(16, np.float32))
+        )
+        got = buf.gather(np.asarray(idx))
+        assert got["obs"].dtype == np.float32
+        np.testing.assert_allclose(got["obs"], obs, atol=1.0 / 255.0 + 1e-7)
+        np.testing.assert_allclose(got["next_obs"], nxt, atol=1.0 / 255.0 + 1e-7)
+
+
+def test_pixel_preset_wires_encoder_and_capacity():
+    """The public preset API alone (no Trainer) must yield a conv-encoded
+    agent and a pixel-sized replay default."""
+    from d4pg_tpu.config import TrainConfig, apply_env_preset
+
+    cfg = apply_env_preset(TrainConfig(env="pixel_pendulum"))
+    assert cfg.agent.pixel_shape == (48, 48, 2)
+    assert cfg.agent.obs_dim == 48 * 48 * 2
+    assert cfg.replay_capacity == 100_000
+    # explicit user capacity wins over the preset cap
+    cfg2 = apply_env_preset(TrainConfig(env="pixel_pendulum", replay_capacity=5_000))
+    assert cfg2.replay_capacity == 5_000
+
+
+def test_uint8_replay_accepts_byte_range():
+    """[0,255] byte-image observations quantize correctly too (same max>2
+    heuristic as the encoder); decoded batches are always [0,1]."""
+    from d4pg_tpu.replay import ReplayBuffer
+    from d4pg_tpu.replay.uniform import Transition
+
+    rng = np.random.default_rng(1)
+    obs255 = rng.integers(0, 256, size=(8, 16)).astype(np.float32)
+    buf = ReplayBuffer(32, 16, 1, obs_dtype=np.uint8)
+    idx = buf.add_batch(
+        Transition(obs255, np.zeros((8, 1), np.float32), np.zeros(8, np.float32),
+                   obs255, np.ones(8, np.float32))
+    )
+    got = buf.gather(np.asarray(idx))
+    np.testing.assert_allclose(got["obs"], obs255 / 255.0, atol=1e-6)
+
+
+def test_cli_default_path_applies_pixel_preset():
+    """`train.py --env pixel_pendulum` with NO extra flags must get the
+    conv encoder and the pixel-sized replay cap (preset not gated on
+    --v-min/--v-max)."""
+    from train import build_parser, config_from_args
+
+    cfg = config_from_args(build_parser().parse_args(["--env", "pixel_pendulum"]))
+    assert cfg.agent.pixel_shape == (48, 48, 2)
+    assert cfg.replay_capacity == 100_000
+    assert cfg.agent.dist.v_min == -300.0
+    # explicit flags still win
+    cfg2 = config_from_args(build_parser().parse_args(
+        ["--env", "pixel_pendulum", "--v-min", "-50", "--rmsize", "7000"]))
+    assert cfg2.agent.dist.v_min == -50.0
+    assert cfg2.replay_capacity == 7_000

@@ -126,11 +126,13 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         agent=agent,
         seed=args.seed,
     )
-    # explicit --v-min/--v-max beat the env preset
-    if args.v_min is not None or args.v_max is not None:
-        from d4pg_tpu.config import apply_env_preset
+    # Env preset always applies (dims, v-range, pixel wiring, pixel-sized
+    # replay cap); explicit --v-min/--v-max then beat it. Explicit --rmsize
+    # beats the preset cap inside apply_env_preset (non-default wins).
+    from d4pg_tpu.config import apply_env_preset
 
-        cfg = apply_env_preset(cfg)
+    cfg = apply_env_preset(cfg)
+    if args.v_min is not None or args.v_max is not None:
         dist = dataclasses.replace(
             cfg.agent.dist,
             v_min=args.v_min if args.v_min is not None else cfg.agent.dist.v_min,
