@@ -36,8 +36,19 @@ class TrainConfig:
     env_steps_per_train_step: float = 1.0  # collect:train ratio
     batch_size: int = 256
 
-    # replay
-    replay_capacity: int = 1_000_000   # reference --rmsize
+    # async actor/learner decoupling (host actor pool only): collection runs
+    # in a background thread against periodically published actor params
+    # while the learner trains — the BASELINE north-star "streaming batches
+    # asynchronously" decomposition. The env:train ratio is enforced from
+    # both sides (collector throttles ahead, learner waits when starved).
+    async_collect: bool = False
+    publish_interval: int = 10         # grad steps between param publications
+
+    # replay. Capacity None = "unset": resolved to the env preset's cap if
+    # any, else 1M (reference --rmsize default) — a sentinel, so an explicit
+    # --rmsize 1000000 is distinguishable from the default and never
+    # silently downgraded by a preset.
+    replay_capacity: Optional[int] = None
     prioritized: bool = True           # reference --p_replay
     n_step: int = 3                    # reference --n_steps
     tree_backend: str = "auto"
@@ -62,6 +73,9 @@ class TrainConfig:
     seed: int = 0
 
 
+DEFAULT_REPLAY_CAPACITY = 1_000_000  # reference --rmsize default
+
+
 # Per-env presets: categorical support + episode limits (replaces
 # configure_env_params, main.py:84-99, which hardcodes Pendulum and comments
 # out the rest).
@@ -77,7 +91,9 @@ ENV_PRESETS = {
     ),
     "Pendulum-v1": dict(v_min=-300.0, v_max=0.0, obs_dim=3, action_dim=1, max_episode_steps=200),
     "HalfCheetah-v4": dict(v_min=0.0, v_max=1000.0, obs_dim=17, action_dim=6, max_episode_steps=1000),
-    "Humanoid-v4": dict(v_min=0.0, v_max=1000.0, obs_dim=348, action_dim=17, max_episode_steps=1000),
+    "HalfCheetah-v5": dict(v_min=0.0, v_max=1000.0, obs_dim=17, action_dim=6, max_episode_steps=1000),
+    "Humanoid-v4": dict(v_min=0.0, v_max=1000.0, obs_dim=376, action_dim=17, max_episode_steps=1000),
+    "Humanoid-v5": dict(v_min=0.0, v_max=1000.0, obs_dim=348, action_dim=17, max_episode_steps=1000),
 }
 
 
@@ -104,9 +120,8 @@ def apply_env_preset(config: TrainConfig) -> TrainConfig:
         else preset["max_episode_steps"]
     )
     replay_capacity = config.replay_capacity
-    default_capacity = TrainConfig.__dataclass_fields__["replay_capacity"].default
-    if replay_capacity == default_capacity and "replay_capacity" in preset:
-        replay_capacity = preset["replay_capacity"]
+    if replay_capacity is None:
+        replay_capacity = preset.get("replay_capacity", DEFAULT_REPLAY_CAPACITY)
     return dataclasses.replace(
         config, agent=agent, max_episode_steps=max_steps,
         replay_capacity=replay_capacity,

@@ -5,26 +5,33 @@ The reference drives host gym/pybullet envs (``main.py:68``,
 
 - pure-JAX envs with a Brax-style functional API (:mod:`d4pg_tpu.envs.api`)
   that roll out entirely on device under ``lax.scan``
-  (:mod:`d4pg_tpu.envs.rollout`) — BASELINE.json config 5;
+  (:mod:`d4pg_tpu.envs.rollouts`) — BASELINE.json config 5;
 - a gymnasium adapter with the reference's action normalization and
   goal-dict flattening for host-CPU actors (:mod:`d4pg_tpu.envs.gym_adapter`).
+
+Exports resolve lazily (PEP 562) so that spawned actor-pool worker
+processes importing only :mod:`d4pg_tpu.envs.gym_adapter` never pull in the
+JAX env modules (and with them the JAX runtime).
 """
 
-from d4pg_tpu.envs.api import Env, EnvState
-from d4pg_tpu.envs.pendulum import Pendulum
-from d4pg_tpu.envs.pixel_pendulum import PixelPendulum
-from d4pg_tpu.envs.pointmass_goal import PointMassGoal
-from d4pg_tpu.envs.rollout import rollout
-from d4pg_tpu.envs.gym_adapter import GymAdapter, NormalizeAction, make_env
+_EXPORTS = {
+    "Env": "d4pg_tpu.envs.api",
+    "EnvState": "d4pg_tpu.envs.api",
+    "Pendulum": "d4pg_tpu.envs.pendulum",
+    "PixelPendulum": "d4pg_tpu.envs.pixel_pendulum",
+    "PointMassGoal": "d4pg_tpu.envs.pointmass_goal",
+    "rollout": "d4pg_tpu.envs.rollouts",
+    "GymAdapter": "d4pg_tpu.envs.gym_adapter",
+    "NormalizeAction": "d4pg_tpu.envs.gym_adapter",
+    "make_env": "d4pg_tpu.envs.gym_adapter",
+}
 
-__all__ = [
-    "Env",
-    "EnvState",
-    "Pendulum",
-    "PixelPendulum",
-    "PointMassGoal",
-    "rollout",
-    "GymAdapter",
-    "NormalizeAction",
-    "make_env",
-]
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name: str):
+    if name in _EXPORTS:
+        import importlib
+
+        return getattr(importlib.import_module(_EXPORTS[name]), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

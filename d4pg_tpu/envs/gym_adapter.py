@@ -110,7 +110,9 @@ ENV_VALUE_RANGES = {
     "pendulum": (-300.0, 0.0),
     "pointmass_goal": (-50.0, 0.0),
     "HalfCheetah-v4": (0.0, 1000.0),
+    "HalfCheetah-v5": (0.0, 1000.0),
     "Humanoid-v4": (0.0, 1000.0),
+    "Humanoid-v5": (0.0, 1000.0),
 }
 
 

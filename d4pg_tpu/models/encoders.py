@@ -16,17 +16,23 @@ import jax.numpy as jnp
 
 
 class PixelEncoder(nn.Module):
-    """DrQ-style conv encoder: 4 conv layers, 3x3, stride 2 then 1."""
+    """DrQ-style conv encoder: 4 conv layers, 3x3, stride 2 then 1.
+
+    The pipeline's pixel convention is [0,1] floats everywhere (on-device
+    renderers emit it; replay decode guarantees it) — ``input_scale`` is a
+    fixed divisor for envs that feed raw [0,255] bytes directly, declared
+    once rather than guessed per batch (a dark frame breaks any magnitude
+    heuristic)."""
 
     features: Sequence[int] = (32, 32, 32, 32)
     embed_dim: int = 50
     dtype: jnp.dtype = jnp.float32
+    input_scale: float = 1.0
 
     @nn.compact
     def __call__(self, pixels: jax.Array) -> jax.Array:
-        # pixels: [..., H, W, C] in [0, 255] or [0, 1]
-        x = pixels.astype(self.dtype)
-        x = jnp.where(jnp.max(jnp.abs(x)) > 2.0, x / 255.0, x)
+        # pixels: [..., H, W, C] in [0, 1] (or [0, input_scale])
+        x = pixels.astype(self.dtype) / self.input_scale
         for i, feat in enumerate(self.features):
             stride = 2 if i == 0 else 1
             x = nn.Conv(feat, (3, 3), strides=(stride, stride), dtype=self.dtype)(x)

@@ -39,8 +39,11 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         eps: float = 1e-6,
         tree_backend: str = "auto",
         obs_dtype=np.float32,
+        obs_scale=None,
     ):
-        super().__init__(capacity, obs_dim, action_dim, obs_dtype=obs_dtype)
+        super().__init__(
+            capacity, obs_dim, action_dim, obs_dtype=obs_dtype, obs_scale=obs_scale
+        )
         assert alpha >= 0
         self.alpha = alpha
         self.beta0 = beta0

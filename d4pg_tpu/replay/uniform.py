@@ -39,13 +39,18 @@ class ReplayBuffer:
     """
 
     def __init__(self, capacity: int, obs_dim: int, action_dim: int,
-                 obs_dtype=np.float32):
-        """``obs_dtype=np.uint8`` quantizes [0,1]-float observations to bytes
-        in storage (×255 on write, ÷255 on read) — 4× less host RAM for
-        pixel envs, the standard pixel-replay layout. Flat envs keep f32."""
+                 obs_dtype=np.float32, obs_scale: float | None = None):
+        """``obs_dtype=np.uint8`` quantizes observations to bytes in storage
+        — 4× less host RAM for pixel envs, the standard pixel-replay layout.
+        ``obs_scale`` is the fixed store-time multiplier, declared once at
+        construction (guessing the convention per frame mis-encodes dark
+        frames): 255.0 for [0,1]-float envs (the default), 1.0 for envs that
+        already emit [0,255] bytes. Decoded batches are always [0,1] floats.
+        Flat envs keep f32 and ignore ``obs_scale``."""
         self.capacity = int(capacity)
         self.obs_dtype = np.dtype(obs_dtype)
         self._quantized = self.obs_dtype == np.uint8
+        self._obs_scale = float(obs_scale) if obs_scale is not None else 255.0
         self.obs = np.zeros((capacity, obs_dim), self.obs_dtype)
         self.action = np.zeros((capacity, action_dim), np.float32)
         self.reward = np.zeros((capacity,), np.float32)
@@ -58,12 +63,9 @@ class ReplayBuffer:
     def _encode_obs(self, obs: np.ndarray) -> np.ndarray:
         obs = np.atleast_2d(np.asarray(obs, np.float32))
         if self._quantized:
-            # Accept either pixel convention — [0,1] floats (our on-device
-            # renderers) or [0,255] (byte-image envs); same max>2 heuristic
-            # as models/encoders.py. Decoded batches are always [0,1].
-            if obs.size and np.abs(obs).max() > 2.0:
-                return np.clip(np.rint(obs), 0.0, 255.0).astype(np.uint8)
-            return np.clip(np.rint(obs * 255.0), 0.0, 255.0).astype(np.uint8)
+            return np.clip(np.rint(obs * self._obs_scale), 0.0, 255.0).astype(
+                np.uint8
+            )
         return obs
 
     def _decode_obs(self, stored: np.ndarray) -> np.ndarray:

@@ -1,0 +1,150 @@
+"""Parallel host actor pool: N gymnasium envs in worker processes.
+
+This is the TPU-native replacement for the reference's N Hogwild worker
+processes (``main.py:399-403``) on the *acting* side: the reference forks N
+full act+learn workers; here N lightweight processes each own one host env
+and only step it, while a single learner consumes the shared replay. One
+batched device call computes all N actions per pool step (the reference does
+N independent single-obs forwards, ``main.py:145``), so host envs ride the
+TPU's batch dimension instead of competing for it.
+
+Workers deliberately import nothing heavy (no JAX): with the ``spawn`` start
+method each child interpreter loads only gymnasium + numpy, keeping children
+clean of TPU runtime state (forking a live TPU client is unsafe).
+
+Protocol (pipe messages, parent → child):
+    ("reset", seed)      → child replies flat obs [obs_dim]
+    ("step", action)     → child replies (next_obs, reward, terminated,
+                           truncated, obs_after_autoreset, is_success)
+    ("close",)           → child exits
+``next_obs`` is the true successor state (what replay must store);
+``obs_after_autoreset`` is what the policy sees next (== next_obs unless the
+episode ended, in which case the child has already reset).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+from typing import Optional
+
+import numpy as np
+
+
+def _worker(conn, env_id: str, max_episode_steps: Optional[int], base_seed: int):
+    # Child-process entry: owns exactly one host env. Import here so the
+    # parent's module import stays light and spawn'd children never touch JAX.
+    from d4pg_tpu.envs.gym_adapter import GymAdapter
+
+    env = GymAdapter(env_id, max_episode_steps)
+    episode = 0
+    try:
+        while True:
+            msg = conn.recv()
+            cmd = msg[0]
+            if cmd == "reset":
+                seed = msg[1] if msg[1] is not None else base_seed + episode
+                episode += 1
+                conn.send(env.reset(seed=seed))
+            elif cmd == "step":
+                obs2, r, term, trunc, info = env.step(msg[1])
+                success = bool(info.get("is_success", False)) if isinstance(info, dict) else False
+                if term or trunc:
+                    episode += 1
+                    obs_next = env.reset(seed=base_seed + episode)
+                else:
+                    obs_next = obs2
+                conn.send((obs2, r, term, trunc, obs_next, success))
+            elif cmd == "close":
+                break
+    finally:
+        env.close()
+        conn.close()
+
+
+class HostActorPool:
+    """N parallel host envs behind a synchronized batch-step interface."""
+
+    def __init__(
+        self,
+        env_id: str,
+        num_actors: int,
+        max_episode_steps: Optional[int] = None,
+        seed: int = 0,
+        start_method: str = "spawn",
+    ):
+        assert num_actors >= 1
+        self.num_actors = num_actors
+        ctx = mp.get_context(start_method)
+        self._conns = []
+        self._procs = []
+        for i in range(num_actors):
+            parent, child = ctx.Pipe()
+            # Disjoint per-actor seed streams (akin to the reference seeding
+            # each worker's env independently at fork).
+            p = ctx.Process(
+                target=_worker,
+                args=(child, env_id, max_episode_steps, seed + 1_000_003 * (i + 1)),
+                daemon=True,
+            )
+            p.start()
+            child.close()
+            self._conns.append(parent)
+            self._procs.append(p)
+        self._closed = False
+
+    def reset_all(self, seed: Optional[int] = None) -> np.ndarray:
+        """Reset every env; returns stacked obs [N, obs_dim]."""
+        for i, c in enumerate(self._conns):
+            c.send(("reset", None if seed is None else seed + i))
+        return np.stack([c.recv() for c in self._conns]).astype(np.float32)
+
+    def step(self, actions: np.ndarray):
+        """Step all envs with canonical (−1,1) actions [N, act_dim].
+
+        Returns ``(next_obs, rewards, terminated, truncated, policy_obs,
+        success)`` — all stacked over the actor axis. ``next_obs`` is the
+        transition's successor (store this); ``policy_obs`` already reflects
+        any auto-reset (act on this).
+        """
+        actions = np.asarray(actions)
+        for i, c in enumerate(self._conns):
+            c.send(("step", actions[i]))
+        obs2, rews, terms, truncs, pol_obs, succ = [], [], [], [], [], []
+        for c in self._conns:
+            o2, r, te, tr, on, s = c.recv()
+            obs2.append(o2)
+            rews.append(r)
+            terms.append(te)
+            truncs.append(tr)
+            pol_obs.append(on)
+            succ.append(s)
+        return (
+            np.stack(obs2).astype(np.float32),
+            np.asarray(rews, np.float32),
+            np.asarray(terms, bool),
+            np.asarray(truncs, bool),
+            np.stack(pol_obs).astype(np.float32),
+            np.asarray(succ, bool),
+        )
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for c in self._conns:
+            try:
+                c.send(("close",))
+            except (BrokenPipeError, OSError):
+                pass
+        for p in self._procs:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+        for c in self._conns:
+            c.close()
+
+    def __del__(self):  # best-effort cleanup
+        try:
+            self.close()
+        except Exception:
+            pass

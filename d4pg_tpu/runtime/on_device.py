@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from d4pg_tpu.agent import TrainState
 from d4pg_tpu.agent.d4pg import fused_train_scan, gather_batches, make_noise
 from d4pg_tpu.agent.state import D4PGConfig
-from d4pg_tpu.envs.rollout import rollout
+from d4pg_tpu.envs.rollouts import rollout
 from d4pg_tpu.ops import nstep_returns
 
 

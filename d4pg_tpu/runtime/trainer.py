@@ -20,6 +20,7 @@ device mesh.
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from typing import Optional
 
@@ -91,7 +92,18 @@ def _reconcile_config(config: TrainConfig, env) -> TrainConfig:
     max_steps = config.max_episode_steps
     if max_steps is None:
         max_steps = getattr(env, "max_episode_steps", 1000)
-    return dataclasses.replace(config, agent=agent, max_episode_steps=max_steps)
+    replay_capacity = config.replay_capacity
+    if replay_capacity is None:
+        from d4pg_tpu.config import DEFAULT_REPLAY_CAPACITY
+
+        preset = ENV_PRESETS.get(config.env) or {}
+        replay_capacity = preset.get("replay_capacity", DEFAULT_REPLAY_CAPACITY)
+    return dataclasses.replace(
+        config,
+        agent=agent,
+        max_episode_steps=max_steps,
+        replay_capacity=replay_capacity,
+    )
 
 
 class Trainer:
@@ -108,6 +120,9 @@ class Trainer:
         # host RAM; [0,1] floats round-trip through ×255)
         obs_dim, act_dim = agent_cfg.obs_dim, agent_cfg.action_dim
         obs_dtype = np.uint8 if agent_cfg.pixel_shape else np.float32
+        # Envs declare their pixel convention once ([0,1] floats unless the
+        # env advertises obs_scale, e.g. 1.0 for byte-image envs).
+        obs_scale = getattr(self.env, "obs_scale", None)
         if config.prioritized:
             self.buffer = PrioritizedReplayBuffer(
                 config.replay_capacity,
@@ -119,10 +134,15 @@ class Trainer:
                 eps=agent_cfg.per_eps,
                 tree_backend=config.tree_backend,
                 obs_dtype=obs_dtype,
+                obs_scale=obs_scale,
             )
         else:
             self.buffer = ReplayBuffer(
-                config.replay_capacity, obs_dim, act_dim, obs_dtype=obs_dtype
+                config.replay_capacity,
+                obs_dim,
+                act_dim,
+                obs_dtype=obs_dtype,
+                obs_scale=obs_scale,
             )
 
         # learner
@@ -152,6 +172,18 @@ class Trainer:
         self._rng = np.random.default_rng(config.seed)
         self._noise_init, self._noise_sample, self._noise_reset = make_noise(agent_cfg)
 
+        self.has_pool = False
+        self._buffer_lock = threading.Lock()
+        self._stop_collect = threading.Event()
+        self._collector: Optional[threading.Thread] = None
+        self._collector_error: Optional[BaseException] = None
+        self._actor_pub = None  # published param copy the async collector acts on
+        # Trainer-lifetime grad-step counter for async pacing. Deliberately
+        # NOT self.grad_steps: that one is restored from checkpoints, which
+        # would make a resumed learner wait for ratio·(all past steps) of
+        # fresh collection; this one is cumulative across chunked train()
+        # calls but starts at 0 per process.
+        self._learner_steps = 0
         if config.her:
             self._setup_her()
         elif self.is_jax_env:
@@ -233,6 +265,9 @@ class Trainer:
     # ------------------------------------------------------------------ host
     def _setup_host_collect(self):
         cfg = self.config
+        if cfg.num_envs > 1 or cfg.async_collect:
+            self._setup_pool_collect()
+            return
         self.writers = [NStepWriter(self.buffer, cfg.n_step, cfg.agent.gamma)]
         self._host_obs = self.env.reset(seed=cfg.seed)
         self._host_noise = self._noise_init()
@@ -267,6 +302,148 @@ class Trainer:
             else:
                 self._host_obs = obs2
             self.env_steps += 1
+
+    # ------------------------------------------------------------------ pool
+    def _setup_pool_collect(self):
+        """Parallel host actors (BASELINE configs 2-3: HalfCheetah ×4,
+        Humanoid ×64): N env worker processes, one batched device call per
+        pool step. Replaces the reference's N forked act+learn workers
+        (``main.py:399-403``) with act-only processes + a single learner."""
+        from d4pg_tpu.runtime.actor_pool import HostActorPool
+
+        cfg = self.config
+        self.pool = HostActorPool(
+            cfg.env, cfg.num_envs, cfg.max_episode_steps, seed=cfg.seed
+        )
+        self.has_pool = True
+        self.writers = [
+            NStepWriter(self.buffer, cfg.n_step, cfg.agent.gamma)
+            for _ in range(cfg.num_envs)
+        ]
+        self._pool_obs = self.pool.reset_all(seed=cfg.seed)
+        self._pool_noise = jax.vmap(lambda _: self._noise_init())(
+            jnp.arange(cfg.num_envs)
+        )
+        agent_cfg = cfg.agent
+        noise_sample, noise_reset = self._noise_sample, self._noise_reset
+
+        def pool_act(params, obs, key, nstates, scale):
+            a = act_deterministic(agent_cfg, params, obs)  # [N, act_dim]
+            keys = jax.random.split(key, obs.shape[0])
+
+            def one(ai, k, nst):
+                n, nst = noise_sample(nst, k, ai.shape)
+                return jnp.clip(ai + scale * n, -1.0, 1.0), nst
+
+            return jax.vmap(one)(a, keys, nstates)
+
+        def pool_reset_noise(nstates, done):
+            fresh = jax.vmap(noise_reset)(nstates)
+
+            def sel(a, b):
+                mask = done.reshape((-1,) + (1,) * (a.ndim - 1))
+                return jnp.where(mask, a, b)
+
+            return jax.tree.map(sel, fresh, nstates)
+
+        self._pool_act = jax.jit(pool_act)
+        self._pool_reset_noise = jax.jit(pool_reset_noise)
+        # The pool has its own key stream so a background collector never
+        # races the learner thread on self.key.
+        self.key, self._collect_key = jax.random.split(self.key)
+
+    def _pool_collect_steps(self, num_steps: int, noise_scale: Optional[float] = None):
+        """Collect ≈num_steps env steps across all pool actors (rounded up
+        to whole synchronized pool steps of N envs each)."""
+        cfg = self.config
+        scale = self._noise_scale() if noise_scale is None else noise_scale
+        N = cfg.num_envs
+        # Async mode acts on the published copy (the live state's buffers are
+        # donated into each train step and must not be read concurrently).
+        params = self._actor_pub if self._actor_pub is not None else self.state.actor_params
+        for _ in range(max(1, -(-num_steps // N))):
+            self._collect_key, k = jax.random.split(self._collect_key)
+            a_dev, self._pool_noise = self._pool_act(
+                params,
+                jnp.asarray(self._pool_obs),
+                k,
+                self._pool_noise,
+                scale,
+            )
+            actions = np.asarray(a_dev)
+            obs2, rews, terms, truncs, pol_obs, _succ = self.pool.step(actions)
+            with self._buffer_lock:
+                for i in range(N):
+                    self.writers[i].add(
+                        self._pool_obs[i],
+                        actions[i],
+                        float(rews[i]),
+                        obs2[i],
+                        terminated=bool(terms[i]),
+                        truncated=bool(truncs[i]),
+                    )
+            done = terms | truncs
+            if done.any():
+                self._pool_noise = self._pool_reset_noise(
+                    self._pool_noise, jnp.asarray(done)
+                )
+            self._pool_obs = pol_obs
+            self.env_steps += N
+
+    # ----------------------------------------------------------------- async
+    def _publish_params(self):
+        """Device-side copy of actor params for the collector thread (the
+        live state is donated into every train step, so it must never be
+        read concurrently — this is the 'weight publication to host actors'
+        leg of the actor/learner decomposition)."""
+        self._actor_pub = jax.tree.map(jnp.copy, self.state.actor_params)
+
+    def _collector_loop(self):
+        cfg = self.config
+        ratio = cfg.env_steps_per_train_step
+        slack = max(cfg.num_envs * 4, 64)
+        try:
+            while not self._stop_collect.is_set():
+                target = cfg.warmup_steps + ratio * self._learner_steps + slack
+                if self.env_steps >= target:
+                    time.sleep(0.002)
+                    continue
+                noise = 3.0 if self.env_steps < cfg.warmup_steps else None
+                self._pool_collect_steps(cfg.num_envs, noise_scale=noise)
+        except BaseException as e:  # surfaced by the learner's pacing loop
+            self._collector_error = e
+            raise
+
+    def _check_collector_alive(self):
+        if self._collector is not None and not self._collector.is_alive():
+            raise RuntimeError(
+                "async collector thread died; training cannot make progress"
+            ) from self._collector_error
+
+    def _start_collector(self):
+        if not self.has_pool:
+            raise ValueError(
+                "async_collect needs the host actor pool (a gymnasium env id); "
+                "pure-JAX envs collect on-device in the learner stream"
+            )
+        if self._collector is not None and self._collector.is_alive():
+            raise RuntimeError(
+                "a collector thread is already running; call _stop_collector() "
+                "(train() does this even on error) before starting another"
+            )
+        self._stop_collect.clear()
+        self._collector_error = None
+        self._publish_params()
+        self._collector = threading.Thread(
+            target=self._collector_loop, name="collector", daemon=True
+        )
+        self._collector.start()
+
+    def _stop_collector(self):
+        self._stop_collect.set()
+        if self._collector is not None:
+            self._collector.join(timeout=30)
+            self._collector = None
 
     # ------------------------------------------------------------------- HER
     def _setup_her(self):
@@ -390,25 +567,31 @@ class Trainer:
                 self._her_collect_episode(noise_scale=3.0)
             elif self.is_jax_env:
                 self._collect_once(noise_scale=3.0)
+            elif self.has_pool:
+                self._pool_collect_steps(self.config.num_envs * 8, noise_scale=3.0)
             else:
                 self._host_collect_steps(64, noise_scale=3.0)
 
     # ----------------------------------------------------------------- train
     def _sample(self):
-        if self.config.prioritized:
-            batch = self.buffer.sample(
-                self.config.batch_size, self._rng, step=self.grad_steps
-            )
-        else:
-            batch = dict(self.buffer.sample(self.config.batch_size, self._rng))
-            batch["weights"] = np.ones(self.config.batch_size, np.float32)
+        with self._buffer_lock:
+            if self.config.prioritized:
+                batch = self.buffer.sample(
+                    self.config.batch_size, self._rng, step=self.grad_steps
+                )
+            else:
+                batch = dict(self.buffer.sample(self.config.batch_size, self._rng))
+                batch["weights"] = np.ones(self.config.batch_size, np.float32)
         return batch
 
     def train(self, total_steps: Optional[int] = None) -> dict:
         """Run the full loop; returns final metrics."""
         cfg = self.config
         total = total_steps or cfg.total_steps
-        self.warmup()
+        if cfg.async_collect:
+            self._start_collector()
+        else:
+            self.warmup()
 
         t_start = time.monotonic()
         grad_steps_done = 0
@@ -417,58 +600,85 @@ class Trainer:
         collect_budget = 0.0
         tracing = False
 
-        while grad_steps_done < total:
-            if cfg.profile_dir and grad_steps_done == 10 and not tracing:
-                jax.profiler.start_trace(cfg.profile_dir)
-                tracing = True
-            if tracing and grad_steps_done == 60:
+        try:
+            while grad_steps_done < total:
+                if cfg.profile_dir and grad_steps_done == 10 and not tracing:
+                    jax.profiler.start_trace(cfg.profile_dir)
+                    tracing = True
+                if tracing and grad_steps_done == 60:
+                    jax.profiler.stop_trace()
+                    tracing = False
+                if cfg.async_collect:
+                    # pacing: never outrun the actors' env:train ratio
+                    # (lifetime counter, so chunked train() calls keep collecting)
+                    while (
+                        self.env_steps
+                        < cfg.warmup_steps
+                        + cfg.env_steps_per_train_step * self._learner_steps
+                    ):
+                        self._check_collector_alive()
+                        time.sleep(0.001)
+                else:
+                    # interleave collection to hold the env:train ratio (sync modes)
+                    collect_budget += cfg.env_steps_per_train_step
+                    if cfg.her:
+                        max_steps = self.config.max_episode_steps or 1000
+                        if collect_budget >= max_steps:
+                            self._her_collect_episode()
+                            collect_budget -= max_steps
+                    elif self.is_jax_env:
+                        per_iter = cfg.num_envs * self.segment_len
+                        if collect_budget >= per_iter:
+                            self._collect_once()
+                            collect_budget -= per_iter
+                    elif self.has_pool:
+                        per_iter = cfg.num_envs
+                        if collect_budget >= per_iter:
+                            self._pool_collect_steps(per_iter)
+                            collect_budget -= per_iter
+                    else:
+                        n = int(collect_budget)
+                        if n > 0:
+                            self._host_collect_steps(n)
+                            collect_budget -= n
+
+                with annotate("host/sample"):
+                    batch = self._sample()
+                indices = batch.pop("indices", None)
+                dev_batch = {k: jnp.asarray(v) for k, v in batch.items()}
+                # dispatch is async: the TPU runs while we write back the
+                # PREVIOUS step's priorities and sample the next batch
+                with annotate("host/dispatch"):
+                    self.state, metrics, priorities = self._train_step(
+                        self.state, dev_batch
+                    )
+                if pending is not None and self.config.prioritized:
+                    prev_idx, prev_pri = pending
+                    with annotate("host/priority_writeback"):
+                        pri = np.asarray(prev_pri)
+                        with self._buffer_lock:
+                            self.buffer.update_priorities(prev_idx, pri)
+                pending = (indices, priorities)
+                grad_steps_done += 1
+                self.grad_steps += 1
+                self._learner_steps += 1
+                if cfg.async_collect and grad_steps_done % cfg.publish_interval == 0:
+                    self._publish_params()
+
+                step = grad_steps_done
+                if step % cfg.eval_interval == 0 or step == total:
+                    last = self._periodic(step, metrics, t_start, grad_steps_done)
+                if step % cfg.checkpoint_interval == 0 or step == total:
+                    self.ckpt.save(self.grad_steps, self.state)
+        finally:
+            if tracing:
                 jax.profiler.stop_trace()
-                tracing = False
-            # interleave collection to hold the env:train ratio
-            collect_budget += cfg.env_steps_per_train_step
-            if cfg.her:
-                max_steps = self.config.max_episode_steps or 1000
-                if collect_budget >= max_steps:
-                    self._her_collect_episode()
-                    collect_budget -= max_steps
-            elif self.is_jax_env:
-                per_iter = cfg.num_envs * self.segment_len
-                if collect_budget >= per_iter:
-                    self._collect_once()
-                    collect_budget -= per_iter
-            else:
-                n = int(collect_budget)
-                if n > 0:
-                    self._host_collect_steps(n)
-                    collect_budget -= n
-
-            with annotate("host/sample"):
-                batch = self._sample()
-            indices = batch.pop("indices", None)
-            dev_batch = {k: jnp.asarray(v) for k, v in batch.items()}
-            # dispatch is async: the TPU runs while we write back the
-            # PREVIOUS step's priorities and sample the next batch
-            with annotate("host/dispatch"):
-                self.state, metrics, priorities = self._train_step(
-                    self.state, dev_batch
-                )
-            if pending is not None and self.config.prioritized:
-                prev_idx, prev_pri = pending
-                with annotate("host/priority_writeback"):
-                    self.buffer.update_priorities(prev_idx, np.asarray(prev_pri))
-            pending = (indices, priorities)
-            grad_steps_done += 1
-            self.grad_steps += 1
-
-            step = grad_steps_done
-            if step % cfg.eval_interval == 0 or step == total:
-                last = self._periodic(step, metrics, t_start, grad_steps_done)
-            if step % cfg.checkpoint_interval == 0 or step == total:
-                self.ckpt.save(self.grad_steps, self.state)
-        if tracing:
-            jax.profiler.stop_trace()
+            if cfg.async_collect:
+                self._stop_collector()
         if pending is not None and self.config.prioritized:
-            self.buffer.update_priorities(pending[0], np.asarray(pending[1]))
+            pri = np.asarray(pending[1])
+            with self._buffer_lock:
+                self.buffer.update_priorities(pending[0], pri)
         self.ckpt.wait()
         return last
 
@@ -534,7 +744,10 @@ class Trainer:
         return scalars
 
     def close(self):
+        self._stop_collector()
         self.metrics.close()
         self.ckpt.close()
+        if self.has_pool:
+            self.pool.close()
         if hasattr(self.env, "close"):
             self.env.close()

@@ -1,0 +1,214 @@
+"""Parallel host actor pool + async actor/learner decoupling.
+
+Covers the TPU-native replacement for the reference's N forked workers
+(``main.py:399-403``): process-isolated host envs behind a batched step
+interface, and the background-collector mode where the learner and actors
+run concurrently against published params.
+"""
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from d4pg_tpu.config import TrainConfig, apply_env_preset
+from d4pg_tpu.runtime.actor_pool import HostActorPool
+
+gym = pytest.importorskip("gymnasium")
+
+ENV = "Pendulum-v1"
+
+
+def _random_actions(rng, n, dim=1):
+    return rng.uniform(-1, 1, (n, dim)).astype(np.float32)
+
+
+class TestHostActorPool:
+    def test_step_shapes_and_autoreset(self):
+        pool = HostActorPool(ENV, 3, max_episode_steps=10, seed=0)
+        try:
+            obs = pool.reset_all(seed=0)
+            assert obs.shape == (3, 3) and obs.dtype == np.float32
+            rng = np.random.default_rng(0)
+            for t in range(10):
+                obs2, r, term, trunc, pol, succ = pool.step(_random_actions(rng, 3))
+            # all three hit the TimeLimit on step 10 and auto-reset
+            assert trunc.all() and not term.any()
+            # the policy obs is the fresh post-reset state, not the terminal one
+            assert not np.allclose(pol, obs2)
+            assert obs2.shape == pol.shape == (3, 3)
+            assert r.shape == (3,) and succ.shape == (3,)
+        finally:
+            pool.close()
+
+    def test_seeding_disjoint_and_reproducible(self):
+        a = HostActorPool(ENV, 2, max_episode_steps=10, seed=7)
+        b = HostActorPool(ENV, 2, max_episode_steps=10, seed=7)
+        c = HostActorPool(ENV, 2, max_episode_steps=10, seed=8)
+        try:
+            oa, ob, oc = a.reset_all(), b.reset_all(), c.reset_all()
+            np.testing.assert_allclose(oa, ob)  # same seed → same episodes
+            assert not np.allclose(oa, oc)  # different seed → different
+            assert not np.allclose(oa[0], oa[1])  # actors are disjoint streams
+        finally:
+            a.close()
+            b.close()
+            c.close()
+
+    def test_transition_consistency(self):
+        """next_obs must be the true successor: replaying the same action
+        sequence in a single adapter gives identical transitions."""
+        from d4pg_tpu.envs.gym_adapter import GymAdapter
+
+        pool = HostActorPool(ENV, 1, max_episode_steps=50, seed=3)
+        solo = GymAdapter(ENV, 50)
+        try:
+            obs_p = pool.reset_all(seed=100)[0]
+            obs_s = solo.reset(seed=100)
+            np.testing.assert_allclose(obs_p, obs_s, rtol=1e-6)
+            rng = np.random.default_rng(1)
+            for _ in range(5):
+                a = _random_actions(rng, 1)
+                obs2_p, r_p, *_ = pool.step(a)
+                obs2_s, r_s, *_ = solo.step(a[0])
+                np.testing.assert_allclose(obs2_p[0], obs2_s, rtol=1e-5)
+                assert abs(r_p[0] - r_s) < 1e-4
+        finally:
+            pool.close()
+            solo.close()
+
+
+def _cfg(**kw):
+    base = dict(
+        env=ENV,
+        num_envs=2,
+        total_steps=3,
+        warmup_steps=30,
+        batch_size=16,
+        replay_capacity=2_000,
+        eval_interval=3,
+        eval_episodes=1,
+        max_episode_steps=20,
+        checkpoint_interval=100_000,
+    )
+    base.update(kw)
+    return apply_env_preset(TrainConfig(**base))
+
+
+class TestTrainerPool:
+    def test_pool_mode_trains(self, tmp_path):
+        from d4pg_tpu.runtime.trainer import Trainer
+
+        t = Trainer(_cfg(log_dir=str(tmp_path / "run")))
+        try:
+            assert t.has_pool and t.pool.num_actors == 2
+            out = t.train()
+            assert t.env_steps >= 30
+            assert np.isfinite(out["critic_loss"])
+            assert "eval_return_mean" in out
+        finally:
+            t.close()
+
+    def test_async_mode_trains_and_joins(self, tmp_path):
+        from d4pg_tpu.runtime.trainer import Trainer
+
+        t = Trainer(
+            _cfg(
+                log_dir=str(tmp_path / "run"),
+                async_collect=True,
+                publish_interval=2,
+                total_steps=4,
+            )
+        )
+        try:
+            out = t.train()
+            assert t._collector is None  # joined cleanly
+            # pacing: learner never outran warmup + ratio·steps
+            assert t.env_steps >= 30 + 1.0 * 4
+            assert np.isfinite(out["critic_loss"])
+            assert t._actor_pub is not None
+        finally:
+            t.close()
+
+    def test_async_single_env_gets_pool(self, tmp_path):
+        """--async-collect with num_envs=1 must still route through the pool
+        (a dedicated worker process), not the in-thread single-env path."""
+        from d4pg_tpu.runtime.trainer import Trainer
+
+        t = Trainer(
+            _cfg(
+                log_dir=str(tmp_path / "run"),
+                num_envs=1,
+                async_collect=True,
+                total_steps=2,
+            )
+        )
+        try:
+            assert t.has_pool and t.pool.num_actors == 1
+            out = t.train()
+            assert np.isfinite(out["critic_loss"])
+        finally:
+            t.close()
+
+    def test_async_train_twice(self, tmp_path):
+        """Chunked training: a second train() must restart the collector
+        (the stop event is cleared, not latched)."""
+        from d4pg_tpu.runtime.trainer import Trainer
+
+        t = Trainer(
+            _cfg(log_dir=str(tmp_path / "run"), async_collect=True, total_steps=2)
+        )
+        try:
+            t.train()
+            steps_after_first = t.env_steps
+            t.train(total_steps=2)
+            assert t.grad_steps == 4
+            assert t.env_steps >= steps_after_first
+            assert t._collector is None
+        finally:
+            t.close()
+
+    def test_async_requires_pool(self, tmp_path):
+        from d4pg_tpu.runtime.trainer import Trainer
+
+        cfg = apply_env_preset(
+            TrainConfig(
+                env="pendulum",
+                num_envs=2,
+                async_collect=True,
+                total_steps=2,
+                warmup_steps=10,
+                batch_size=8,
+                replay_capacity=1_000,
+                log_dir=str(tmp_path / "run"),
+            )
+        )
+        t = Trainer(cfg)
+        try:
+            with pytest.raises(ValueError, match="actor pool"):
+                t.train()
+        finally:
+            t.close()
+
+
+def test_gym_adapter_imports_without_jax():
+    """Pool worker processes must stay lean: importing the adapter module
+    alone (what ``actor_pool._worker`` does) must not pull in the JAX env
+    stack, and must not load jax itself unless the host environment preloads
+    it at interpreter startup (some TPU sites do, via sitecustomize)."""
+    probe = (
+        "import sys\n"
+        "preloaded = 'jax' in sys.modules\n"
+        "import d4pg_tpu.envs.gym_adapter\n"
+        "jax_envs = [m for m in sys.modules if m.startswith('d4pg_tpu.envs.') "
+        "and not m.endswith('gym_adapter')]\n"
+        "print(preloaded or 'jax' not in sys.modules, jax_envs)\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", probe], capture_output=True, text=True, timeout=120
+    )
+    assert out.returncode == 0, out.stderr
+    flag, envs = out.stdout.strip().split(" ", 1)
+    assert flag == "True", "gym_adapter import loaded jax"
+    assert envs == "[]", f"gym_adapter import loaded JAX env modules: {envs}"

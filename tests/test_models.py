@@ -80,6 +80,11 @@ def test_fanin_init_bounds():
 def test_pixel_encoder():
     enc = PixelEncoder()
     params = enc.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)))
-    z = enc.apply(params, jnp.ones((2, 64, 64, 3)) * 255.0)
+    z = enc.apply(params, jnp.ones((2, 64, 64, 3)))
     assert z.shape == (2, 50)
     assert np.all(np.abs(np.asarray(z)) <= 1.0)
+    # byte-range inputs are declared via a fixed input_scale, not guessed
+    # per batch; same pixels under either convention embed identically
+    enc255 = PixelEncoder(input_scale=255.0)
+    z255 = enc255.apply(params, jnp.ones((2, 64, 64, 3)) * 255.0)
+    np.testing.assert_allclose(np.asarray(z255), np.asarray(z), atol=1e-6)

@@ -172,14 +172,15 @@ def test_pixel_preset_wires_encoder_and_capacity():
 
 
 def test_uint8_replay_accepts_byte_range():
-    """[0,255] byte-image observations quantize correctly too (same max>2
-    heuristic as the encoder); decoded batches are always [0,1]."""
+    """[0,255] byte-image envs declare obs_scale=1.0 once at construction
+    (no per-frame convention guessing — a dark frame would defeat any
+    magnitude heuristic); decoded batches are always [0,1]."""
     from d4pg_tpu.replay import ReplayBuffer
     from d4pg_tpu.replay.uniform import Transition
 
     rng = np.random.default_rng(1)
     obs255 = rng.integers(0, 256, size=(8, 16)).astype(np.float32)
-    buf = ReplayBuffer(32, 16, 1, obs_dtype=np.uint8)
+    buf = ReplayBuffer(32, 16, 1, obs_dtype=np.uint8, obs_scale=1.0)
     idx = buf.add_batch(
         Transition(obs255, np.zeros((8, 1), np.float32), np.zeros(8, np.float32),
                    obs255, np.ones(8, np.float32))

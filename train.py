@@ -28,7 +28,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--env", default="pendulum",
                    help="pendulum | pointmass_goal | any gymnasium id")
     p.add_argument("--rmsize", "--replay-capacity", dest="replay_capacity",
-                   type=int, default=1_000_000)
+                   type=int, default=None,
+                   help="replay ring capacity (default: env preset's cap, "
+                        "else 1M); an explicit value always wins")
     p.add_argument("--tau", type=float, default=0.001)
     p.add_argument("--bsize", "--batch-size", dest="batch_size", type=int, default=256)
     p.add_argument("--gamma", type=float, default=0.99)
@@ -50,7 +52,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--noise-epsilon", type=float, default=0.3)
     # TPU-native flags
     p.add_argument("--num-envs", type=int, default=16,
-                   help="vectorized on-device exploration envs (was --n_workers)")
+                   help="vectorized on-device exploration envs, or host actor "
+                        "pool size for gymnasium envs (was --n_workers)")
+    p.add_argument("--async-collect", action="store_true",
+                   help="decouple actors from the learner: collection runs in "
+                        "a background thread against published actor params")
+    p.add_argument("--publish-interval", type=int, default=10,
+                   help="grad steps between actor-param publications (async)")
     p.add_argument("--dp", type=int, default=None,
                    help="data-parallel device count (None = single device)")
     p.add_argument("--tp", type=int, default=1)
@@ -108,6 +116,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         num_envs=args.num_envs,
         her=args.her,
         her_k=args.her_k,
+        async_collect=args.async_collect,
+        publish_interval=args.publish_interval,
         total_steps=args.total_steps,
         warmup_steps=args.warmup_steps,
         batch_size=args.batch_size,
