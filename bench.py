@@ -77,7 +77,7 @@ def bench_tpu() -> float:
         # Same fused gather+scan program the on-device trainer runs
         # (d4pg_tpu/runtime/on_device.py step 4).
         idx = jax.random.randint(key, (K, BATCH), 0, POOL)
-        state, metrics = fused_train_scan(config, state, gather_batches(pool, idx))
+        state, metrics, _ = fused_train_scan(config, state, gather_batches(pool, idx))
         return state, metrics["critic_loss"]
 
     key = jax.random.PRNGKey(1)

@@ -358,13 +358,23 @@ def gather_batches(store, idx: jax.Array) -> dict:
     return batches
 
 
-def fused_train_scan(config: D4PGConfig, state: TrainState, batches: dict):
+def fused_train_scan(
+    config: D4PGConfig,
+    state: TrainState,
+    batches: dict,
+    axis_name: str | None = None,
+):
     """Scan ``train_step`` over pre-gathered [K, B] batches — the shared
-    inner loop of the on-device trainer and the benchmark. Returns
-    (state, metrics pytree with leading K axis)."""
+    inner loop of the on-device trainer, the benchmark, and the host
+    trainer's ``steps_per_dispatch`` mode (one dispatch per K grad steps
+    amortizes per-call latency, which dominates on remote/tunneled TPUs).
+    ``axis_name`` threads through to each step's gradient pmean (DP under
+    shard_map). Returns (state, metrics pytree with leading K axis,
+    priorities [K, B])."""
 
     def body(st, batch):
-        st, metrics, _ = train_step(config, st, batch)
-        return st, metrics
+        st, metrics, priorities = train_step(config, st, batch, axis_name=axis_name)
+        return st, (metrics, priorities)
 
-    return jax.lax.scan(body, state, batches)
+    state, (metrics, priorities) = jax.lax.scan(body, state, batches)
+    return state, metrics, priorities

@@ -35,6 +35,12 @@ class TrainConfig:
     warmup_steps: int = 1_000          # env steps before learning (main.py:204)
     env_steps_per_train_step: float = 1.0  # collect:train ratio
     batch_size: int = 256
+    # Grad steps fused into one device dispatch (lax.scan over K host-sampled
+    # batches). K>1 amortizes per-dispatch latency — the dominant cost on
+    # remote/tunneled TPUs and still ~ms-level locally. PER priorities go
+    # stale within the K-step window (written back after the dispatch), the
+    # same staleness class the reference accepts from Hogwild asynchrony.
+    steps_per_dispatch: int = 1
 
     # async actor/learner decoupling (host actor pool only): collection runs
     # in a background thread against periodically published actor params

@@ -17,7 +17,7 @@ from functools import partial
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from d4pg_tpu.agent.d4pg import train_step
+from d4pg_tpu.agent.d4pg import fused_train_scan, train_step
 from d4pg_tpu.agent.state import D4PGConfig
 
 
@@ -30,6 +30,25 @@ def make_dp_train_step(config: D4PGConfig, mesh: Mesh, donate: bool = True):
     """
     fn = partial(train_step, config, axis_name="dp")
     batch_spec = P("dp")
+    mapped = jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(P(), {k: batch_spec for k in
+                        ("obs", "action", "reward", "next_obs", "discount", "weights")}),
+        out_specs=(P(), P(), batch_spec),
+        check_vma=False,
+    )
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
+def make_dp_fused_train_step(config: D4PGConfig, mesh: Mesh, donate: bool = True):
+    """DP variant of ``fused_train_scan``: (state, batches [K, B, ...]) →
+    (state, metrics [K], priorities [K, B]) — K grad steps per dispatch,
+    batch rows sharded over "dp" within each scan step, one pmean per step
+    riding ICI. The scan lives *inside* shard_map so the whole K-step chain
+    is a single XLA program per device."""
+    fn = partial(fused_train_scan, config, axis_name="dp")
+    batch_spec = P(None, "dp")  # [K, B] — shard the batch axis, not the scan axis
     mapped = jax.shard_map(
         fn,
         mesh=mesh,

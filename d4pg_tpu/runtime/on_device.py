@@ -165,7 +165,7 @@ def make_on_device_trainer(
         idx = jax.random.randint(
             k_train, (train_steps_per_iter, batch_size), 0, replay.size
         )
-        state, metrics = fused_train_scan(
+        state, metrics, _ = fused_train_scan(
             config, state, gather_batches(replay, idx)
         )
         metrics = jax.tree_util.tree_map(jnp.mean, metrics)

@@ -33,7 +33,7 @@ from d4pg_tpu.agent import (
     create_train_state,
     jit_train_step,
 )
-from d4pg_tpu.agent.d4pg import make_noise
+from d4pg_tpu.agent.d4pg import fused_train_scan, make_noise
 from d4pg_tpu.config import ENV_PRESETS, TrainConfig
 from d4pg_tpu.envs import make_env, rollout
 from d4pg_tpu.envs.pointmass_goal import PointMassGoal
@@ -151,14 +151,22 @@ class Trainer:
         self.state = create_train_state(agent_cfg, init_key)
         if config.dp:
             from d4pg_tpu.parallel import make_dp_train_step, make_mesh
-            from d4pg_tpu.parallel.dp import replicate
+            from d4pg_tpu.parallel.dp import make_dp_fused_train_step, replicate
 
             self.mesh = make_mesh(dp=config.dp, tp=config.tp)
             self.state = replicate(self.state, self.mesh)
             self._train_step = make_dp_train_step(agent_cfg, self.mesh)
+            if config.steps_per_dispatch > 1:
+                self._fused_step = make_dp_fused_train_step(agent_cfg, self.mesh)
         else:
             self.mesh = None
             self._train_step = jit_train_step(agent_cfg)
+            if config.steps_per_dispatch > 1:
+                from functools import partial
+
+                self._fused_step = jax.jit(
+                    partial(fused_train_scan, agent_cfg), donate_argnums=(0,)
+                )
 
         self.metrics = MetricsLogger(config.log_dir)
         self.ckpt = CheckpointManager(f"{config.log_dir}/checkpoints")
@@ -600,14 +608,26 @@ class Trainer:
         collect_budget = 0.0
         tracing = False
 
+        K = max(1, cfg.steps_per_dispatch)
+        if total % K:
+            # whole dispatches only (K is a compiled shape): round up, visibly
+            total = -(-total // K) * K
+            print(f"total_steps rounded up to {total} (multiple of steps_per_dispatch={K})")
+        profiled = False
         try:
             while grad_steps_done < total:
-                if cfg.profile_dir and grad_steps_done == 10 and not tracing:
+                if (
+                    cfg.profile_dir
+                    and not profiled
+                    and not tracing
+                    and grad_steps_done >= 10
+                ):
                     jax.profiler.start_trace(cfg.profile_dir)
                     tracing = True
-                if tracing and grad_steps_done == 60:
+                if tracing and grad_steps_done >= max(60, 10 + K):
                     jax.profiler.stop_trace()
                     tracing = False
+                    profiled = True
                 if cfg.async_collect:
                     # pacing: never outrun the actors' env:train ratio
                     # (lifetime counter, so chunked train() calls keep collecting)
@@ -620,20 +640,20 @@ class Trainer:
                         time.sleep(0.001)
                 else:
                     # interleave collection to hold the env:train ratio (sync modes)
-                    collect_budget += cfg.env_steps_per_train_step
+                    collect_budget += cfg.env_steps_per_train_step * K
                     if cfg.her:
                         max_steps = self.config.max_episode_steps or 1000
-                        if collect_budget >= max_steps:
+                        while collect_budget >= max_steps:
                             self._her_collect_episode()
                             collect_budget -= max_steps
                     elif self.is_jax_env:
                         per_iter = cfg.num_envs * self.segment_len
-                        if collect_budget >= per_iter:
+                        while collect_budget >= per_iter:
                             self._collect_once()
                             collect_budget -= per_iter
                     elif self.has_pool:
                         per_iter = cfg.num_envs
-                        if collect_budget >= per_iter:
+                        while collect_budget >= per_iter:
                             self._pool_collect_steps(per_iter)
                             collect_budget -= per_iter
                     else:
@@ -642,33 +662,53 @@ class Trainer:
                             self._host_collect_steps(n)
                             collect_budget -= n
 
-                with annotate("host/sample"):
-                    batch = self._sample()
-                indices = batch.pop("indices", None)
-                dev_batch = {k: jnp.asarray(v) for k, v in batch.items()}
-                # dispatch is async: the TPU runs while we write back the
-                # PREVIOUS step's priorities and sample the next batch
-                with annotate("host/dispatch"):
-                    self.state, metrics, priorities = self._train_step(
-                        self.state, dev_batch
-                    )
+                if K == 1:
+                    with annotate("host/sample"):
+                        batch = self._sample()
+                    indices = batch.pop("indices", None)
+                    dev_batch = {k: jnp.asarray(v) for k, v in batch.items()}
+                    # dispatch is async: the TPU runs while we write back the
+                    # PREVIOUS step's priorities and sample the next batch
+                    with annotate("host/dispatch"):
+                        self.state, metrics, priorities = self._train_step(
+                            self.state, dev_batch
+                        )
+                else:
+                    # K host-sampled batches → one lax.scan dispatch; the
+                    # per-call latency (the dominant cost on remote TPUs) is
+                    # paid once per K grad steps
+                    with annotate("host/sample"):
+                        samples = [self._sample() for _ in range(K)]
+                    indices = [s.pop("indices", None) for s in samples]
+                    dev_batch = {
+                        k: jnp.asarray(np.stack([s[k] for s in samples]))
+                        for k in samples[0]
+                    }
+                    with annotate("host/dispatch"):
+                        self.state, metrics_k, priorities = self._fused_step(
+                            self.state, dev_batch
+                        )
+                    metrics = jax.tree.map(lambda x: x.mean(), metrics_k)
                 if pending is not None and self.config.prioritized:
-                    prev_idx, prev_pri = pending
                     with annotate("host/priority_writeback"):
-                        pri = np.asarray(prev_pri)
-                        with self._buffer_lock:
-                            self.buffer.update_priorities(prev_idx, pri)
+                        self._write_back(pending)
                 pending = (indices, priorities)
-                grad_steps_done += 1
-                self.grad_steps += 1
-                self._learner_steps += 1
-                if cfg.async_collect and grad_steps_done % cfg.publish_interval == 0:
+                grad_steps_done += K
+                self.grad_steps += K
+                self._learner_steps += K
+                if cfg.async_collect and (
+                    grad_steps_done // cfg.publish_interval
+                    > (grad_steps_done - K) // cfg.publish_interval
+                ):
                     self._publish_params()
 
                 step = grad_steps_done
-                if step % cfg.eval_interval == 0 or step == total:
+                crossed = lambda interval: (
+                    step // interval > (step - K) // interval
+                )
+                if crossed(cfg.eval_interval) or step >= total:
                     last = self._periodic(step, metrics, t_start, grad_steps_done)
-                if step % cfg.checkpoint_interval == 0 or step == total:
+                if crossed(cfg.checkpoint_interval) or step >= total:
                     self.ckpt.save(self.grad_steps, self.state)
         finally:
             if tracing:
@@ -676,11 +716,22 @@ class Trainer:
             if cfg.async_collect:
                 self._stop_collector()
         if pending is not None and self.config.prioritized:
-            pri = np.asarray(pending[1])
-            with self._buffer_lock:
-                self.buffer.update_priorities(pending[0], pri)
+            self._write_back(pending)
         self.ckpt.wait()
         return last
+
+    def _write_back(self, pending) -> None:
+        """Flush one dispatch's PER priorities: ([B] idx, [B] pri) for K=1,
+        (list of K [B] idx, [K, B] pri) for fused dispatches."""
+        idx, pri_dev = pending
+        pri = np.asarray(pri_dev)
+        with self._buffer_lock:
+            if isinstance(idx, list):
+                for k, ix in enumerate(idx):
+                    if ix is not None:
+                        self.buffer.update_priorities(ix, pri[k])
+            elif idx is not None:
+                self.buffer.update_priorities(idx, pri)
 
     def _host_eval(self) -> dict:
         """Greedy eval episodes through a host env (reference main.py:309-347)."""

@@ -122,3 +122,41 @@ def test_mesh_validation():
         make_mesh(dp=16, tp=1)  # only 8 devices
     mesh = make_mesh(tp=2)
     assert mesh.shape["dp"] == 4 and mesh.shape["tp"] == 2
+
+
+def test_dp_fused_scan_matches_sequential_steps():
+    """K fused grad steps under DP must equal K sequential DP steps: same
+    final params, same per-step priorities."""
+    from d4pg_tpu.parallel.dp import make_dp_fused_train_step
+
+    config = D4PGConfig(obs_dim=3, action_dim=1, hidden_sizes=(32, 32))
+    key = jax.random.PRNGKey(1)
+    state_seq = create_train_state(config, key)
+    state_fused = create_train_state(config, key)
+
+    mesh = make_mesh(dp=8, tp=1)
+    seq_step = make_dp_train_step(config, mesh, donate=False)
+    fused_step = make_dp_fused_train_step(config, mesh, donate=False)
+    state_seq = replicate(state_seq, mesh)
+    state_fused = replicate(state_fused, mesh)
+
+    rng = np.random.default_rng(3)
+    K = 4
+    batches = [_batch(rng) for _ in range(K)]
+    pris = []
+    for b in batches:
+        state_seq, _, p = seq_step(state_seq, b)
+        pris.append(np.asarray(p))
+    stacked = {k: jnp.stack([b[k] for b in batches]) for k in batches[0]}
+    state_fused, metrics_k, pri_k = fused_step(state_fused, stacked)
+
+    assert np.asarray(metrics_k["critic_loss"]).shape == (K,)
+    np.testing.assert_allclose(
+        np.asarray(pri_k), np.stack(pris), rtol=1e-4, atol=1e-6
+    )
+    diff = jax.tree_util.tree_map(
+        lambda a, b: float(jnp.abs(a - b).max()),
+        jax.device_get(state_seq.critic_params),
+        jax.device_get(state_fused.critic_params),
+    )
+    assert max(jax.tree_util.tree_leaves(diff)) < 1e-5

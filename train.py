@@ -69,6 +69,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="categorical projection backend (pallas = custom TPU kernel)")
     p.add_argument("--total-steps", type=int, default=100_000,
                    help="learner grad steps to run")
+    p.add_argument("--steps-per-dispatch", type=int, default=1,
+                   help="grad steps fused into one device dispatch (K>1 "
+                        "amortizes dispatch latency; PER priorities update "
+                        "once per dispatch)")
     p.add_argument("--eval-interval", type=int, default=2_000)
     p.add_argument("--eval-episodes", type=int, default=10)
     p.add_argument("--checkpoint-interval", type=int, default=10_000)
@@ -121,6 +125,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         total_steps=args.total_steps,
         warmup_steps=args.warmup_steps,
         batch_size=args.batch_size,
+        steps_per_dispatch=args.steps_per_dispatch,
         replay_capacity=args.replay_capacity,
         prioritized=args.prioritized,
         n_step=args.n_step,
