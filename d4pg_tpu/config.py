@@ -49,6 +49,10 @@ class TrainConfig:
     # both sides (collector throttles ahead, learner waits when starved).
     async_collect: bool = False
     publish_interval: int = 10         # grad steps between param publications
+    # Actor-pool worker start method. "spawn" keeps children JAX-free (safe
+    # with an initialized TPU client); "fork" starts much faster on few-core
+    # hosts since children inherit the parent's imports.
+    pool_start_method: str = "spawn"
 
     # replay. Capacity None = "unset": resolved to the env preset's cap if
     # any, else 1M (reference --rmsize default) — a sentinel, so an explicit

@@ -47,7 +47,13 @@ def _worker(conn, env_id: str, max_episode_steps: Optional[int], base_seed: int)
                 conn.send(env.reset(seed=seed))
             elif cmd == "step":
                 obs2, r, term, trunc, info = env.step(msg[1])
-                success = bool(info.get("is_success", False)) if isinstance(info, dict) else False
+                # tri-state: None = env doesn't report is_success (callers
+                # fall back to terminal termination, reference main.py:327)
+                success = (
+                    bool(info["is_success"])
+                    if isinstance(info, dict) and "is_success" in info
+                    else None
+                )
                 if term or trunc:
                     episode += 1
                     obs_next = env.reset(seed=base_seed + episode)
@@ -102,14 +108,16 @@ class HostActorPool:
         """Step all envs with canonical (−1,1) actions [N, act_dim].
 
         Returns ``(next_obs, rewards, terminated, truncated, policy_obs,
-        success)`` — all stacked over the actor axis. ``next_obs`` is the
-        transition's successor (store this); ``policy_obs`` already reflects
-        any auto-reset (act on this).
+        success, success_reported)`` — all stacked over the actor axis.
+        ``next_obs`` is the transition's successor (store this);
+        ``policy_obs`` already reflects any auto-reset (act on this);
+        ``success`` is only meaningful where ``success_reported`` (the env
+        actually emitted ``is_success``) is True.
         """
         actions = np.asarray(actions)
         for i, c in enumerate(self._conns):
             c.send(("step", actions[i]))
-        obs2, rews, terms, truncs, pol_obs, succ = [], [], [], [], [], []
+        obs2, rews, terms, truncs, pol_obs, succ, succ_rep = [], [], [], [], [], [], []
         for c in self._conns:
             o2, r, te, tr, on, s = c.recv()
             obs2.append(o2)
@@ -117,7 +125,8 @@ class HostActorPool:
             terms.append(te)
             truncs.append(tr)
             pol_obs.append(on)
-            succ.append(s)
+            succ.append(bool(s) if s is not None else False)
+            succ_rep.append(s is not None)
         return (
             np.stack(obs2).astype(np.float32),
             np.asarray(rews, np.float32),
@@ -125,6 +134,7 @@ class HostActorPool:
             np.asarray(truncs, bool),
             np.stack(pol_obs).astype(np.float32),
             np.asarray(succ, bool),
+            np.asarray(succ_rep, bool),
         )
 
     def close(self) -> None:

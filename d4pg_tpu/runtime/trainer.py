@@ -186,6 +186,7 @@ class Trainer:
         self._collector: Optional[threading.Thread] = None
         self._collector_error: Optional[BaseException] = None
         self._actor_pub = None  # published param copy the async collector acts on
+        self._eval_pool = None  # lazy parallel eval envs (host pool mode)
         # Trainer-lifetime grad-step counter for async pacing. Deliberately
         # NOT self.grad_steps: that one is restored from checkpoints, which
         # would make a resumed learner wait for ratio·(all past steps) of
@@ -321,7 +322,11 @@ class Trainer:
 
         cfg = self.config
         self.pool = HostActorPool(
-            cfg.env, cfg.num_envs, cfg.max_episode_steps, seed=cfg.seed
+            cfg.env,
+            cfg.num_envs,
+            cfg.max_episode_steps,
+            seed=cfg.seed,
+            start_method=cfg.pool_start_method,
         )
         self.has_pool = True
         self.writers = [
@@ -379,7 +384,7 @@ class Trainer:
                 scale,
             )
             actions = np.asarray(a_dev)
-            obs2, rews, terms, truncs, pol_obs, _succ = self.pool.step(actions)
+            obs2, rews, terms, truncs, pol_obs, _succ, _rep = self.pool.step(actions)
             with self._buffer_lock:
                 for i in range(N):
                     self.writers[i].add(
@@ -733,13 +738,65 @@ class Trainer:
             elif idx is not None:
                 self.buffer.update_priorities(idx, pri)
 
+    def _pool_eval(self) -> dict:
+        """All eval episodes in parallel through a dedicated actor pool —
+        one batched device call per env step instead of per episode-step,
+        so eval cost is amortized eval_episodes-fold (it is dispatch-latency
+        bound on remote TPUs, same as collection)."""
+        from d4pg_tpu.runtime.actor_pool import HostActorPool
+
+        cfg = self.config
+        n = cfg.eval_episodes
+        if self._eval_pool is None:
+            self._eval_pool = HostActorPool(
+                cfg.env,
+                n,
+                cfg.max_episode_steps,
+                seed=cfg.seed + 977_777,
+                start_method=cfg.pool_start_method,
+            )
+        obs = self._eval_pool.reset_all()
+        alive = np.ones(n, bool)
+        rets = np.zeros(n, np.float64)
+        ep_success = np.zeros(n, bool)
+        eval_act = self._get_eval_act()
+        for _ in range(cfg.max_episode_steps or 1000):
+            a = np.asarray(eval_act(self.state.actor_params, jnp.asarray(obs)))
+            obs2, r, term, trunc, pol_obs, s, s_rep = self._eval_pool.step(a)
+            rets += r * alive
+            # final-step semantics, matching the single-env path: the
+            # episode's success is is_success at its last step if the env
+            # reports it, else terminal termination (reference main.py:327)
+            done_now = (term | trunc) & alive
+            final = np.where(s_rep, s, term)
+            ep_success = np.where(done_now, final, ep_success)
+            alive &= ~(term | trunc)
+            obs = pol_obs
+            if not alive.any():
+                break
+        return {
+            "eval_return_mean": float(rets.mean()),
+            "eval_return_std": float(rets.std()),
+            "success_rate": float(ep_success.mean()),
+        }
+
+    def _get_eval_act(self):
+        """Cached jitted greedy-actor forward (a fresh lambda per eval would
+        retrace and recompile at every eval interval)."""
+        if getattr(self, "_eval_act", None) is None:
+            agent_cfg = self.config.agent
+            self._eval_act = jax.jit(
+                lambda p, o: act_deterministic(agent_cfg, p, o)
+            )
+        return self._eval_act
+
     def _host_eval(self) -> dict:
         """Greedy eval episodes through a host env (reference main.py:309-347)."""
         cfg = self.config
+        if self.has_pool and cfg.eval_episodes > 1:
+            return self._pool_eval()
         rets, succ = [], 0
-        eval_act = jax.jit(
-            lambda p, o: act_deterministic(cfg.agent, p, o)
-        )
+        eval_act = self._get_eval_act()
         for _ in range(cfg.eval_episodes):
             obs = self.env.reset()
             ep_ret, term, trunc = 0.0, False, False
@@ -800,5 +857,7 @@ class Trainer:
         self.ckpt.close()
         if self.has_pool:
             self.pool.close()
+        if self._eval_pool is not None:
+            self._eval_pool.close()
         if hasattr(self.env, "close"):
             self.env.close()

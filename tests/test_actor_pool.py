@@ -32,13 +32,15 @@ class TestHostActorPool:
             assert obs.shape == (3, 3) and obs.dtype == np.float32
             rng = np.random.default_rng(0)
             for t in range(10):
-                obs2, r, term, trunc, pol, succ = pool.step(_random_actions(rng, 3))
+                obs2, r, term, trunc, pol, succ, succ_rep = pool.step(_random_actions(rng, 3))
             # all three hit the TimeLimit on step 10 and auto-reset
             assert trunc.all() and not term.any()
             # the policy obs is the fresh post-reset state, not the terminal one
             assert not np.allclose(pol, obs2)
             assert obs2.shape == pol.shape == (3, 3)
             assert r.shape == (3,) and succ.shape == (3,)
+            # Pendulum reports no is_success -> tri-state collapses to unreported
+            assert not succ_rep.any() and not succ.any()
         finally:
             pool.close()
 
@@ -212,3 +214,20 @@ def test_gym_adapter_imports_without_jax():
     flag, envs = out.stdout.strip().split(" ", 1)
     assert flag == "True", "gym_adapter import loaded jax"
     assert envs == "[]", f"gym_adapter import loaded JAX env modules: {envs}"
+
+
+def test_pool_eval_parallel(tmp_path):
+    """Host eval routes through a parallel eval pool when eval_episodes > 1:
+    one batched act per env step across all episodes."""
+    from d4pg_tpu.runtime.trainer import Trainer
+
+    t = Trainer(
+        _cfg(log_dir=str(tmp_path / "run"), eval_episodes=3, total_steps=2)
+    )
+    try:
+        out = t.train()
+        assert t._eval_pool is not None and t._eval_pool.num_actors == 3
+        assert np.isfinite(out["eval_return_mean"])
+        assert out["eval_return_std"] >= 0.0
+    finally:
+        t.close()

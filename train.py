@@ -69,6 +69,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="categorical projection backend (pallas = custom TPU kernel)")
     p.add_argument("--total-steps", type=int, default=100_000,
                    help="learner grad steps to run")
+    p.add_argument("--env-steps-per-train-step", type=float, default=1.0,
+                   help="collect:train ratio (env steps per grad step); "
+                        "enforced from both sides in --async-collect mode")
+    p.add_argument("--pool-start-method", choices=["spawn", "fork", "forkserver"],
+                   default="spawn",
+                   help="actor-pool worker start method; spawn keeps children "
+                        "JAX-free, fork starts faster on few-core hosts")
     p.add_argument("--steps-per-dispatch", type=int, default=1,
                    help="grad steps fused into one device dispatch (K>1 "
                         "amortizes dispatch latency; PER priorities update "
@@ -126,6 +133,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         warmup_steps=args.warmup_steps,
         batch_size=args.batch_size,
         steps_per_dispatch=args.steps_per_dispatch,
+        env_steps_per_train_step=args.env_steps_per_train_step,
+        pool_start_method=args.pool_start_method,
         replay_capacity=args.replay_capacity,
         prioritized=args.prioritized,
         n_step=args.n_step,
