@@ -55,6 +55,13 @@ class GymAdapter:
             # reference overrides _max_episode_steps (main.py:69)
             env = _gym.wrappers.TimeLimit(env.unwrapped, max_episode_steps)
         self.env = env
+        # Effective episode limit (explicit override, else the registry's),
+        # surfaced so trainers don't guess-rewrap with a different limit.
+        self.max_episode_steps = (
+            max_episode_steps
+            if max_episode_steps is not None
+            else getattr(getattr(env, "spec", None), "max_episode_steps", None)
+        )
         space = env.action_space
         if not hasattr(space, "high"):
             raise ValueError(

@@ -16,6 +16,9 @@ Protocol (pipe messages, parent → child):
     ("reset", seed)      → child replies flat obs [obs_dim]
     ("step", action)     → child replies (next_obs, reward, terminated,
                            truncated, obs_after_autoreset, is_success)
+    ("step_goal", action)→ same plus the pre/post-step goal views
+                           (observation, achieved_goal, desired_goal) for
+                           HER relabeling — goal-dict envs only
     ("close",)           → child exits
 ``next_obs`` is the true successor state (what replay must store);
 ``obs_after_autoreset`` is what the policy sees next (== next_obs unless the
@@ -37,6 +40,15 @@ def _worker(conn, env_id: str, max_episode_steps: Optional[int], base_seed: int)
 
     env = GymAdapter(env_id, max_episode_steps)
     episode = 0
+
+    def goal_view():
+        g = env.last_goal_obs
+        return (
+            np.ravel(g["observation"]).astype(np.float32),
+            np.ravel(g["achieved_goal"]).astype(np.float32),
+            np.ravel(g["desired_goal"]).astype(np.float32),
+        )
+
     try:
         while True:
             msg = conn.recv()
@@ -45,8 +57,11 @@ def _worker(conn, env_id: str, max_episode_steps: Optional[int], base_seed: int)
                 seed = msg[1] if msg[1] is not None else base_seed + episode
                 episode += 1
                 conn.send(env.reset(seed=seed))
-            elif cmd == "step":
+            elif cmd in ("step", "step_goal"):
+                with_goals = cmd == "step_goal"
+                g0 = goal_view() if with_goals else None
                 obs2, r, term, trunc, info = env.step(msg[1])
+                g1 = goal_view() if with_goals else None  # before any autoreset
                 # tri-state: None = env doesn't report is_success (callers
                 # fall back to terminal termination, reference main.py:327)
                 success = (
@@ -59,7 +74,10 @@ def _worker(conn, env_id: str, max_episode_steps: Optional[int], base_seed: int)
                     obs_next = env.reset(seed=base_seed + episode)
                 else:
                     obs_next = obs2
-                conn.send((obs2, r, term, trunc, obs_next, success))
+                if with_goals:
+                    conn.send((obs2, r, term, trunc, obs_next, success, g0, g1))
+                else:
+                    conn.send((obs2, r, term, trunc, obs_next, success))
             elif cmd == "close":
                 break
     finally:
@@ -114,12 +132,29 @@ class HostActorPool:
         ``success`` is only meaningful where ``success_reported`` (the env
         actually emitted ``is_success``) is True.
         """
+        return self._step_cmd(actions, "step")
+
+    def step_goal(self, actions: np.ndarray):
+        """Like :meth:`step`, but additionally returns each actor's pre- and
+        post-step goal views ``(observation, achieved_goal, desired_goal)``
+        for HER relabeling. Goal-dict envs only.
+
+        Returns ``(next_obs, rewards, terminated, truncated, policy_obs,
+        success, success_reported, goals_prev, goals_next)`` where the goal
+        lists hold per-actor triples of flat float32 arrays.
+        """
+        return self._step_cmd(actions, "step_goal")
+
+    def _step_cmd(self, actions: np.ndarray, cmd: str):
+        with_goals = cmd == "step_goal"
         actions = np.asarray(actions)
         for i, c in enumerate(self._conns):
-            c.send(("step", actions[i]))
+            c.send((cmd, actions[i]))
         obs2, rews, terms, truncs, pol_obs, succ, succ_rep = [], [], [], [], [], [], []
+        g_prev, g_next = [], []
         for c in self._conns:
-            o2, r, te, tr, on, s = c.recv()
+            reply = c.recv()
+            o2, r, te, tr, on, s = reply[:6]
             obs2.append(o2)
             rews.append(r)
             terms.append(te)
@@ -127,7 +162,10 @@ class HostActorPool:
             pol_obs.append(on)
             succ.append(bool(s) if s is not None else False)
             succ_rep.append(s is not None)
-        return (
+            if with_goals:
+                g_prev.append(reply[6])
+                g_next.append(reply[7])
+        out = (
             np.stack(obs2).astype(np.float32),
             np.asarray(rews, np.float32),
             np.asarray(terms, bool),
@@ -136,6 +174,7 @@ class HostActorPool:
             np.asarray(succ, bool),
             np.asarray(succ_rep, bool),
         )
+        return out + (g_prev, g_next) if with_goals else out
 
     def close(self) -> None:
         if self._closed:

@@ -91,7 +91,10 @@ def _reconcile_config(config: TrainConfig, env) -> TrainConfig:
         )
     max_steps = config.max_episode_steps
     if max_steps is None:
-        max_steps = getattr(env, "max_episode_steps", 1000)
+        # None from the env (registered without a time limit) still gets the
+        # 1000-step default: pool workers must truncate for noise resets and
+        # HER episode flushes to ever fire.
+        max_steps = getattr(env, "max_episode_steps", None) or 1000
     replay_capacity = config.replay_capacity
     if replay_capacity is None:
         from d4pg_tpu.config import DEFAULT_REPLAY_CAPACITY
@@ -384,17 +387,39 @@ class Trainer:
                 scale,
             )
             actions = np.asarray(a_dev)
-            obs2, rews, terms, truncs, pol_obs, _succ, _rep = self.pool.step(actions)
-            with self._buffer_lock:
+            if cfg.her:
+                (obs2, rews, terms, truncs, pol_obs, _succ, _rep,
+                 g_prev, g_next) = self.pool.step_goal(actions)
                 for i in range(N):
-                    self.writers[i].add(
-                        self._pool_obs[i],
-                        actions[i],
-                        float(rews[i]),
-                        obs2[i],
+                    self.her_writers[i].add(
+                        observation=g_prev[i][0],
+                        achieved_goal=g_prev[i][1],
+                        desired_goal=g_prev[i][2],
+                        action=actions[i],
+                        reward=float(rews[i]),
+                        next_observation=g_next[i][0],
+                        next_achieved_goal=g_next[i][1],
                         terminated=bool(terms[i]),
-                        truncated=bool(truncs[i]),
                     )
+                    if terms[i] or truncs[i]:
+                        with self._buffer_lock:
+                            self.her_writers[i].end_episode(
+                                truncated=not bool(terms[i])
+                            )
+            else:
+                obs2, rews, terms, truncs, pol_obs, _succ, _rep = self.pool.step(
+                    actions
+                )
+                with self._buffer_lock:
+                    for i in range(N):
+                        self.writers[i].add(
+                            self._pool_obs[i],
+                            actions[i],
+                            float(rews[i]),
+                            obs2[i],
+                            terminated=bool(terms[i]),
+                            truncated=bool(truncs[i]),
+                        )
             done = terms | truncs
             if done.any():
                 self._pool_noise = self._pool_reset_noise(
@@ -418,7 +443,7 @@ class Trainer:
         try:
             while not self._stop_collect.is_set():
                 target = cfg.warmup_steps + ratio * self._learner_steps + slack
-                if self.env_steps >= target:
+                if self.env_steps >= target and len(self.buffer) >= cfg.batch_size:
                     time.sleep(0.002)
                     continue
                 noise = 3.0 if self.env_steps < cfg.warmup_steps else None
@@ -459,6 +484,17 @@ class Trainer:
             self._collector = None
 
     # ------------------------------------------------------------------- HER
+    def _make_her_writer(self, reward_fn) -> HindsightWriter:
+        cfg = self.config
+        return HindsightWriter(
+            writer_factory=lambda: NStepWriter(
+                self.buffer, cfg.n_step, cfg.agent.gamma
+            ),
+            compute_reward=reward_fn,
+            k_future=cfg.her_k,
+            rng=self._rng,
+        )
+
     def _setup_her(self):
         cfg = self.config
         env = self.env
@@ -470,14 +506,18 @@ class Trainer:
             reward_fn = env.compute_reward
         else:
             raise ValueError(f"--her needs a goal env, got {cfg.env}")
-        self.her_writer = HindsightWriter(
-            writer_factory=lambda: NStepWriter(
-                self.buffer, cfg.n_step, cfg.agent.gamma
-            ),
-            compute_reward=reward_fn,
-            k_future=cfg.her_k,
-            rng=self._rng,
-        )
+        if getattr(env, "is_goal_env", False) and (
+            cfg.num_envs > 1 or cfg.async_collect
+        ):
+            # HER at scale: the pool collects with goal views (step_goal) and
+            # each actor owns a HindsightWriter, so hindsight relabeling
+            # composes with parallel + async collection.
+            self._setup_pool_collect()
+            self.her_writers = [
+                self._make_her_writer(reward_fn) for _ in range(cfg.num_envs)
+            ]
+            return
+        self.her_writer = self._make_her_writer(reward_fn)
         agent_cfg = cfg.agent
         noise_sample = self._noise_sample
         self._her_noise = self._noise_init()
@@ -575,13 +615,16 @@ class Trainer:
         """Pre-fill replay with high-noise exploration (reference
         ``warmup()``, ``main.py:200-207``)."""
         cfg = self.config
-        while self.env_steps < cfg.warmup_steps:
-            if cfg.her:
+        # Env-step count alone is not enough in HER pool mode: hindsight
+        # writers only flush at episode boundaries, so keep collecting until
+        # the buffer can actually serve a batch.
+        while self.env_steps < cfg.warmup_steps or len(self.buffer) < cfg.batch_size:
+            if cfg.her and not self.has_pool:
                 self._her_collect_episode(noise_scale=3.0)
-            elif self.is_jax_env:
-                self._collect_once(noise_scale=3.0)
             elif self.has_pool:
                 self._pool_collect_steps(self.config.num_envs * 8, noise_scale=3.0)
+            elif self.is_jax_env:
+                self._collect_once(noise_scale=3.0)
             else:
                 self._host_collect_steps(64, noise_scale=3.0)
 
@@ -635,23 +678,25 @@ class Trainer:
                     profiled = True
                 if cfg.async_collect:
                     # pacing: never outrun the actors' env:train ratio
-                    # (lifetime counter, so chunked train() calls keep collecting)
+                    # (lifetime counter, so chunked train() calls keep
+                    # collecting), and never sample a buffer that can't
+                    # serve a batch (HER flushes only at episode ends)
                     while (
                         self.env_steps
                         < cfg.warmup_steps
                         + cfg.env_steps_per_train_step * self._learner_steps
-                    ):
+                    ) or len(self.buffer) < cfg.batch_size:
                         self._check_collector_alive()
                         time.sleep(0.001)
                 else:
                     # interleave collection to hold the env:train ratio (sync modes)
                     collect_budget += cfg.env_steps_per_train_step * K
-                    if cfg.her:
+                    if cfg.her and not self.has_pool:
                         max_steps = self.config.max_episode_steps or 1000
                         while collect_budget >= max_steps:
                             self._her_collect_episode()
                             collect_budget -= max_steps
-                    elif self.is_jax_env:
+                    elif self.is_jax_env and not self.has_pool:
                         per_iter = cfg.num_envs * self.segment_len
                         while collect_budget >= per_iter:
                             self._collect_once()

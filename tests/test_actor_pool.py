@@ -231,3 +231,124 @@ def test_pool_eval_parallel(tmp_path):
         assert out["eval_return_std"] >= 0.0
     finally:
         t.close()
+
+
+GOAL_ENV = "toy_goal_env:ToyGoal-v0"
+
+
+class TestHERPool:
+    def test_step_goal_views(self):
+        """step_goal returns consistent pre/post goal views: prev.next == next
+        under the flat obs the policy sees."""
+        pool = HostActorPool(GOAL_ENV, 2, max_episode_steps=25, seed=0)
+        try:
+            obs = pool.reset_all(seed=0)
+            assert obs.shape == (2, 4)  # concat(observation, desired_goal)
+            a = np.full((2, 2), 0.5, np.float32)
+            obs2, r, term, trunc, pol, s, s_rep, g0, g1 = pool.step_goal(a)
+            assert s_rep.all()  # the env reports is_success
+            for i in range(2):
+                o0, ag0, dg0 = g0[i]
+                o1, ag1, dg1 = g1[i]
+                # achieved goal == observation in this env; goal fixed
+                np.testing.assert_allclose(o0, ag0)
+                np.testing.assert_allclose(dg0, dg1)
+                # flat next_obs is concat(next observation, goal)
+                np.testing.assert_allclose(obs2[i], np.concatenate([o1, dg1]))
+        finally:
+            pool.close()
+
+    def test_her_pool_trains_and_relabels(self, tmp_path):
+        """HER through the pool: original + relabeled transitions land in
+        replay, training runs, and the env actually solves-ish under noise
+        (toy env is trivially reachable)."""
+        from d4pg_tpu.runtime.trainer import Trainer
+
+        cfg = apply_env_preset(
+            TrainConfig(
+                env=GOAL_ENV,
+                num_envs=2,
+                her=True,
+                her_k=2,
+                n_step=1,
+                total_steps=4,
+                warmup_steps=60,
+                batch_size=16,
+                replay_capacity=4_000,
+                eval_interval=4,
+                eval_episodes=2,
+                checkpoint_interval=10**6,
+                log_dir=str(tmp_path / "run"),
+            )
+        )
+        t = Trainer(cfg)
+        try:
+            assert t.has_pool and len(t.her_writers) == 2
+            out = t.train()
+            # HER adds relabeled copies: stored transitions exceed env steps
+            assert len(t.buffer) > 60
+            assert np.isfinite(out["critic_loss"])
+            assert 0.0 <= out["success_rate"] <= 1.0
+        finally:
+            t.close()
+
+    def test_her_pool_async(self, tmp_path):
+        from d4pg_tpu.runtime.trainer import Trainer
+
+        cfg = apply_env_preset(
+            TrainConfig(
+                env=GOAL_ENV,
+                num_envs=2,
+                her=True,
+                her_k=1,
+                n_step=1,
+                total_steps=4,
+                warmup_steps=60,
+                batch_size=16,
+                replay_capacity=4_000,
+                eval_interval=4,
+                eval_episodes=1,
+                checkpoint_interval=10**6,
+                async_collect=True,
+                log_dir=str(tmp_path / "run"),
+            )
+        )
+        t = Trainer(cfg)
+        try:
+            out = t.train()
+            assert t._collector is None
+            assert len(t.buffer) > 60
+            assert np.isfinite(out["critic_loss"])
+        finally:
+            t.close()
+
+    def test_her_pool_warmup_fills_buffer(self, tmp_path):
+        """Warmup must not exit before the buffer can serve a batch: HER
+        only flushes at episode ends, so step-counted warmup alone could
+        leave replay empty (division-by-zero in PER sampling)."""
+        from d4pg_tpu.runtime.trainer import Trainer
+
+        cfg = apply_env_preset(
+            TrainConfig(
+                env=GOAL_ENV,
+                num_envs=2,
+                her=True,
+                her_k=1,
+                n_step=1,
+                total_steps=2,
+                warmup_steps=4,  # far less than one 25-step episode
+                batch_size=16,
+                replay_capacity=2_000,
+                eval_interval=100,
+                eval_episodes=1,
+                checkpoint_interval=10**6,
+                log_dir=str(tmp_path / "run"),
+            )
+        )
+        t = Trainer(cfg)
+        try:
+            out = t.train()
+            assert len(t.buffer) >= 16
+            assert np.isfinite(out["critic_loss"])
+        finally:
+            t.close()

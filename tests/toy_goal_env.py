@@ -1,0 +1,51 @@
+"""A tiny goal-dict gymnasium env for exercising the HER pool path.
+
+Point on a 2-D plane; action moves it; success when within 0.1 of the goal.
+Sparse reward 0/-1 and an ``is_success`` info flag — the same contract as the
+robotics envs the reference's HER loop targets (``main.py:144-184``).
+
+Made with the module-prefixed id ``"toy_goal_env:ToyGoal-v0"`` so that
+spawned actor-pool workers can resolve it: gymnasium imports this module
+(which registers the env) in the child process before ``gym.make``.
+"""
+
+import gymnasium as gym
+import numpy as np
+from gymnasium import spaces
+
+
+class ToyGoalEnv(gym.Env):
+    def __init__(self):
+        box = spaces.Box(-1.0, 1.0, (2,), np.float32)
+        self.observation_space = spaces.Dict(
+            {"observation": box, "achieved_goal": box, "desired_goal": box}
+        )
+        self.action_space = spaces.Box(-1.0, 1.0, (2,), np.float32)
+        self._pos = np.zeros(2, np.float32)
+        self._goal = np.zeros(2, np.float32)
+
+    def _obs(self):
+        return {
+            "observation": self._pos.copy(),
+            "achieved_goal": self._pos.copy(),
+            "desired_goal": self._goal.copy(),
+        }
+
+    def reset(self, *, seed=None, options=None):
+        super().reset(seed=seed)
+        self._pos = self.np_random.uniform(-1, 1, 2).astype(np.float32)
+        self._goal = self.np_random.uniform(-1, 1, 2).astype(np.float32)
+        return self._obs(), {}
+
+    def compute_reward(self, achieved_goal, desired_goal, info):
+        d = np.linalg.norm(np.asarray(achieved_goal) - np.asarray(desired_goal), axis=-1)
+        return -(d >= 0.1).astype(np.float32)
+
+    def step(self, action):
+        self._pos = np.clip(self._pos + 0.2 * np.asarray(action, np.float32), -1, 1)
+        r = float(self.compute_reward(self._pos, self._goal, {}))
+        success = r == 0.0
+        return self._obs(), r, bool(success), False, {"is_success": success}
+
+
+gym.register(id="ToyGoal-v0", entry_point=ToyGoalEnv, max_episode_steps=25)
