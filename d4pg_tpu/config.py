@@ -70,6 +70,10 @@ class TrainConfig:
     log_dir: str = "runs/default"
     checkpoint_interval: int = 10_000
     resume: bool = False
+    # Also snapshot the replay buffer alongside each checkpoint (latest
+    # only) and restore it on --resume, so resumed runs don't restart from
+    # an empty buffer + fresh warmup. Costs disk + a few seconds per save.
+    snapshot_replay: bool = False
     # capture a jax.profiler trace of grad steps [10, 60) into this dir
     profile_dir: Optional[str] = None
 

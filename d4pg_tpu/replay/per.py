@@ -107,6 +107,28 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         batch["weights"] = weights.astype(np.float32)
         return batch
 
+    def _snapshot_arrays(self) -> dict:
+        data = super()._snapshot_arrays()
+        n = self._size
+        data["tree_priorities"] = self._sum.get(np.arange(n))  # α-exponentiated
+        data["max_priority"] = np.asarray(self._max_priority)
+        return data
+
+    def _restore_arrays(self, data) -> int:
+        n = super()._restore_arrays(data)
+        if "tree_priorities" in data:
+            idx = np.arange(n)
+            pa = np.asarray(data["tree_priorities"], np.float64)
+            self._sum.set(idx, pa)
+            self._min.set(idx, pa)
+            self._max_priority = float(np.asarray(data["max_priority"]).item())
+        else:  # snapshot from a uniform buffer: seed with max priority
+            idx = np.arange(n)
+            p = np.full(n, self._max_priority**self.alpha)
+            self._sum.set(idx, p)
+            self._min.set(idx, p)
+        return n
+
     def update_priorities(self, indices: np.ndarray, priorities: np.ndarray) -> None:
         """(|priority| + ε)^α into both trees (reference ``:315-335``)."""
         priorities = np.abs(np.asarray(priorities, np.float64)) + self.eps

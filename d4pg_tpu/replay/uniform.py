@@ -116,3 +116,61 @@ class ReplayBuffer:
         """Uniform sample of stacked arrays (reference ``replay_memory.py:61-80``)."""
         idx = rng.integers(0, self._size, size=batch_size)
         return self.gather(idx)
+
+    # ------------------------------------------------------------- snapshot
+    def _snapshot_arrays(self) -> dict:
+        """Stored rows in ring order [0, size) — caller holds no lock."""
+        n = self._size
+        return {
+            "obs": self.obs[:n],
+            "action": self.action[:n],
+            "reward": self.reward[:n],
+            "next_obs": self.next_obs[:n],
+            "discount": self.discount[:n],
+            "pos": np.asarray(self._pos),
+            "size": np.asarray(n),
+        }
+
+    def snapshot(self, path: str) -> None:
+        """Write the buffer contents to ``path`` (.npz, atomic via rename).
+
+        The reference checkpoints nothing but network weights (SURVEY.md §5
+        'checkpoint/resume'); without this, --resume restarts with an empty
+        replay and repays the whole warmup in fresh interaction.
+        """
+        import os
+
+        with self._lock:
+            # Real copies: collector threads keep mutating the live arrays
+            # while the (seconds-long) compression below runs unlocked.
+            data = {k: np.array(v, copy=True) for k, v in self._snapshot_arrays().items()}
+        tmp = f"{path}.tmp.npz"  # savez appends .npz unless present
+        np.savez_compressed(tmp, **data)
+        os.replace(tmp, path)
+
+    def _restore_arrays(self, data) -> int:
+        n = int(np.asarray(data["size"]).item())
+        if n > self.capacity:
+            raise ValueError(
+                f"snapshot holds {n} rows > capacity {self.capacity}; "
+                "raise --rmsize to restore it"
+            )
+        if data["obs"].shape[1] != self.obs.shape[1]:
+            raise ValueError("snapshot obs_dim does not match this buffer")
+        self.obs[:n] = data["obs"]
+        self.action[:n] = data["action"]
+        self.reward[:n] = data["reward"]
+        self.next_obs[:n] = data["next_obs"]
+        self.discount[:n] = data["discount"]
+        self._size = n
+        # Same capacity → resume the saved write head so FIFO eviction order
+        # survives a wrapped ring; different capacity → data sits at [0, n).
+        saved_pos = int(np.asarray(data["pos"]).item())
+        self._pos = saved_pos if n == self.capacity else n % self.capacity
+        return n
+
+    def restore(self, path: str) -> int:
+        """Load a :meth:`snapshot`; returns the number of rows restored."""
+        with np.load(path, allow_pickle=False) as data:
+            with self._lock:
+                return self._restore_arrays(data)

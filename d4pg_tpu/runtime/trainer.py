@@ -20,6 +20,7 @@ device mesh.
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 import time
 from typing import Optional
@@ -174,9 +175,15 @@ class Trainer:
         self.metrics = MetricsLogger(config.log_dir)
         self.ckpt = CheckpointManager(f"{config.log_dir}/checkpoints")
         self.grad_steps = 0
+        self._replay_restored = False
         if config.resume and self.ckpt.latest_step() is not None:
             self.state = self.ckpt.restore(self.state)
             self.grad_steps = int(jax.device_get(self.state.step))
+            snap = self._replay_snapshot_path()
+            if config.snapshot_replay and os.path.exists(snap):
+                n = self.buffer.restore(snap)
+                self._replay_restored = True
+                print(f"restored replay snapshot: {n} transitions")
 
         self.env_steps = 0
         self.ewma_return: Optional[float] = None
@@ -202,6 +209,11 @@ class Trainer:
             self._setup_sync_collect()
         else:
             self._setup_host_collect()
+
+    def _effective_warmup(self) -> int:
+        """Warmup env-steps still owed: zero once a replay snapshot was
+        restored (that experience already paid its warmup)."""
+        return 0 if self._replay_restored else self.config.warmup_steps
 
     def _noise_scale(self) -> float:
         """Exploration scale schedule over env steps (constant when
@@ -442,11 +454,11 @@ class Trainer:
         slack = max(cfg.num_envs * 4, 64)
         try:
             while not self._stop_collect.is_set():
-                target = cfg.warmup_steps + ratio * self._learner_steps + slack
+                target = self._effective_warmup() + ratio * self._learner_steps + slack
                 if self.env_steps >= target and len(self.buffer) >= cfg.batch_size:
                     time.sleep(0.002)
                     continue
-                noise = 3.0 if self.env_steps < cfg.warmup_steps else None
+                noise = 3.0 if self.env_steps < self._effective_warmup() else None
                 self._pool_collect_steps(cfg.num_envs, noise_scale=noise)
         except BaseException as e:  # surfaced by the learner's pacing loop
             self._collector_error = e
@@ -617,8 +629,12 @@ class Trainer:
         cfg = self.config
         # Env-step count alone is not enough in HER pool mode: hindsight
         # writers only flush at episode boundaries, so keep collecting until
-        # the buffer can actually serve a batch.
-        while self.env_steps < cfg.warmup_steps or len(self.buffer) < cfg.batch_size:
+        # the buffer can actually serve a batch. A restored replay snapshot
+        # already paid its warmup — don't recollect it.
+        while (
+            self.env_steps < self._effective_warmup()
+            or len(self.buffer) < cfg.batch_size
+        ):
             if cfg.her and not self.has_pool:
                 self._her_collect_episode(noise_scale=3.0)
             elif self.has_pool:
@@ -683,7 +699,7 @@ class Trainer:
                     # serve a batch (HER flushes only at episode ends)
                     while (
                         self.env_steps
-                        < cfg.warmup_steps
+                        < self._effective_warmup()
                         + cfg.env_steps_per_train_step * self._learner_steps
                     ) or len(self.buffer) < cfg.batch_size:
                         self._check_collector_alive()
@@ -759,7 +775,7 @@ class Trainer:
                 if crossed(cfg.eval_interval) or step >= total:
                     last = self._periodic(step, metrics, t_start, grad_steps_done)
                 if crossed(cfg.checkpoint_interval) or step >= total:
-                    self.ckpt.save(self.grad_steps, self.state)
+                    self._save_checkpoint()
         finally:
             if tracing:
                 jax.profiler.stop_trace()
@@ -769,6 +785,15 @@ class Trainer:
             self._write_back(pending)
         self.ckpt.wait()
         return last
+
+    def _replay_snapshot_path(self) -> str:
+        return os.path.join(self.config.log_dir, "checkpoints", "replay.npz")
+
+    def _save_checkpoint(self) -> None:
+        self.ckpt.save(self.grad_steps, self.state)
+        if self.config.snapshot_replay:
+            with annotate("host/replay_snapshot"):
+                self.buffer.snapshot(self._replay_snapshot_path())
 
     def _write_back(self, pending) -> None:
         """Flush one dispatch's PER priorities: ([B] idx, [B] pri) for K=1,

@@ -157,3 +157,89 @@ def test_linear_schedule_pure():
     assert linear_schedule(20, 10, 1.0, 0.0) == 0.0
     # calling twice does not change the result (no reference quirk #8)
     assert linear_schedule(5, 10, 1.0, 0.0) == 0.5
+
+
+class TestReplaySnapshot:
+    def test_uniform_roundtrip(self, tmp_path):
+        from d4pg_tpu.replay import ReplayBuffer
+        from d4pg_tpu.replay.uniform import Transition
+
+        rng = np.random.default_rng(0)
+        buf = ReplayBuffer(100, 4, 2)
+        buf.add_batch(Transition(
+            rng.normal(size=(30, 4)).astype(np.float32),
+            rng.uniform(-1, 1, (30, 2)).astype(np.float32),
+            rng.normal(size=30).astype(np.float32),
+            rng.normal(size=(30, 4)).astype(np.float32),
+            np.full(30, 0.99, np.float32)))
+        path = str(tmp_path / "replay.npz")
+        buf.snapshot(path)
+        buf2 = ReplayBuffer(100, 4, 2)
+        assert buf2.restore(path) == 30
+        assert len(buf2) == 30
+        got = buf2.gather(np.arange(30))
+        want = buf.gather(np.arange(30))
+        for k in got:
+            np.testing.assert_array_equal(got[k], want[k])
+        # ring continues correctly after restore
+        buf2.add(np.zeros(4), np.zeros(2), 0.0, np.zeros(4), 0.99)
+        assert len(buf2) == 31
+
+    def test_per_roundtrip_preserves_priorities(self, tmp_path):
+        from d4pg_tpu.replay import PrioritizedReplayBuffer
+        from d4pg_tpu.replay.uniform import Transition
+
+        rng = np.random.default_rng(1)
+        buf = PrioritizedReplayBuffer(64, 3, 1, tree_backend="numpy")
+        buf.add_batch(Transition(
+            rng.normal(size=(20, 3)).astype(np.float32),
+            rng.uniform(-1, 1, (20, 1)).astype(np.float32),
+            rng.normal(size=20).astype(np.float32),
+            rng.normal(size=(20, 3)).astype(np.float32),
+            np.full(20, 0.99, np.float32)))
+        buf.update_priorities(np.arange(20), rng.uniform(0.1, 5.0, 20))
+        path = str(tmp_path / "replay.npz")
+        buf.snapshot(path)
+        buf2 = PrioritizedReplayBuffer(64, 3, 1, tree_backend="numpy")
+        assert buf2.restore(path) == 20
+        np.testing.assert_allclose(
+            buf2._sum.get(np.arange(20)), buf._sum.get(np.arange(20)))
+        assert buf2._max_priority == buf._max_priority
+        # sampling statistics stay proportional after restore
+        b = buf2.sample(16, np.random.default_rng(2), step=0)
+        assert np.isfinite(b["weights"]).all()
+
+    def test_restore_capacity_mismatch(self, tmp_path):
+        from d4pg_tpu.replay import ReplayBuffer
+        from d4pg_tpu.replay.uniform import Transition
+
+        buf = ReplayBuffer(50, 2, 1)
+        buf.add_batch(Transition(
+            np.zeros((40, 2), np.float32), np.zeros((40, 1), np.float32),
+            np.zeros(40, np.float32), np.zeros((40, 2), np.float32),
+            np.ones(40, np.float32)))
+        path = str(tmp_path / "replay.npz")
+        buf.snapshot(path)
+        small = ReplayBuffer(10, 2, 1)
+        with pytest.raises(ValueError, match="capacity"):
+            small.restore(path)
+
+    def test_wrapped_ring_restores_write_head(self, tmp_path):
+        from d4pg_tpu.replay import ReplayBuffer
+        from d4pg_tpu.replay.uniform import Transition
+
+        buf = ReplayBuffer(8, 2, 1)
+        mk = lambda lo, hi: Transition(
+            np.arange(lo, hi, dtype=np.float32).reshape(-1, 1).repeat(2, 1),
+            np.zeros((hi - lo, 1), np.float32),
+            np.arange(lo, hi, dtype=np.float32),
+            np.zeros((hi - lo, 2), np.float32),
+            np.ones(hi - lo, np.float32))
+        buf.add_batch(mk(0, 11))  # wraps: pos = 3
+        assert buf._pos == 3 and len(buf) == 8
+        path = str(tmp_path / "r.npz")
+        buf.snapshot(path)
+        buf2 = ReplayBuffer(8, 2, 1)
+        buf2.restore(path)
+        assert buf2._pos == 3  # FIFO order resumes where it left off
+        np.testing.assert_array_equal(buf2.reward, buf.reward)

@@ -158,3 +158,39 @@ def test_trainer_fused_dispatch(tmp_path):
         assert t.buffer._max_priority != 1.0
     finally:
         t.close()
+
+
+def test_snapshot_replay_resume_skips_warmup(tmp_path):
+    """--snapshot-replay: a resumed trainer restores the buffer and does not
+    recollect warmup (the snapshot already paid it)."""
+    from d4pg_tpu.config import TrainConfig, apply_env_preset
+    from d4pg_tpu.runtime.trainer import Trainer
+
+    kw = dict(
+        env="pendulum",
+        num_envs=4,
+        total_steps=2,
+        warmup_steps=150,
+        batch_size=32,
+        replay_capacity=2_000,
+        eval_interval=100,
+        eval_episodes=1,
+        checkpoint_interval=2,
+        snapshot_replay=True,
+        log_dir=str(tmp_path / "run"),
+    )
+    t = Trainer(apply_env_preset(TrainConfig(**kw)))
+    t.train()
+    saved = len(t.buffer)
+    t.close()
+    assert saved >= 150
+
+    t2 = Trainer(apply_env_preset(TrainConfig(**kw, resume=True)))
+    try:
+        assert t2._replay_restored and len(t2.buffer) == saved
+        t2.train()
+        # warmup skipped: only incidental collection happened
+        assert t2.env_steps < 150
+        assert t2.grad_steps == 4
+    finally:
+        t2.close()

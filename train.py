@@ -84,6 +84,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--eval-episodes", type=int, default=10)
     p.add_argument("--checkpoint-interval", type=int, default=10_000)
     p.add_argument("--resume", action="store_true")
+    p.add_argument("--snapshot-replay", action="store_true",
+                   help="save/restore the replay buffer with checkpoints so "
+                        "--resume keeps its experience")
     p.add_argument("--lr-actor", type=float, default=1e-4)
     p.add_argument("--lr-critic", type=float, default=1e-4)
     p.add_argument("--seed", type=int, default=0)
@@ -144,6 +147,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         log_dir=log_dir,
         checkpoint_interval=args.checkpoint_interval,
         resume=args.resume,
+        snapshot_replay=args.snapshot_replay,
         profile_dir=args.profile_dir,
         dp=args.dp,
         tp=args.tp,
