@@ -119,6 +119,12 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         if "tree_priorities" in data:
             idx = np.arange(n)
             pa = np.asarray(data["tree_priorities"], np.float64)
+            # A snapshot can catch a row between the ring write and the tree
+            # write (two lock acquisitions in add_batch): its leaf reads as
+            # the sum tree's neutral 0. Restored as-is, 0 would poison the
+            # min tree (min()==0 → all IS weights collapse) with no repair
+            # path since a never-sampled row never gets a priority update.
+            pa = np.maximum(pa, self.eps**self.alpha)
             self._sum.set(idx, pa)
             self._min.set(idx, pa)
             self._max_priority = float(np.asarray(data["max_priority"]).item())
@@ -127,6 +133,13 @@ class PrioritizedReplayBuffer(ReplayBuffer):
             p = np.full(n, self._max_priority**self.alpha)
             self._sum.set(idx, p)
             self._min.set(idx, p)
+        # Clear any stale mass beyond the snapshot (restoring into a
+        # previously used buffer): leftover leaves would draw prefix-sum
+        # samples that the idx clamp folds onto row n-1, oversampling it.
+        if n < self.capacity:
+            tail = np.arange(n, self.capacity)
+            self._sum.set(tail, np.zeros(tail.shape))
+            self._min.set(tail, np.full(tail.shape, np.inf))
         return n
 
     def update_priorities(self, indices: np.ndarray, priorities: np.ndarray) -> None:

@@ -119,7 +119,8 @@ class ReplayBuffer:
 
     # ------------------------------------------------------------- snapshot
     def _snapshot_arrays(self) -> dict:
-        """Stored rows in ring order [0, size) — caller holds no lock."""
+        """Stored rows in ring order [0, size) as LIVE VIEWS. The caller
+        MUST hold self._lock and copy every value before releasing it."""
         n = self._size
         return {
             "obs": self.obs[:n],

@@ -175,18 +175,28 @@ class Trainer:
         self.metrics = MetricsLogger(config.log_dir)
         self.ckpt = CheckpointManager(f"{config.log_dir}/checkpoints")
         self.grad_steps = 0
+        self.env_steps = 0
+        self.ewma_return: Optional[float] = None
         self._replay_restored = False
         if config.resume and self.ckpt.latest_step() is not None:
+            import json
+
             self.state = self.ckpt.restore(self.state)
             self.grad_steps = int(jax.device_get(self.state.step))
+            meta = self._trainer_meta_path()
+            if os.path.exists(meta):
+                with open(meta) as f:
+                    m = json.load(f)
+                # env_steps drives the noise-decay schedule; without it a
+                # resumed run would re-explore at full scale
+                self.env_steps = int(m.get("env_steps", 0))
+                self.ewma_return = m.get("ewma_return")
             snap = self._replay_snapshot_path()
             if config.snapshot_replay and os.path.exists(snap):
                 n = self.buffer.restore(snap)
                 self._replay_restored = True
                 print(f"restored replay snapshot: {n} transitions")
 
-        self.env_steps = 0
-        self.ewma_return: Optional[float] = None
         self._rng = np.random.default_rng(config.seed)
         self._noise_init, self._noise_sample, self._noise_reset = make_noise(agent_cfg)
 
@@ -789,8 +799,22 @@ class Trainer:
     def _replay_snapshot_path(self) -> str:
         return os.path.join(self.config.log_dir, "checkpoints", "replay.npz")
 
+    def _trainer_meta_path(self) -> str:
+        return os.path.join(self.config.log_dir, "checkpoints", "trainer_meta.json")
+
     def _save_checkpoint(self) -> None:
+        import json
+
         self.ckpt.save(self.grad_steps, self.state)
+        # Host-side counters the device TrainState doesn't carry: env_steps
+        # drives the noise-decay schedule, so without it every --resume
+        # would restart exploration at full scale.
+        tmp = self._trainer_meta_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {"env_steps": self.env_steps, "ewma_return": self.ewma_return}, f
+            )
+        os.replace(tmp, self._trainer_meta_path())
         if self.config.snapshot_replay:
             with annotate("host/replay_snapshot"):
                 self.buffer.snapshot(self._replay_snapshot_path())

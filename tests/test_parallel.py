@@ -160,3 +160,13 @@ def test_dp_fused_scan_matches_sequential_steps():
         jax.device_get(state_fused.critic_params),
     )
     assert max(jax.tree_util.tree_leaves(diff)) < 1e-5
+
+
+def test_initialize_distributed_single_host():
+    """Single-host no-op path returns the process/device summary."""
+    from d4pg_tpu.parallel.distributed import initialize_distributed
+
+    info = initialize_distributed()
+    assert info["process_index"] == 0
+    assert info["process_count"] == 1
+    assert info["global_device_count"] == 8

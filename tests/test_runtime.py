@@ -188,9 +188,50 @@ def test_snapshot_replay_resume_skips_warmup(tmp_path):
     t2 = Trainer(apply_env_preset(TrainConfig(**kw, resume=True)))
     try:
         assert t2._replay_restored and len(t2.buffer) == saved
+        start = t2.env_steps  # restored from trainer meta, not re-collected
         t2.train()
         # warmup skipped: only incidental collection happened
-        assert t2.env_steps < 150
+        assert t2.env_steps - start < 150
         assert t2.grad_steps == 4
+    finally:
+        t2.close()
+
+
+def test_resume_restores_env_steps_and_noise_schedule(tmp_path):
+    """env_steps (which drives noise decay) survives resume via the trainer
+    meta file; exploration does not restart at full scale."""
+    from d4pg_tpu.config import TrainConfig, apply_env_preset
+    from d4pg_tpu.runtime.trainer import Trainer
+    import dataclasses
+
+    kw = dict(
+        env="pendulum",
+        num_envs=4,
+        total_steps=2,
+        warmup_steps=100,
+        batch_size=32,
+        replay_capacity=2_000,
+        eval_interval=100,
+        eval_episodes=1,
+        checkpoint_interval=2,
+        log_dir=str(tmp_path / "run"),
+    )
+    cfg = apply_env_preset(TrainConfig(**kw))
+    cfg = dataclasses.replace(
+        cfg, agent=dataclasses.replace(cfg.agent, noise_decay_steps=120)
+    )
+    t = Trainer(cfg)
+    t.train()
+    steps1 = t.env_steps
+    scale1 = t._noise_scale()
+    t.close()
+    assert steps1 >= 100 and scale1 < 1.0
+
+    cfg2 = dataclasses.replace(cfg, resume=True)
+    t2 = Trainer(cfg2)
+    try:
+        assert t2.env_steps == steps1
+        assert t2._noise_scale() == pytest.approx(scale1)
+        assert t2.ewma_return is not None
     finally:
         t2.close()

@@ -50,6 +50,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ou-mu", type=float, default=0.0)
     p.add_argument("--noise", choices=["gaussian", "ou"], default="gaussian")
     p.add_argument("--noise-epsilon", type=float, default=0.3)
+    p.add_argument("--noise-decay-steps", type=int, default=0,
+                   help="env steps to linearly anneal exploration scale to "
+                        "--noise-scale-final (0 = constant, the reference's "
+                        "effective behavior, SURVEY.md quirk #10)")
+    p.add_argument("--noise-scale-final", type=float, default=0.1)
     # TPU-native flags
     p.add_argument("--num-envs", type=int, default=16,
                    help="vectorized on-device exploration envs, or host actor "
@@ -112,6 +117,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         lr_critic=args.lr_critic,
         noise_kind=args.noise,
         noise_epsilon=args.noise_epsilon,
+        noise_decay_steps=args.noise_decay_steps,
+        noise_scale_final=args.noise_scale_final,
         ou_theta=args.ou_theta,
         ou_sigma=args.ou_sigma,
         ou_mu=args.ou_mu,
