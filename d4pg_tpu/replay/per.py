@@ -124,10 +124,12 @@ class PrioritizedReplayBuffer(ReplayBuffer):
             # the sum tree's neutral 0. Restored as-is, 0 would poison the
             # min tree (min()==0 → all IS weights collapse) with no repair
             # path since a never-sampled row never gets a priority update.
-            pa = np.maximum(pa, self.eps**self.alpha)
+            # Give such rows the max-priority seed add_batch would have —
+            # flooring at the minimum would instead starve them forever.
+            self._max_priority = float(np.asarray(data["max_priority"]).item())
+            pa = np.where(pa <= 0.0, self._max_priority**self.alpha, pa)
             self._sum.set(idx, pa)
             self._min.set(idx, pa)
-            self._max_priority = float(np.asarray(data["max_priority"]).item())
         else:  # snapshot from a uniform buffer: seed with max priority
             idx = np.arange(n)
             p = np.full(n, self._max_priority**self.alpha)

@@ -806,6 +806,10 @@ class Trainer:
         import json
 
         self.ckpt.save(self.grad_steps, self.state)
+        # Finalize the (async) Orbax write before the side files: a crash
+        # between them must never leave meta/replay newer than the newest
+        # restorable checkpoint.
+        self.ckpt.wait()
         # Host-side counters the device TrainState doesn't carry: env_steps
         # drives the noise-decay schedule, so without it every --resume
         # would restart exploration at full scale.
