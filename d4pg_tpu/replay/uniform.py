@@ -13,6 +13,7 @@ fixes the reference's dead/inconsistent n-step path (SURVEY.md quirk #3/#5).
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Mapping, NamedTuple
 
@@ -139,8 +140,6 @@ class ReplayBuffer:
         'checkpoint/resume'); without this, --resume restarts with an empty
         replay and repays the whole warmup in fresh interaction.
         """
-        import os
-
         with self._lock:
             # Real copies: collector threads keep mutating the live arrays
             # while the (seconds-long) compression below runs unlocked.

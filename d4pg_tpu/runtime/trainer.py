@@ -20,6 +20,7 @@ device mesh.
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 import threading
 import time
@@ -179,8 +180,6 @@ class Trainer:
         self.ewma_return: Optional[float] = None
         self._replay_restored = False
         if config.resume and self.ckpt.latest_step() is not None:
-            import json
-
             self.state = self.ckpt.restore(self.state)
             self.grad_steps = int(jax.device_get(self.state.step))
             meta = self._trainer_meta_path()
@@ -645,10 +644,10 @@ class Trainer:
             self.env_steps < self._effective_warmup()
             or len(self.buffer) < cfg.batch_size
         ):
-            if cfg.her and not self.has_pool:
-                self._her_collect_episode(noise_scale=3.0)
-            elif self.has_pool:
+            if self.has_pool:  # pool mode handles HER internally
                 self._pool_collect_steps(self.config.num_envs * 8, noise_scale=3.0)
+            elif cfg.her:
+                self._her_collect_episode(noise_scale=3.0)
             elif self.is_jax_env:
                 self._collect_once(noise_scale=3.0)
             else:
@@ -717,20 +716,20 @@ class Trainer:
                 else:
                     # interleave collection to hold the env:train ratio (sync modes)
                     collect_budget += cfg.env_steps_per_train_step * K
-                    if cfg.her and not self.has_pool:
+                    if self.has_pool:  # pool mode handles HER internally
+                        per_iter = cfg.num_envs
+                        while collect_budget >= per_iter:
+                            self._pool_collect_steps(per_iter)
+                            collect_budget -= per_iter
+                    elif cfg.her:
                         max_steps = self.config.max_episode_steps or 1000
                         while collect_budget >= max_steps:
                             self._her_collect_episode()
                             collect_budget -= max_steps
-                    elif self.is_jax_env and not self.has_pool:
+                    elif self.is_jax_env:
                         per_iter = cfg.num_envs * self.segment_len
                         while collect_budget >= per_iter:
                             self._collect_once()
-                            collect_budget -= per_iter
-                    elif self.has_pool:
-                        per_iter = cfg.num_envs
-                        while collect_budget >= per_iter:
-                            self._pool_collect_steps(per_iter)
                             collect_budget -= per_iter
                     else:
                         n = int(collect_budget)
@@ -772,16 +771,13 @@ class Trainer:
                 grad_steps_done += K
                 self.grad_steps += K
                 self._learner_steps += K
-                if cfg.async_collect and (
-                    grad_steps_done // cfg.publish_interval
-                    > (grad_steps_done - K) // cfg.publish_interval
-                ):
-                    self._publish_params()
-
                 step = grad_steps_done
-                crossed = lambda interval: (
-                    step // interval > (step - K) // interval
-                )
+
+                def crossed(interval: int) -> bool:
+                    return step // interval > (step - K) // interval
+
+                if cfg.async_collect and crossed(cfg.publish_interval):
+                    self._publish_params()
                 if crossed(cfg.eval_interval) or step >= total:
                     last = self._periodic(step, metrics, t_start, grad_steps_done)
                 if crossed(cfg.checkpoint_interval) or step >= total:
@@ -803,8 +799,6 @@ class Trainer:
         return os.path.join(self.config.log_dir, "checkpoints", "trainer_meta.json")
 
     def _save_checkpoint(self) -> None:
-        import json
-
         self.ckpt.save(self.grad_steps, self.state)
         # Finalize the (async) Orbax write before the side files: a crash
         # between them must never leave meta/replay newer than the newest
