@@ -49,10 +49,28 @@ class TrainConfig:
     # both sides (collector throttles ahead, learner waits when starved).
     async_collect: bool = False
     publish_interval: int = 10         # grad steps between param publications
+    # Flush PER priorities from a background thread instead of blocking the
+    # learner loop on the device→host fetch. The thread drains everything
+    # queued since its last wake, concatenates on device, and pays ONE
+    # fetch for the whole group — so it keeps up at any dispatch rate (on a
+    # tunneled chip a fetch is a ~100 ms link round-trip; synchronous
+    # write-back caps the whole learner at ~10 fetches/s). Priorities go a
+    # few hundred grad steps stale at high rates — the same staleness class
+    # as K-step dispatch and the reference's Hogwild asynchrony.
+    async_priority_writeback: bool = False
     # Actor-pool worker start method. "spawn" keeps children JAX-free (safe
     # with an initialized TPU client); "fork" starts much faster on few-core
     # hosts since children inherit the parent's imports.
     pool_start_method: str = "spawn"
+    # Where host-env collection/eval forwards run: "cpu" jits the actor on
+    # the host CPU backend against published numpy params, "default" uses
+    # the accelerator, "auto" picks cpu whenever the default backend is an
+    # accelerator. The 3×256 actor forward is microseconds on CPU; through
+    # a remote/tunneled TPU each act is a full link round-trip (measured
+    # ~100 ms — it gated collection at ~55 env-steps/s). The BASELINE
+    # north-star layout — actors on TPU-VM host CPU, learner on chip — is
+    # exactly this. Pure-JAX envs ignore it (their rollout IS the device).
+    actor_device: str = "auto"
 
     # replay. Capacity None = "unset": resolved to the env preset's cap if
     # any, else 1M (reference --rmsize default) — a sentinel, so an explicit

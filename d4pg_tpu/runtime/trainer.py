@@ -22,6 +22,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import queue
 import threading
 import time
 from typing import Optional
@@ -199,11 +200,33 @@ class Trainer:
         self._rng = np.random.default_rng(config.seed)
         self._noise_init, self._noise_sample, self._noise_reset = make_noise(agent_cfg)
 
+        # Host-env acting backend (config.actor_device). On a remote/tunneled
+        # chip every device call from the collection loop is a full link
+        # round-trip (~100 ms measured) while the actor MLP itself is
+        # microseconds on CPU — so host-env collection defaults to a
+        # CPU-jitted actor fed published numpy params, the BASELINE
+        # north-star "CPU actors + TPU learner" split.
+        if config.actor_device == "auto":
+            self._act_backend = "cpu" if jax.default_backend() != "cpu" else None
+        elif config.actor_device == "cpu":
+            self._act_backend = "cpu"
+        elif config.actor_device == "default":
+            self._act_backend = None
+        else:
+            raise ValueError(
+                f"actor_device must be auto|cpu|default, got {config.actor_device!r}"
+            )
+        self._cpu_params = None
+        self._cpu_params_step = -1
+
         self.has_pool = False
         self._buffer_lock = threading.Lock()
         self._stop_collect = threading.Event()
         self._collector: Optional[threading.Thread] = None
         self._collector_error: Optional[BaseException] = None
+        self._wb_queue: Optional[queue.Queue] = None
+        self._wb_thread: Optional[threading.Thread] = None
+        self._wb_error: Optional[BaseException] = None
         self._actor_pub = None  # published param copy the async collector acts on
         self._eval_pool = None  # lazy parallel eval envs (host pool mode)
         # Trainer-lifetime grad-step counter for async pacing. Deliberately
@@ -218,6 +241,42 @@ class Trainer:
             self._setup_sync_collect()
         else:
             self._setup_host_collect()
+
+    def _act_jit(self, fn):
+        """jit for the host-env acting paths. Placement is carried by the
+        operands, not the jit: in CPU-acting mode every stateful input
+        (params, PRNG key, noise state) is committed to the CPU device via
+        ``jax.device_put`` and jit follows committed inputs — this keeps the
+        C++ fast dispatch path (a ``jax.default_device`` context or the
+        deprecated ``backend=`` argument forces Python dispatch, ~2 ms/call,
+        which would eat the entire win)."""
+        return jax.jit(fn)
+
+    def _to_act_device(self, tree):
+        """Commit a pytree to the acting backend's device (identity unless
+        CPU acting). Committed inputs pin every downstream jit/eager op —
+        including the per-step ``jax.random.split`` chain — to that device;
+        on a remote default device each such op is a link round-trip."""
+        if self._act_backend == "cpu":
+            return jax.device_put(tree, jax.devices("cpu")[0])
+        return tree
+
+    def _acting_params(self):
+        """Actor params as the acting backend consumes them.
+
+        Async mode: the published copy (never the live donated state — the
+        collector thread must not touch buffers the learner donates into
+        dispatches). Sync modes: the live state, copied to the acting device
+        at most once per grad step when acting on CPU.
+        """
+        if self._actor_pub is not None:
+            return self._actor_pub
+        if self._act_backend != "cpu":
+            return self.state.actor_params
+        if self._cpu_params is None or self._cpu_params_step != self.grad_steps:
+            self._cpu_params = self._to_act_device(self.state.actor_params)
+            self._cpu_params_step = self.grad_steps
+        return self._cpu_params
 
     def _effective_warmup(self) -> int:
         """Warmup env-steps still owed: zero once a replay snapshot was
@@ -303,7 +362,6 @@ class Trainer:
             return
         self.writers = [NStepWriter(self.buffer, cfg.n_step, cfg.agent.gamma)]
         self._host_obs = self.env.reset(seed=cfg.seed)
-        self._host_noise = self._noise_init()
         agent_cfg = cfg.agent
         noise_sample = self._noise_sample
 
@@ -312,16 +370,20 @@ class Trainer:
             n, nstate = noise_sample(nstate, k, a.shape)
             return jnp.clip(a + scale * n, -1.0, 1.0), nstate
 
-        self._host_act = jax.jit(host_act)
+        self._host_act = self._act_jit(host_act)
+        self._host_noise = self._to_act_device(self._noise_init())
+        self.key, hk = jax.random.split(self.key)
+        self._host_key = self._to_act_device(hk)
 
     def _host_collect_steps(self, num_steps: int, noise_scale: Optional[float] = None):
         w = self.writers[0]
         scale = self._noise_scale() if noise_scale is None else noise_scale
+        params = self._acting_params()
         for _ in range(num_steps):
-            self.key, k = jax.random.split(self.key)
+            self._host_key, k = jax.random.split(self._host_key)
             a_dev, self._host_noise = self._host_act(
-                self.state.actor_params,
-                jnp.asarray(self._host_obs)[None],
+                params,
+                np.asarray(self._host_obs)[None],
                 k,
                 self._host_noise,
                 scale,
@@ -358,8 +420,8 @@ class Trainer:
             for _ in range(cfg.num_envs)
         ]
         self._pool_obs = self.pool.reset_all(seed=cfg.seed)
-        self._pool_noise = jax.vmap(lambda _: self._noise_init())(
-            jnp.arange(cfg.num_envs)
+        self._pool_noise = self._to_act_device(
+            jax.vmap(lambda _: self._noise_init())(jnp.arange(cfg.num_envs))
         )
         agent_cfg = cfg.agent
         noise_sample, noise_reset = self._noise_sample, self._noise_reset
@@ -383,11 +445,12 @@ class Trainer:
 
             return jax.tree.map(sel, fresh, nstates)
 
-        self._pool_act = jax.jit(pool_act)
-        self._pool_reset_noise = jax.jit(pool_reset_noise)
+        self._pool_act = self._act_jit(pool_act)
+        self._pool_reset_noise = self._act_jit(pool_reset_noise)
         # The pool has its own key stream so a background collector never
         # races the learner thread on self.key.
-        self.key, self._collect_key = jax.random.split(self.key)
+        self.key, ck = jax.random.split(self.key)
+        self._collect_key = self._to_act_device(ck)
 
     def _pool_collect_steps(self, num_steps: int, noise_scale: Optional[float] = None):
         """Collect ≈num_steps env steps across all pool actors (rounded up
@@ -395,14 +458,12 @@ class Trainer:
         cfg = self.config
         scale = self._noise_scale() if noise_scale is None else noise_scale
         N = cfg.num_envs
-        # Async mode acts on the published copy (the live state's buffers are
-        # donated into each train step and must not be read concurrently).
-        params = self._actor_pub if self._actor_pub is not None else self.state.actor_params
+        params = self._acting_params()
         for _ in range(max(1, -(-num_steps // N))):
             self._collect_key, k = jax.random.split(self._collect_key)
             a_dev, self._pool_noise = self._pool_act(
                 params,
-                jnp.asarray(self._pool_obs),
+                np.asarray(self._pool_obs),
                 k,
                 self._pool_noise,
                 scale,
@@ -444,18 +505,28 @@ class Trainer:
             done = terms | truncs
             if done.any():
                 self._pool_noise = self._pool_reset_noise(
-                    self._pool_noise, jnp.asarray(done)
+                    self._pool_noise, np.asarray(done)
                 )
             self._pool_obs = pol_obs
             self.env_steps += N
 
     # ----------------------------------------------------------------- async
     def _publish_params(self):
-        """Device-side copy of actor params for the collector thread (the
-        live state is donated into every train step, so it must never be
-        read concurrently — this is the 'weight publication to host actors'
-        leg of the actor/learner decomposition)."""
-        self._actor_pub = jax.tree.map(jnp.copy, self.state.actor_params)
+        """Copy of actor params for the collector thread (the live state is
+        donated into every train step, so it must never be read concurrently
+        — this is the 'weight publication to host actors' leg of the
+        actor/learner decomposition). CPU acting publishes host numpy; the
+        collector then never touches the remote device at all."""
+        if self._act_backend == "cpu":
+            # device_get is a real copy off the device (device_put alone
+            # would ALIAS the live buffers when learner and actor share a
+            # device — and those get donated into the next dispatch);
+            # device_put then just commits the host copy to the CPU backend.
+            self._actor_pub = self._to_act_device(
+                jax.device_get(self.state.actor_params)
+            )
+        else:
+            self._actor_pub = jax.tree.map(jnp.copy, self.state.actor_params)
 
     def _collector_loop(self):
         cfg = self.config
@@ -504,6 +575,85 @@ class Trainer:
             self._collector.join(timeout=30)
             self._collector = None
 
+    # ------------------------------------------------------- async write-back
+    def _writeback_loop(self):
+        """Drain-and-batch PER priority flusher. Each wake takes everything
+        queued since the last one, concatenates the [K, B] priority blocks
+        on device, and fetches the whole group in ONE device→host transfer —
+        one link round-trip however many dispatches accumulated, so the
+        flusher keeps pace with any learner rate instead of gating it."""
+        try:
+            while True:
+                item = self._wb_queue.get()
+                stop = item is None
+                items = [] if stop else [item]
+                while True:
+                    try:
+                        nxt = self._wb_queue.get_nowait()
+                    except queue.Empty:
+                        break
+                    if nxt is None:
+                        stop = True
+                    else:
+                        items.append(nxt)
+                if items:
+                    idx_all = [ix for idxs, _ in items for ix in idxs]
+                    # Host-side concatenation consumes the async D2H copies
+                    # _queue_writeback already started (a device-side concat
+                    # would re-transfer every block a second time).
+                    pri = np.concatenate(
+                        [np.asarray(p) for _, p in items], axis=0
+                    )
+                    with self._buffer_lock:
+                        for k, ix in enumerate(idx_all):
+                            if ix is not None:
+                                self.buffer.update_priorities(ix, pri[k])
+                if stop:
+                    return
+        except BaseException as e:
+            self._wb_error = e
+            raise
+
+    def _start_writeback(self):
+        if self._wb_thread is not None and self._wb_thread.is_alive():
+            raise RuntimeError("a priority write-back thread is already running")
+        self._wb_queue = queue.Queue()
+        self._wb_error = None
+        self._wb_thread = threading.Thread(
+            target=self._writeback_loop, name="priority-writeback", daemon=True
+        )
+        self._wb_thread.start()
+
+    def _stop_writeback(self):
+        if self._wb_thread is not None:
+            self._wb_queue.put(None)
+            self._wb_thread.join(timeout=60)
+            if self._wb_thread.is_alive():
+                # Keep the references so a later _start_writeback refuses to
+                # double up; dropping them here would silently discard the
+                # still-queued priority updates.
+                raise RuntimeError(
+                    "priority write-back thread failed to drain within 60 s; "
+                    "queued priority updates were not flushed"
+                )
+            self._wb_thread = None
+        self._wb_queue = None
+
+    def _queue_writeback(self, indices, priorities) -> None:
+        """Hand one dispatch's (indices, [K, B] or [B] priorities) to the
+        flusher thread. The async D2H copy is started immediately so the
+        flusher's fetch finds the transfer already under way."""
+        if self._wb_error is not None:
+            raise RuntimeError(
+                "priority write-back thread died"
+            ) from self._wb_error
+        if not isinstance(indices, list):  # K=1 dispatch: [B] → [1, B]
+            indices = [indices]
+            priorities = priorities[None]
+        if hasattr(priorities, "copy_to_host_async"):
+            priorities.copy_to_host_async()
+        self._wb_queue.put((indices, priorities))
+
     # ------------------------------------------------------------------- HER
     def _make_her_writer(self, reward_fn) -> HindsightWriter:
         cfg = self.config
@@ -541,14 +691,23 @@ class Trainer:
         self.her_writer = self._make_her_writer(reward_fn)
         agent_cfg = cfg.agent
         noise_sample = self._noise_sample
-        self._her_noise = self._noise_init()
+        # Pure-JAX goal envs step on the default device, so their episode
+        # loop acts there too; host goal envs act on the acting backend.
+        her_on_host = not isinstance(env, PointMassGoal)
 
         def her_act(params, o, k, nstate, scale):
             a = act_deterministic(agent_cfg, params, o)[0]
             n, nstate = noise_sample(nstate, k, a.shape)
             return jnp.clip(a + scale * n, -1.0, 1.0), nstate
 
-        self._her_act = jax.jit(her_act)
+        if her_on_host:
+            self._her_act = self._act_jit(her_act)
+            self._her_noise = self._to_act_device(self._noise_init())
+            self.key, hk = jax.random.split(self.key)
+            self._her_key = self._to_act_device(hk)
+        else:
+            self._her_act = jax.jit(her_act)
+            self._her_noise = self._noise_init()
 
     def _her_collect_episode(self, noise_scale: Optional[float] = None) -> float:
         if isinstance(self.env, PointMassGoal):
@@ -602,11 +761,12 @@ class Trainer:
         obs = env.reset()
         ep_return, term, trunc = 0.0, False, False
         max_steps = self.config.max_episode_steps or 1000
+        params = self._acting_params()
         for _ in range(max_steps):
             g0 = env.last_goal_obs
-            self.key, ak = jax.random.split(self.key)
+            self._her_key, ak = jax.random.split(self._her_key)
             a_dev, self._her_noise = self._her_act(
-                self.state.actor_params, jnp.asarray(obs)[None], ak,
+                params, np.asarray(obs)[None], ak,
                 self._her_noise, scale,
             )
             a = np.asarray(a_dev)
@@ -673,6 +833,8 @@ class Trainer:
             self._start_collector()
         else:
             self.warmup()
+        if cfg.async_priority_writeback and cfg.prioritized:
+            self._start_writeback()
 
         t_start = time.monotonic()
         grad_steps_done = 0
@@ -764,10 +926,22 @@ class Trainer:
                             self.state, dev_batch
                         )
                     metrics = jax.tree.map(lambda x: x.mean(), metrics_k)
-                if pending is not None and self.config.prioritized:
-                    with annotate("host/priority_writeback"):
-                        self._write_back(pending)
-                pending = (indices, priorities)
+                if self.config.prioritized:
+                    if self._wb_thread is not None:
+                        with annotate("host/priority_writeback"):
+                            self._queue_writeback(indices, priorities)
+                    else:
+                        if pending is not None:
+                            with annotate("host/priority_writeback"):
+                                self._write_back(pending)
+                        if hasattr(priorities, "copy_to_host_async"):
+                            # Start the D2H transfer now; the one-dispatch
+                            # pipeline lag then fetches an already-copied
+                            # array. Without it the fetch is a blocking link
+                            # round-trip (~100 ms of a ~110 ms loop on a
+                            # tunneled chip).
+                            priorities.copy_to_host_async()
+                        pending = (indices, priorities)
                 grad_steps_done += K
                 self.grad_steps += K
                 self._learner_steps += K
@@ -787,6 +961,7 @@ class Trainer:
                 jax.profiler.stop_trace()
             if cfg.async_collect:
                 self._stop_collector()
+            self._stop_writeback()  # flushes everything still queued
         if pending is not None and self.config.prioritized:
             self._write_back(pending)
         self.ckpt.wait()
@@ -852,8 +1027,9 @@ class Trainer:
         rets = np.zeros(n, np.float64)
         ep_success = np.zeros(n, bool)
         eval_act = self._get_eval_act()
+        eval_params = self._eval_params()
         for _ in range(cfg.max_episode_steps or 1000):
-            a = np.asarray(eval_act(self.state.actor_params, jnp.asarray(obs)))
+            a = np.asarray(eval_act(eval_params, np.asarray(obs)))
             obs2, r, term, trunc, pol_obs, s, s_rep = self._eval_pool.step(a)
             rets += r * alive
             # final-step semantics, matching the single-env path: the
@@ -874,13 +1050,22 @@ class Trainer:
 
     def _get_eval_act(self):
         """Cached jitted greedy-actor forward (a fresh lambda per eval would
-        retrace and recompile at every eval interval)."""
+        retrace and recompile at every eval interval). Runs on the acting
+        backend: host-env eval is per-env-step act calls, the same link
+        round-trip cost profile as collection."""
         if getattr(self, "_eval_act", None) is None:
             agent_cfg = self.config.agent
-            self._eval_act = jax.jit(
+            self._eval_act = self._act_jit(
                 lambda p, o: act_deterministic(agent_cfg, p, o)
             )
         return self._eval_act
+
+    def _eval_params(self):
+        """Latest actor params for greedy eval, on the acting backend. Unlike
+        the collector this always reads the live state — eval must score the
+        current learner, not the last published copy. Called from the learner
+        thread only (no dispatch can be in flight on the donated state)."""
+        return self._to_act_device(self.state.actor_params)
 
     def _host_eval(self) -> dict:
         """Greedy eval episodes through a host env (reference main.py:309-347)."""
@@ -889,11 +1074,12 @@ class Trainer:
             return self._pool_eval()
         rets, succ = [], 0
         eval_act = self._get_eval_act()
+        eval_params = self._eval_params()
         for _ in range(cfg.eval_episodes):
             obs = self.env.reset()
             ep_ret, term, trunc = 0.0, False, False
             for _ in range(cfg.max_episode_steps or 1000):
-                a = np.asarray(eval_act(self.state.actor_params, jnp.asarray(obs)[None])[0])
+                a = np.asarray(eval_act(eval_params, np.asarray(obs)[None])[0])
                 obs, r, term, trunc, info = self.env.step(a)
                 ep_ret += r
                 if term or trunc:
@@ -945,6 +1131,7 @@ class Trainer:
 
     def close(self):
         self._stop_collector()
+        self._stop_writeback()
         self.metrics.close()
         self.ckpt.close()
         if self.has_pool:

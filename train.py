@@ -64,6 +64,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "a background thread against published actor params")
     p.add_argument("--publish-interval", type=int, default=10,
                    help="grad steps between actor-param publications (async)")
+    p.add_argument("--async-writeback", action="store_true",
+                   help="flush PER priorities from a background thread with "
+                        "one batched device fetch per wake (the sync fetch "
+                        "is a ~100 ms link round-trip on remote chips)")
     p.add_argument("--dp", type=int, default=None,
                    help="data-parallel device count (None = single device)")
     p.add_argument("--tp", type=int, default=1)
@@ -81,6 +85,12 @@ def build_parser() -> argparse.ArgumentParser:
                    default="spawn",
                    help="actor-pool worker start method; spawn keeps children "
                         "JAX-free, fork starts faster on few-core hosts")
+    p.add_argument("--actor-device", choices=["auto", "cpu", "default"],
+                   default="auto",
+                   help="backend for host-env collection/eval forwards; auto "
+                        "= CPU whenever the learner is on an accelerator "
+                        "(each act through a remote chip is a ~100 ms link "
+                        "round-trip; the actor MLP is microseconds on CPU)")
     p.add_argument("--steps-per-dispatch", type=int, default=1,
                    help="grad steps fused into one device dispatch (K>1 "
                         "amortizes dispatch latency; PER priorities update "
@@ -145,6 +155,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         steps_per_dispatch=args.steps_per_dispatch,
         env_steps_per_train_step=args.env_steps_per_train_step,
         pool_start_method=args.pool_start_method,
+        actor_device=args.actor_device,
+        async_priority_writeback=args.async_writeback,
         replay_capacity=args.replay_capacity,
         prioritized=args.prioritized,
         n_step=args.n_step,
