@@ -9,6 +9,7 @@ moment sets, step counter, PRNG key — so ``--resume`` is bit-exact.
 
 from __future__ import annotations
 
+import json
 import os
 from typing import Optional
 
@@ -50,3 +51,27 @@ class CheckpointManager:
     def close(self) -> None:
         self._mgr.wait_until_finished()
         self._mgr.close()
+
+
+def trainer_meta_path(log_dir: str) -> str:
+    return os.path.join(log_dir, "checkpoints", "trainer_meta.json")
+
+
+def save_trainer_meta(log_dir: str, env_steps: int, ewma_return) -> None:
+    """Atomically persist the host-side counters the device TrainState does
+    not carry (env_steps drives schedules; ewma keeps curves continuous).
+    Shared by the host Trainer and the on-device driver so their resume
+    metadata stays mutually readable."""
+    path = trainer_meta_path(log_dir)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"env_steps": env_steps, "ewma_return": ewma_return}, f)
+    os.replace(tmp, path)
+
+
+def load_trainer_meta(log_dir: str) -> dict:
+    path = trainer_meta_path(log_dir)
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        return json.load(f)

@@ -15,6 +15,14 @@ import time
 from typing import Mapping, Optional
 
 
+def interval_crossed(prev_step: int, step: int, interval: int) -> bool:
+    """True when advancing prev_step→step crossed a multiple of interval —
+    the shared cadence predicate for eval/checkpoint/publish schedules (train
+    loops advance in K-step dispatches, so exact multiples can be skipped
+    over)."""
+    return step // interval > prev_step // interval
+
+
 class MetricsLogger:
     def __init__(self, log_dir: str, use_tensorboard: bool = True):
         self.log_dir = log_dir

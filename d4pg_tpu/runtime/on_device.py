@@ -103,10 +103,12 @@ def make_on_device_trainer(
     batch_size: int = 256,
     train_steps_per_iter: int = 32,
 ):
-    """Build (init_fn, iterate_fn) for the fully-jitted loop.
+    """Build (init_fn, warmup_fn, iterate_fn) for the fully-jitted loop.
 
-    ``init_fn(state, key) -> carry``; ``iterate_fn(carry) -> (carry,
-    metrics)`` where one call = num_envs×segment_len env steps +
+    ``init_fn(state, key) -> carry``; ``warmup_fn(carry) -> carry`` collects
+    one num_envs×segment_len exploration segment into the device replay
+    WITHOUT training (the reference's replay pre-fill, ``main.py:200-207``);
+    ``iterate_fn(carry) -> (carry, metrics)`` = one segment +
     train_steps_per_iter grad steps, entirely on device.
     """
     n_new = num_envs * segment_len
@@ -127,10 +129,9 @@ def make_on_device_trainer(
         )
         return (state, env_states, obs, noise_states, replay, k_carry)
 
-    @jax.jit
-    def iterate_fn(carry):
-        state, env_states, obs, noise_states, replay, key = carry
-        key, k_roll, k_train = jax.random.split(key, 3)
+    def _collect(state, env_states, obs, noise_states, replay, k_roll):
+        """Steps 1-3: vmapped exploration rollout, n-step collapse, ring
+        append. Shared by warmup (collect-only) and full iterations."""
 
         # ---- 1. vmapped exploration rollout --------------------------------
         def policy(o, k, nstate):
@@ -177,6 +178,24 @@ def make_on_device_trainer(
 
         # ---- 3. ring append ------------------------------------------------
         replay = _append(replay, flat, n_new, config.per_alpha)
+        return env_states, obs, noise_states, replay, traj
+
+    @jax.jit
+    def warmup_fn(carry):
+        state, env_states, obs, noise_states, replay, key = carry
+        key, k_roll = jax.random.split(key)
+        env_states, obs, noise_states, replay, _ = _collect(
+            state, env_states, obs, noise_states, replay, k_roll
+        )
+        return (state, env_states, obs, noise_states, replay, key)
+
+    @jax.jit
+    def iterate_fn(carry):
+        state, env_states, obs, noise_states, replay, key = carry
+        key, k_roll, k_train = jax.random.split(key, 3)
+        env_states, obs, noise_states, replay, traj = _collect(
+            state, env_states, obs, noise_states, replay, k_roll
+        )
 
         # ---- 4. K train steps ----------------------------------------------
         K, B = train_steps_per_iter, batch_size
@@ -228,4 +247,170 @@ def make_on_device_trainer(
         )
         return (state, env_states, obs, noise_states, replay, key), metrics
 
-    return init_fn, iterate_fn
+    return init_fn, warmup_fn, iterate_fn
+
+
+def run_on_device(config) -> dict:
+    """CLI driver for the fully on-device loop (``train.py --on-device``).
+
+    Wraps (init_fn, iterate_fn) with the same periphery the host
+    :class:`~d4pg_tpu.runtime.trainer.Trainer` provides — greedy eval on the
+    eval cadence, EWMA return, TensorBoard/JSONL metrics, Orbax checkpoints,
+    ``--resume`` — while the training loop itself never leaves the device:
+    metrics stay as device arrays between evals (a fetch per iteration would
+    be a link round-trip), and one iteration = ``num_envs × 32`` env steps
+    plus ``round(num_envs × 32 / env_steps_per_train_step)`` grad steps, so
+    the collect:train ratio is honored exactly like the host loop.
+
+    Pure-JAX envs only. The device replay ring is rebuilt on ``--resume``
+    and re-warmed with ``warmup_steps`` of fresh exploration (ring contents
+    are not checkpointed); ``noise_decay_steps`` is not threaded into the
+    fused rollout (exploration ε is constant — the reference's effective
+    behavior, SURVEY.md quirk #10).
+    """
+    import time
+
+    from d4pg_tpu.agent import create_train_state
+    from d4pg_tpu.envs import make_env
+    from d4pg_tpu.runtime.checkpoint import (
+        CheckpointManager,
+        load_trainer_meta,
+        save_trainer_meta,
+    )
+    from d4pg_tpu.runtime.evaluator import evaluate
+    from d4pg_tpu.runtime.metrics import MetricsLogger, interval_crossed
+    from d4pg_tpu.runtime.trainer import _reconcile_config
+
+    env = make_env(config.env, config.max_episode_steps)
+    if hasattr(env, "last_goal_obs"):
+        raise ValueError(
+            "--on-device needs a pure-JAX env (pendulum, pixel_pendulum, "
+            "pointmass_goal); host gymnasium envs use the actor pool instead"
+        )
+    config = _reconcile_config(config, env)
+    agent_cfg = config.agent
+    segment_len = 32
+    n_new = config.num_envs * segment_len
+    K = max(1, round(n_new / max(config.env_steps_per_train_step, 1e-9)))
+    capacity = max(n_new, (config.replay_capacity // n_new) * n_new)
+    if capacity != config.replay_capacity:
+        print(
+            f"replay capacity {config.replay_capacity} adjusted to {capacity} "
+            f"(device ring must be a multiple of num_envs×segment_len = {n_new})"
+        )
+    init_fn, warmup_fn, iterate_fn = make_on_device_trainer(
+        agent_cfg,
+        env,
+        num_envs=config.num_envs,
+        segment_len=segment_len,
+        replay_capacity=capacity,
+        batch_size=config.batch_size,
+        train_steps_per_iter=K,
+    )
+
+    key = jax.random.PRNGKey(config.seed)
+    key, k_state = jax.random.split(key)
+    state = create_train_state(agent_cfg, k_state)
+    ckpt = CheckpointManager(f"{config.log_dir}/checkpoints")
+    env_steps = 0
+    ewma = None
+    if config.resume and ckpt.latest_step() is not None:
+        state = ckpt.restore(state)
+        meta = load_trainer_meta(config.log_dir)
+        env_steps = int(meta.get("env_steps", 0))
+        ewma = meta.get("ewma_return")
+    grad_steps = int(jax.device_get(state.step))
+    # Distinct key stream per resumed leg — replaying PRNGKey(seed) would
+    # repeat the original run's exact exploration/eval sequence every leg.
+    key = jax.random.fold_in(key, grad_steps)
+    key, k_init = jax.random.split(key)
+    carry = init_fn(state, k_init)
+    logger = MetricsLogger(config.log_dir)
+    last: dict = {}
+    total = config.total_steps
+    t0 = time.monotonic()
+    grad_steps_done = 0
+    env_steps_done = 0
+    try:
+        # Replay pre-fill without training (reference warmup, main.py:200-207).
+        # Needed after resume too: the device ring starts empty every run.
+        # Skipped when the checkpoint already satisfies total_steps — the
+        # eval-only path below never samples the ring.
+        while grad_steps < total and env_steps_done < max(
+            config.warmup_steps, config.batch_size
+        ):
+            carry = warmup_fn(carry)
+            env_steps_done += n_new
+            env_steps += n_new
+
+        def _eval_and_log(m) -> dict:
+            nonlocal ewma, last, key
+            key, ek = jax.random.split(key)
+            scalars = {k: float(v) for k, v in jax.device_get(m).items()} if m else {}
+            scalars.update(
+                evaluate(
+                    agent_cfg, env, carry[0].actor_params, ek,
+                    config.eval_episodes,
+                )
+            )
+            ewma = (
+                scalars["eval_return_mean"]
+                if ewma is None
+                else (1 - config.ewma_alpha) * ewma
+                + config.ewma_alpha * scalars["eval_return_mean"]
+            )
+            dt = time.monotonic() - t0
+            scalars.update(
+                avg_test_reward_ewma=ewma,
+                grad_steps_per_sec=grad_steps_done / dt,
+                env_steps_per_sec=env_steps_done / dt,
+                replay_size=int(jax.device_get(carry[4].size)),
+                env_steps=env_steps,
+            )
+            logger.log(grad_steps, scalars)
+            print(
+                f"[step {grad_steps}] "
+                + " ".join(
+                    f"{k}={v:.3f}"
+                    for k, v in scalars.items()
+                    if k != "replay_size"
+                )
+            )
+            last = scalars
+            return scalars
+
+        def _save():
+            ckpt.save(grad_steps, carry[0])
+            # Orbax write finishes before the meta file, so a crash between
+            # them never leaves meta newer than the newest checkpoint.
+            ckpt.wait()
+            save_trainer_meta(config.log_dir, env_steps, ewma)
+
+        if grad_steps >= total:
+            # Resumed past total_steps: report instead of silently no-opping.
+            print(
+                f"checkpoint already at step {grad_steps} >= total {total}; "
+                "running final eval only"
+            )
+            _eval_and_log(None)
+            return last
+        while grad_steps < total:
+            carry, m = iterate_fn(carry)
+            prev = grad_steps
+            grad_steps += K
+            grad_steps_done += K
+            env_steps += n_new
+            env_steps_done += n_new
+            if interval_crossed(prev, grad_steps, config.eval_interval) or (
+                grad_steps >= total
+            ):
+                _eval_and_log(m)
+            if interval_crossed(prev, grad_steps, config.checkpoint_interval) or (
+                grad_steps >= total
+            ):
+                _save()
+    finally:
+        ckpt.wait()
+        logger.close()
+        ckpt.close()
+    return last

@@ -20,7 +20,6 @@ device mesh.
 from __future__ import annotations
 
 import dataclasses
-import json
 import os
 import queue
 import threading
@@ -48,9 +47,13 @@ from d4pg_tpu.replay import (
     ReplayBuffer,
     linear_schedule,
 )
-from d4pg_tpu.runtime.checkpoint import CheckpointManager
+from d4pg_tpu.runtime.checkpoint import (
+    CheckpointManager,
+    load_trainer_meta,
+    save_trainer_meta,
+)
 from d4pg_tpu.runtime.evaluator import evaluate
-from d4pg_tpu.runtime.metrics import MetricsLogger
+from d4pg_tpu.runtime.metrics import MetricsLogger, interval_crossed
 from d4pg_tpu.utils.profiling import annotate
 
 
@@ -183,14 +186,11 @@ class Trainer:
         if config.resume and self.ckpt.latest_step() is not None:
             self.state = self.ckpt.restore(self.state)
             self.grad_steps = int(jax.device_get(self.state.step))
-            meta = self._trainer_meta_path()
-            if os.path.exists(meta):
-                with open(meta) as f:
-                    m = json.load(f)
-                # env_steps drives the noise-decay schedule; without it a
-                # resumed run would re-explore at full scale
-                self.env_steps = int(m.get("env_steps", 0))
-                self.ewma_return = m.get("ewma_return")
+            m = load_trainer_meta(config.log_dir)
+            # env_steps drives the noise-decay schedule; without it a
+            # resumed run would re-explore at full scale
+            self.env_steps = int(m.get("env_steps", 0))
+            self.ewma_return = m.get("ewma_return")
             snap = self._replay_snapshot_path()
             if config.snapshot_replay and os.path.exists(snap):
                 n = self.buffer.restore(snap)
@@ -948,7 +948,7 @@ class Trainer:
                 step = grad_steps_done
 
                 def crossed(interval: int) -> bool:
-                    return step // interval > (step - K) // interval
+                    return interval_crossed(step - K, step, interval)
 
                 if cfg.async_collect and crossed(cfg.publish_interval):
                     self._publish_params()
@@ -970,9 +970,6 @@ class Trainer:
     def _replay_snapshot_path(self) -> str:
         return os.path.join(self.config.log_dir, "checkpoints", "replay.npz")
 
-    def _trainer_meta_path(self) -> str:
-        return os.path.join(self.config.log_dir, "checkpoints", "trainer_meta.json")
-
     def _save_checkpoint(self) -> None:
         self.ckpt.save(self.grad_steps, self.state)
         # Finalize the (async) Orbax write before the side files: a crash
@@ -982,12 +979,7 @@ class Trainer:
         # Host-side counters the device TrainState doesn't carry: env_steps
         # drives the noise-decay schedule, so without it every --resume
         # would restart exploration at full scale.
-        tmp = self._trainer_meta_path() + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(
-                {"env_steps": self.env_steps, "ewma_return": self.ewma_return}, f
-            )
-        os.replace(tmp, self._trainer_meta_path())
+        save_trainer_meta(self.config.log_dir, self.env_steps, self.ewma_return)
         if self.config.snapshot_replay:
             with annotate("host/replay_snapshot"):
                 self.buffer.snapshot(self._replay_snapshot_path())

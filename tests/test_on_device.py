@@ -31,7 +31,7 @@ def test_on_device_iteration_shapes_and_replay_fill():
         dist=DistConfig(num_atoms=21, v_min=-300, v_max=0), n_step=3,
     )
     env = Pendulum()
-    init_fn, iterate_fn = make_on_device_trainer(
+    init_fn, _warmup_fn, iterate_fn = make_on_device_trainer(
         config, env, num_envs=4, segment_len=16,
         replay_capacity=1024, batch_size=32, train_steps_per_iter=4,
     )
@@ -64,7 +64,7 @@ def test_on_device_learns_pendulum_signal():
         n_step=3, tau=0.005, lr_actor=5e-4, lr_critic=5e-4,
     )
     env = Pendulum()
-    init_fn, iterate_fn = make_on_device_trainer(
+    init_fn, _warmup_fn, iterate_fn = make_on_device_trainer(
         config, env, num_envs=16, segment_len=32,
         replay_capacity=65_536, batch_size=128, train_steps_per_iter=64,
     )
@@ -92,7 +92,7 @@ def test_on_device_prioritized_sampling_and_updates():
         prioritized=True,
     )
     env = Pendulum()
-    init_fn, iterate_fn = make_on_device_trainer(
+    init_fn, _warmup_fn, iterate_fn = make_on_device_trainer(
         config, env, num_envs=4, segment_len=16,
         replay_capacity=1024, batch_size=32, train_steps_per_iter=4,
     )
@@ -129,3 +129,45 @@ def test_device_per_proportional_statistics():
     idx = np.asarray(jnp.clip(jnp.searchsorted(cums, u), 0, C - 1))
     frac = (idx == 7).mean()
     assert 0.88 < frac < 0.92
+
+
+def test_run_on_device_cli_driver(tmp_path):
+    """train.py --on-device end-to-end: the run_on_device periphery (eval,
+    EWMA, metrics files, checkpoints, resume) around the fused loop."""
+    import json
+    import os
+
+    from train import config_from_args, build_parser
+
+    argv = [
+        "--env", "pendulum", "--on-device", "--num-envs", "2",
+        "--total-steps", "8", "--eval-interval", "4",
+        "--eval-episodes", "2", "--checkpoint-interval", "8",
+        "--env-steps-per-train-step", "16",  # 2 envs × 32 seg / 16 = 4 steps/iter
+        "--bsize", "32", "--rmsize", "256", "--warmup", "0",
+        "--log-dir", str(tmp_path / "run"),
+    ]
+    args = build_parser().parse_args(argv)
+    cfg = config_from_args(args)
+    from d4pg_tpu.runtime.on_device import run_on_device
+
+    out = run_on_device(cfg)
+    assert np.isfinite(out["critic_loss"])
+    assert "eval_return_mean" in out and "avg_test_reward_ewma" in out
+    lines = [
+        json.loads(l)
+        for l in open(tmp_path / "run" / "metrics.jsonl")
+    ]
+    assert lines and lines[-1]["step"] == 8
+    assert os.path.isdir(tmp_path / "run" / "checkpoints")
+    # resume restores the step counter from the checkpoint
+    cfg2 = config_from_args(build_parser().parse_args(argv + ["--resume"]))
+    import dataclasses
+
+    cfg2 = dataclasses.replace(cfg2, total_steps=16)
+    out2 = run_on_device(cfg2)
+    lines = [
+        json.loads(l)
+        for l in open(tmp_path / "run" / "metrics.jsonl")
+    ]
+    assert lines[-1]["step"] == 16

@@ -64,6 +64,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "a background thread against published actor params")
     p.add_argument("--publish-interval", type=int, default=10,
                    help="grad steps between actor-param publications (async)")
+    p.add_argument("--on-device", action="store_true",
+                   help="fully on-device training (pure-JAX envs): rollout + "
+                        "n-step collapse + device replay + K train steps as "
+                        "one XLA program per iteration (BASELINE config 5)")
     p.add_argument("--async-writeback", action="store_true",
                    help="flush PER priorities from a background thread with "
                         "one batched device fetch per wake (the sync fetch "
@@ -197,6 +201,12 @@ def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
     cfg = config_from_args(args)
     print(f"config: {cfg}")
+    if args.on_device:
+        from d4pg_tpu.runtime.on_device import run_on_device
+
+        final = run_on_device(cfg)
+        print(f"done: {final}")
+        return
     trainer = Trainer(cfg)
     try:
         final = trainer.train()
