@@ -235,3 +235,31 @@ def test_resume_restores_env_steps_and_noise_schedule(tmp_path):
         assert t2.ewma_return is not None
     finally:
         t2.close()
+
+
+def test_interval_crossed():
+    from d4pg_tpu.runtime.metrics import interval_crossed
+
+    # K-step dispatches can jump over exact multiples; crossing still fires
+    assert interval_crossed(0, 16, 10)
+    assert interval_crossed(95, 105, 100)
+    assert not interval_crossed(10, 19, 10)
+    assert not interval_crossed(100, 100, 100)  # no advance, no fire
+    assert interval_crossed(99, 100, 100)  # landing exactly on the multiple
+
+
+def test_trainer_meta_roundtrip(tmp_path):
+    from d4pg_tpu.runtime.checkpoint import (
+        load_trainer_meta,
+        save_trainer_meta,
+        trainer_meta_path,
+    )
+
+    log_dir = str(tmp_path / "run")
+    os.makedirs(os.path.join(log_dir, "checkpoints"))
+    assert load_trainer_meta(log_dir) == {}  # missing file -> empty dict
+    save_trainer_meta(log_dir, env_steps=12345, ewma_return=-42.5)
+    meta = load_trainer_meta(log_dir)
+    assert meta == {"env_steps": 12345, "ewma_return": -42.5}
+    # atomic write: no .tmp left behind
+    assert not os.path.exists(trainer_meta_path(log_dir) + ".tmp")
