@@ -1,0 +1,98 @@
+"""Shared on-device exploration collection.
+
+One jitted program: vmapped segment rollout (auto-reset, noise-state
+threading) + truncation-exact n-step collapse. Both trainers consume it —
+the fully on-device loop (``runtime/on_device.py``) appends the result to
+its device ring, the host-replay sync trainer (``runtime/trainer.py``)
+fetches the flat block and bulk-inserts it into the host buffer. ONE
+implementation of the n-step window math, where the reference carries two
+that disagree on the discount (``ddpg.py:129`` vs ``:155``, SURVEY.md
+quirk #5).
+
+Windows never span segment boundaries: the last up-to-(n−1) steps of a
+segment bootstrap early with the exact ``γ^m`` of their shortened window —
+a valid m-step Bellman target, the same convention as episode truncation
+(:func:`d4pg_tpu.ops.nstep_returns` with ``truncations``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from d4pg_tpu.agent import act_deterministic
+from d4pg_tpu.agent.d4pg import make_noise
+from d4pg_tpu.agent.state import D4PGConfig
+from d4pg_tpu.envs.rollouts import rollout
+from d4pg_tpu.ops import nstep_returns
+
+
+def make_segment_collector(
+    config: D4PGConfig,
+    env,
+    num_envs: int,
+    segment_len: int,
+    noise_fns=None,
+    return_traj: bool = True,
+):
+    """Build a jitted ``collect(actor_params, env_states, obs, noise_states,
+    key, noise_scale) -> (env_states, obs, noise_states, flat, traj)``.
+
+    ``flat`` is a dict of ``[num_envs*segment_len]`` n-step-collapsed
+    transitions (obs, action, reward=R^(m), next_obs=s_{t+m},
+    discount=γ^m·(1−terminal)); ``traj`` is the raw segment for metrics.
+    ``noise_scale`` is a traced scalar — schedules don't retrace.
+
+    ``return_traj=False`` returns ``None`` for ``traj`` so XLA prunes the
+    raw-segment outputs from the program — callers that only consume
+    ``flat`` (the host sync trainer) otherwise pay HBM writes for the full
+    [N, L] obs/next_obs blocks as jit outputs (2× the flat block for pixel
+    envs). Callers that trace this inside their own jit (the on-device
+    trainer) get that pruning for free and can keep ``traj`` for metrics.
+    """
+    noise_init, noise_sample, noise_reset = noise_fns or make_noise(config)
+    n_new = num_envs * segment_len
+
+    @jax.jit
+    def collect(actor_params, env_states, obs, noise_states, key, noise_scale):
+        def policy(o, k, nstate):
+            a = act_deterministic(config, actor_params, o[None])[0]
+            n, nstate = noise_sample(nstate, k, a.shape)
+            return jnp.clip(a + noise_scale * n, -1.0, 1.0), nstate
+
+        def one(env_state, o, nstate, k):
+            return rollout(
+                env, policy, k, segment_len,
+                init_state=env_state, init_obs=o,
+                policy_state=nstate, policy_state_reset=noise_reset,
+            )
+
+        keys = jax.random.split(key, num_envs)
+        env_states, obs, noise_states, traj = jax.vmap(one)(
+            env_states, obs, noise_states, keys
+        )
+
+        def collapse(rew, term, trunc, tr_obs, tr_act, tr_next):
+            rets, boots, offs = nstep_returns(
+                rew, term, config.gamma, config.n_step, truncations=trunc
+            )
+            # bootstrap state s_{t+m} is next_obs[t + m - 1]
+            idx = jnp.clip(jnp.arange(rew.shape[0]) + offs - 1, 0, rew.shape[0] - 1)
+            return {
+                "obs": tr_obs,
+                "action": tr_act,
+                "reward": rets,
+                "next_obs": tr_next[idx],
+                "discount": boots,
+            }
+
+        flat = jax.vmap(collapse)(
+            traj.reward, traj.terminated, traj.truncated,
+            traj.obs, traj.action, traj.next_obs,
+        )
+        flat = jax.tree_util.tree_map(
+            lambda x: x.reshape((n_new,) + x.shape[2:]), flat
+        )
+        return env_states, obs, noise_states, flat, traj if return_traj else None
+
+    return collect

@@ -36,8 +36,7 @@ import jax.numpy as jnp
 from d4pg_tpu.agent import TrainState
 from d4pg_tpu.agent.d4pg import fused_train_scan, gather_batches, make_noise
 from d4pg_tpu.agent.state import D4PGConfig
-from d4pg_tpu.envs.rollouts import rollout
-from d4pg_tpu.ops import nstep_returns
+from d4pg_tpu.runtime.collect import make_segment_collector
 
 
 class DeviceReplay(NamedTuple):
@@ -129,54 +128,18 @@ def make_on_device_trainer(
         )
         return (state, env_states, obs, noise_states, replay, k_carry)
 
+    # Steps 1-2 (vmapped exploration rollout + n-step collapse) are the
+    # shared jitted collector; step 3 (ring append) is ours.
+    segment_collect = make_segment_collector(
+        config, env, num_envs, segment_len,
+        noise_fns=(noise_init, noise_sample, noise_reset),
+    )
+
     def _collect(state, env_states, obs, noise_states, replay, k_roll):
-        """Steps 1-3: vmapped exploration rollout, n-step collapse, ring
-        append. Shared by warmup (collect-only) and full iterations."""
-
-        # ---- 1. vmapped exploration rollout --------------------------------
-        def policy(o, k, nstate):
-            from d4pg_tpu.agent import act_deterministic
-
-            a = act_deterministic(config, state.actor_params, o[None])[0]
-            n, nstate = noise_sample(nstate, k, a.shape)
-            return jnp.clip(a + n, -1.0, 1.0), nstate
-
-        def one(env_state, o, nstate, k):
-            return rollout(
-                env, policy, k, segment_len,
-                init_state=env_state, init_obs=o,
-                policy_state=nstate, policy_state_reset=noise_reset,
-            )
-
-        keys = jax.random.split(k_roll, num_envs)
-        env_states, obs, noise_states, traj = jax.vmap(one)(
-            env_states, obs, noise_states, keys
+        env_states, obs, noise_states, flat, traj = segment_collect(
+            state.actor_params, env_states, obs, noise_states, k_roll,
+            jnp.ones(()),
         )
-
-        # ---- 2. n-step collapse (per env row) ------------------------------
-        def collapse(rew, term, trunc, tr_obs, tr_act, tr_next):
-            rets, boots, offs = nstep_returns(
-                rew, term, config.gamma, config.n_step, truncations=trunc
-            )
-            # bootstrap state s_{t+m} is next_obs[t + m - 1]
-            idx = jnp.clip(jnp.arange(rew.shape[0]) + offs - 1, 0, rew.shape[0] - 1)
-            return {
-                "obs": tr_obs,
-                "action": tr_act,
-                "reward": rets,
-                "next_obs": tr_next[idx],
-                "discount": boots,
-            }
-
-        flat = jax.vmap(collapse)(
-            traj.reward, traj.terminated, traj.truncated,
-            traj.obs, traj.action, traj.next_obs,
-        )
-        flat = jax.tree_util.tree_map(
-            lambda x: x.reshape((n_new,) + x.shape[2:]), flat
-        )
-
-        # ---- 3. ring append ------------------------------------------------
         replay = _append(replay, flat, n_new, config.per_alpha)
         return env_states, obs, noise_states, replay, traj
 

@@ -4,10 +4,11 @@ Replaces the reference's ``Worker.work`` nested loops + process forking
 (``main.py:188-405``) with a single-process design around the jitted core:
 
 - **sync mode** (pure-JAX envs): exploration rollouts run vmapped on device
-  (``lax.scan``), segments stream to the host n-step writers, the learner
-  consumes batches with a one-step pipeline lag so the next batch is being
-  sampled/transferred while the TPU executes the current step, and PER
-  priorities write back when the step's results materialize.
+  with the n-step collapse fused in (``runtime/collect.py``), segments
+  bulk-insert into the host buffer, the learner consumes batches with a
+  one-step pipeline lag so the next batch is being sampled/transferred
+  while the TPU executes the current step, and PER priorities write back
+  when the step's results materialize.
 - **host mode** (gymnasium adapters, incl. goal-dict envs with HER):
   per-step host env loop feeding the same writers — the reference's actor
   loop, minus processes.
@@ -37,7 +38,7 @@ from d4pg_tpu.agent import (
 )
 from d4pg_tpu.agent.d4pg import fused_train_scan, make_noise
 from d4pg_tpu.config import ENV_PRESETS, TrainConfig
-from d4pg_tpu.envs import make_env, rollout
+from d4pg_tpu.envs import make_env
 from d4pg_tpu.envs.pointmass_goal import PointMassGoal
 from d4pg_tpu.models.critic import DistConfig
 from d4pg_tpu.replay import (
@@ -45,6 +46,7 @@ from d4pg_tpu.replay import (
     NStepWriter,
     PrioritizedReplayBuffer,
     ReplayBuffer,
+    Transition,
     linear_schedule,
 )
 from d4pg_tpu.runtime.checkpoint import (
@@ -295,32 +297,21 @@ class Trainer:
 
     # ------------------------------------------------------------------ sync
     def _setup_sync_collect(self, segment_len: int = 32):
+        """Pure-JAX envs: one jitted program per collect — vmapped rollout +
+        n-step collapse on device (the shared collector, also the on-device
+        trainer's front half) — then ONE bulk insert into the host buffer.
+        Replaces a per-transition Python writer loop (num_envs×segment_len
+        ``NStepWriter.add`` calls per segment)."""
+        from d4pg_tpu.runtime.collect import make_segment_collector
+
         cfg = self.config
         self.segment_len = segment_len
-        self.writers = [
-            NStepWriter(self.buffer, cfg.n_step, cfg.agent.gamma)
-            for _ in range(cfg.num_envs)
-        ]
-        env, agent_cfg = self.env, cfg.agent
-        noise_sample, noise_reset = self._noise_sample, self._noise_reset
-
-        def collect(actor_params, env_states, obs, noise_states, key, noise_scale):
-            def policy(o, k, nstate):
-                a = act_deterministic(agent_cfg, actor_params, o[None])[0]
-                n, nstate = noise_sample(nstate, k, a.shape)
-                return jnp.clip(a + noise_scale * n, -1.0, 1.0), nstate
-
-            def one(env_state, o, nstate, k):
-                return rollout(
-                    env, policy, k, segment_len,
-                    init_state=env_state, init_obs=o,
-                    policy_state=nstate, policy_state_reset=noise_reset,
-                )
-
-            keys = jax.random.split(key, cfg.num_envs)
-            return jax.vmap(one)(env_states, obs, noise_states, keys)
-
-        self._collect = jax.jit(collect)
+        env = self.env
+        self._collect = make_segment_collector(
+            cfg.agent, env, cfg.num_envs, segment_len,
+            noise_fns=(self._noise_init, self._noise_sample, self._noise_reset),
+            return_traj=False,
+        )
         self.key, reset_key = jax.random.split(self.key)
         reset_keys = jax.random.split(reset_key, cfg.num_envs)
         self.env_states, self.obs = jax.vmap(env.reset)(reset_keys)
@@ -328,31 +319,17 @@ class Trainer:
             jnp.arange(cfg.num_envs)
         )
 
-    def _drain_segment(self, traj) -> None:
-        """Feed a [N, L] device segment into the host n-step writers."""
-        t = jax.device_get(traj)
-        N, L = t.reward.shape
-        for i in range(N):
-            w = self.writers[i]
-            for j in range(L):
-                w.add(
-                    t.obs[i, j],
-                    t.action[i, j],
-                    float(t.reward[i, j]),
-                    t.next_obs[i, j],
-                    terminated=bool(t.terminated[i, j]),
-                    truncated=bool(t.truncated[i, j]),
-                )
-        self.env_steps += N * L
-
     def _collect_once(self, noise_scale: Optional[float] = None) -> None:
         self.key, k = jax.random.split(self.key)
         scale = self._noise_scale() if noise_scale is None else noise_scale
-        self.env_states, self.obs, self.noise_states, traj = self._collect(
+        self.env_states, self.obs, self.noise_states, flat, _traj = self._collect(
             self.state.actor_params, self.env_states, self.obs,
             self.noise_states, k, scale,
         )
-        self._drain_segment(traj)
+        flat = jax.device_get(flat)
+        with self._buffer_lock:
+            self.buffer.add_batch(Transition(**flat))
+        self.env_steps += self.config.num_envs * self.segment_len
 
     # ------------------------------------------------------------------ host
     def _setup_host_collect(self):
