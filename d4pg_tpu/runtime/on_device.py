@@ -101,6 +101,8 @@ def make_on_device_trainer(
     replay_capacity: int = 131_072,
     batch_size: int = 256,
     train_steps_per_iter: int = 32,
+    mesh=None,
+    axis_name: str = "dp",
 ):
     """Build (init_fn, warmup_fn, iterate_fn) for the fully-jitted loop.
 
@@ -109,17 +111,55 @@ def make_on_device_trainer(
     WITHOUT training (the reference's replay pre-fill, ``main.py:200-207``);
     ``iterate_fn(carry) -> (carry, metrics)`` = one segment +
     train_steps_per_iter grad steps, entirely on device.
+
+    With ``mesh``, the whole loop runs data-parallel under ``shard_map``
+    over ``axis_name`` — BASELINE config 5 at pod scale. ``num_envs``,
+    ``replay_capacity`` and ``batch_size`` are GLOBAL and divided across
+    the axis: each device rolls its env shard, owns its shard of the
+    replay ring (distributed PER — proportional sampling over the local
+    shard, the standard distributed-replay approximation), and trains on
+    its batch shard; one ``pmean`` per grad step (inside
+    :func:`~d4pg_tpu.agent.d4pg.train_step`) rides ICI, so params stay
+    replicated and bit-identical. Per-device PRNG streams come from
+    folding ``axis_index`` into the replicated carry key; ``pos``/``size``
+    evolve identically everywhere and stay replicated; ``max_priority`` is
+    ``pmax``-synced each iteration.
     """
+    D = 1
+    if mesh is not None:
+        D = int(mesh.shape[axis_name])
+        for name, val in (
+            ("num_envs", num_envs),
+            ("replay_capacity", replay_capacity),
+            ("batch_size", batch_size),
+        ):
+            if val % D != 0:
+                raise ValueError(
+                    f"{name} ({val}) must be divisible by mesh axis "
+                    f"{axis_name!r} size {D}"
+                )
+        num_envs //= D
+        replay_capacity //= D
+        batch_size //= D
+    axis = axis_name if mesh is not None else None
     n_new = num_envs * segment_len
     if replay_capacity % n_new != 0:
         raise ValueError(
-            f"replay_capacity ({replay_capacity}) must be a multiple of "
-            f"num_envs*segment_len ({n_new})"
+            f"replay_capacity ({replay_capacity * D}) must be a multiple of "
+            f"num_envs*segment_len ({n_new * D}"
+            + (f" — both are per-device ÷{D})" if D > 1 else ")")
         )
     noise_init, noise_sample, noise_reset = make_noise(config)
 
-    def init_fn(state: TrainState, key: jax.Array):
-        k_reset, k_carry = jax.random.split(key)
+    def _fold_local(key):
+        """Distinct per-device stream from the replicated carry key."""
+        if axis is None:
+            return key
+        return jax.random.fold_in(key, jax.lax.axis_index(axis))
+
+    def init_body(state: TrainState, key: jax.Array):
+        k_reset = _fold_local(jax.random.fold_in(key, 0))
+        k_carry = jax.random.fold_in(key, 1)  # replicated; folded per use
         reset_keys = jax.random.split(k_reset, num_envs)
         env_states, obs = jax.vmap(env.reset)(reset_keys)
         noise_states = jax.vmap(lambda _: noise_init())(jnp.arange(num_envs))
@@ -137,14 +177,13 @@ def make_on_device_trainer(
 
     def _collect(state, env_states, obs, noise_states, replay, k_roll):
         env_states, obs, noise_states, flat, traj = segment_collect(
-            state.actor_params, env_states, obs, noise_states, k_roll,
-            jnp.ones(()),
+            state.actor_params, env_states, obs, noise_states,
+            _fold_local(k_roll), jnp.ones(()),
         )
         replay = _append(replay, flat, n_new, config.per_alpha)
         return env_states, obs, noise_states, replay, traj
 
-    @jax.jit
-    def warmup_fn(carry):
+    def warmup_body(carry):
         state, env_states, obs, noise_states, replay, key = carry
         key, k_roll = jax.random.split(key)
         env_states, obs, noise_states, replay, _ = _collect(
@@ -152,10 +191,10 @@ def make_on_device_trainer(
         )
         return (state, env_states, obs, noise_states, replay, key)
 
-    @jax.jit
-    def iterate_fn(carry):
+    def iterate_body(carry):
         state, env_states, obs, noise_states, replay, key = carry
         key, k_roll, k_train = jax.random.split(key, 3)
+        k_train = _fold_local(k_train)
         env_states, obs, noise_states, replay, traj = _collect(
             state, env_states, obs, noise_states, replay, k_roll
         )
@@ -184,7 +223,9 @@ def make_on_device_trainer(
             weights = weights / ((min_p * size_f) ** (-beta))
             batches = gather_batches(replay, idx)
             batches["weights"] = weights
-            state, metrics, new_pri = fused_train_scan(config, state, batches)
+            state, metrics, new_pri = fused_train_scan(
+                config, state, batches, axis_name=axis
+            )
             # ordered write-back: later steps win on duplicate indices,
             # matching the host loop's sequential update_priorities calls
             pa = (jnp.abs(new_pri) + config.per_eps) ** config.per_alpha
@@ -193,23 +234,56 @@ def make_on_device_trainer(
                 return pr.at[idx[k]].set(pa[k])
 
             prio = jax.lax.fori_loop(0, K, upd, prio)
-            replay = replay._replace(
-                priority=prio,
-                max_priority=jnp.maximum(
-                    replay.max_priority, jnp.max(jnp.abs(new_pri) + config.per_eps)
-                ),
+            max_priority = jnp.maximum(
+                replay.max_priority, jnp.max(jnp.abs(new_pri) + config.per_eps)
             )
+            if axis is not None:
+                # keep the replicated scalar identical across shards
+                max_priority = jax.lax.pmax(max_priority, axis)
+            replay = replay._replace(priority=prio, max_priority=max_priority)
         else:
             idx = jax.random.randint(k_train, (K, B), 0, replay.size)
             state, metrics, _ = fused_train_scan(
-                config, state, gather_batches(replay, idx)
+                config, state, gather_batches(replay, idx), axis_name=axis
             )
         metrics = jax.tree_util.tree_map(jnp.mean, metrics)
-        metrics["episode_return_proxy"] = jnp.sum(traj.reward) / jnp.maximum(
+        proxy = jnp.sum(traj.reward) / jnp.maximum(
             jnp.sum(jnp.maximum(traj.terminated, traj.truncated)), 1.0
         )
+        if axis is not None:
+            proxy = jax.lax.pmean(proxy, axis)
+        metrics["episode_return_proxy"] = proxy
         return (state, env_states, obs, noise_states, replay, key), metrics
 
+    if mesh is None:
+        return jax.jit(init_body), jax.jit(warmup_body), jax.jit(iterate_body)
+
+    from jax.sharding import PartitionSpec as P
+
+    rep, shd = P(), P(axis_name)
+    replay_spec = DeviceReplay(
+        obs=shd, action=shd, reward=shd, next_obs=shd, discount=shd,
+        priority=shd, max_priority=rep, pos=rep, size=rep,
+    )
+    carry_spec = (rep, shd, shd, shd, replay_spec, rep)
+    init_fn = jax.jit(
+        jax.shard_map(
+            init_body, mesh=mesh, in_specs=(rep, rep), out_specs=carry_spec,
+            check_vma=False,
+        )
+    )
+    warmup_fn = jax.jit(
+        jax.shard_map(
+            warmup_body, mesh=mesh, in_specs=(carry_spec,),
+            out_specs=carry_spec, check_vma=False,
+        )
+    )
+    iterate_fn = jax.jit(
+        jax.shard_map(
+            iterate_body, mesh=mesh, in_specs=(carry_spec,),
+            out_specs=(carry_spec, rep), check_vma=False,
+        )
+    )
     return init_fn, warmup_fn, iterate_fn
 
 
@@ -261,6 +335,11 @@ def run_on_device(config) -> dict:
             f"replay capacity {config.replay_capacity} adjusted to {capacity} "
             f"(device ring must be a multiple of num_envs×segment_len = {n_new})"
         )
+    mesh = None
+    if config.dp:
+        from d4pg_tpu.parallel import make_mesh
+
+        mesh = make_mesh(dp=config.dp, tp=1)
     init_fn, warmup_fn, iterate_fn = make_on_device_trainer(
         agent_cfg,
         env,
@@ -269,11 +348,16 @@ def run_on_device(config) -> dict:
         replay_capacity=capacity,
         batch_size=config.batch_size,
         train_steps_per_iter=K,
+        mesh=mesh,
     )
 
     key = jax.random.PRNGKey(config.seed)
     key, k_state = jax.random.split(key)
     state = create_train_state(agent_cfg, k_state)
+    if mesh is not None:
+        from d4pg_tpu.parallel.dp import replicate
+
+        state = replicate(state, mesh)
     ckpt = CheckpointManager(f"{config.log_dir}/checkpoints")
     env_steps = 0
     ewma = None
@@ -327,7 +411,9 @@ def run_on_device(config) -> dict:
                 avg_test_reward_ewma=ewma,
                 grad_steps_per_sec=grad_steps_done / dt,
                 env_steps_per_sec=env_steps_done / dt,
-                replay_size=int(jax.device_get(carry[4].size)),
+                # carry[4].size is the per-shard counter (identical on every
+                # device); report the GLOBAL fill to match --rmsize
+                replay_size=int(jax.device_get(carry[4].size)) * (config.dp or 1),
                 env_steps=env_steps,
             )
             logger.log(grad_steps, scalars)

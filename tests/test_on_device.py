@@ -171,3 +171,64 @@ def test_run_on_device_cli_driver(tmp_path):
         for l in open(tmp_path / "run" / "metrics.jsonl")
     ]
     assert lines[-1]["step"] == 16
+
+
+def test_on_device_dp_over_mesh():
+    """Distributed fully-on-device loop (config 5 at pod scale): envs,
+    replay shards and batch split over the 8-device mesh, grads pmean'd,
+    params replicated and identical; global sizes divide across the axis;
+    training shows a learning signal."""
+    from d4pg_tpu.parallel import make_mesh
+
+    mesh = make_mesh(dp=8, tp=1)
+    config = D4PGConfig(
+        obs_dim=3, action_dim=1, hidden_sizes=(32, 32), n_step=2,
+        lr_actor=1e-3, lr_critic=1e-3,
+        dist=DistConfig(kind="categorical", num_atoms=21, v_min=-200.0, v_max=0.0),
+    )
+    init_fn, warmup_fn, iterate_fn = make_on_device_trainer(
+        config, Pendulum(),
+        num_envs=16, segment_len=8, replay_capacity=2048,
+        batch_size=64, train_steps_per_iter=4, mesh=mesh,
+    )
+    state = create_train_state(config, jax.random.PRNGKey(0))
+    from d4pg_tpu.parallel.dp import replicate
+
+    carry = init_fn(replicate(state, mesh), jax.random.PRNGKey(1))
+    carry = warmup_fn(carry)
+    losses = []
+    for _ in range(8):
+        carry, m = iterate_fn(carry)
+        losses.append(float(m["critic_loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]  # distributional CE collapses from init
+    new_state, *_, replay, _key = carry
+    # replay ring is sharded: global leading dim = full capacity, each of
+    # the 8 shards advanced identically
+    assert replay.obs.shape[0] == 2048
+    assert int(replay.size) > 0
+    # params stayed replicated AND identical across devices
+    leaf = jax.tree.leaves(new_state.actor_params)[0]
+    shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+    for s in shards[1:]:
+        np.testing.assert_array_equal(shards[0], s)
+    # step advanced once per grad step regardless of device count
+    assert int(jax.device_get(new_state.step)) == (1 + 8) * 4 - 4  # warmup trains 0
+
+
+def test_run_on_device_cli_driver_dp(tmp_path):
+    """--on-device --dp 8: the CLI driver runs the distributed loop."""
+    from train import build_parser, config_from_args
+    from d4pg_tpu.runtime.on_device import run_on_device
+
+    argv = [
+        "--env", "pendulum", "--on-device", "--dp", "8", "--num-envs", "8",
+        "--total-steps", "8", "--eval-interval", "8", "--eval-episodes", "2",
+        "--checkpoint-interval", "1000000",
+        "--env-steps-per-train-step", "64",  # 8 envs × 32 seg / 64 = 4/iter
+        "--bsize", "64", "--rmsize", "1024", "--warmup", "0",
+        "--log-dir", str(tmp_path / "run"),
+    ]
+    out = run_on_device(config_from_args(build_parser().parse_args(argv)))
+    assert np.isfinite(out["critic_loss"])
+    assert "eval_return_mean" in out
