@@ -7,7 +7,7 @@ reference's pointer-chasing ``prioritized_replay_memory.py:61-112``), with an
 optional native C++ tree backend (``d4pg_tpu.replay.native``).
 """
 
-from d4pg_tpu.replay.schedules import linear_schedule
+from d4pg_tpu.replay.schedules import linear_schedule, noise_scale_schedule
 from d4pg_tpu.replay.segment_tree import MinTree, SumTree
 from d4pg_tpu.replay.uniform import ReplayBuffer, Transition
 from d4pg_tpu.replay.per import PrioritizedReplayBuffer
@@ -16,6 +16,7 @@ from d4pg_tpu.replay.her import HindsightWriter
 
 __all__ = [
     "linear_schedule",
+    "noise_scale_schedule",
     "MinTree",
     "SumTree",
     "ReplayBuffer",

@@ -13,3 +13,13 @@ def linear_schedule(step: int, total_steps: int, start: float, end: float) -> fl
     """Linear interpolation start→end over total_steps, clamped after."""
     frac = min(max(float(step) / max(total_steps, 1), 0.0), 1.0)
     return start + frac * (end - start)
+
+
+def noise_scale_schedule(env_steps: int, decay_steps: int, final: float) -> float:
+    """Exploration-noise scale at env_steps: 1→final over decay_steps;
+    constant 1.0 when decay_steps <= 0 (the reference's effective behavior,
+    SURVEY.md quirk #10). Shared by the host trainer and the on-device
+    driver so their ε-decay can never diverge."""
+    if decay_steps <= 0:
+        return 1.0
+    return linear_schedule(env_steps, decay_steps, 1.0, final)

@@ -106,11 +106,14 @@ def make_on_device_trainer(
 ):
     """Build (init_fn, warmup_fn, iterate_fn) for the fully-jitted loop.
 
-    ``init_fn(state, key) -> carry``; ``warmup_fn(carry) -> carry`` collects
-    one num_envs×segment_len exploration segment into the device replay
-    WITHOUT training (the reference's replay pre-fill, ``main.py:200-207``);
-    ``iterate_fn(carry) -> (carry, metrics)`` = one segment +
-    train_steps_per_iter grad steps, entirely on device.
+    ``init_fn(state, key) -> carry``; ``warmup_fn(carry, noise_scale) ->
+    carry`` collects one num_envs×segment_len exploration segment into the
+    device replay WITHOUT training (the reference's replay pre-fill,
+    ``main.py:200-207``); ``iterate_fn(carry, noise_scale) -> (carry,
+    metrics)`` = one segment + train_steps_per_iter grad steps, entirely on
+    device. ``noise_scale`` is a traced scalar multiplying exploration
+    noise — drive it with a schedule (the host trainer's ε-decay) without
+    retracing.
 
     With ``mesh``, the whole loop runs data-parallel under ``shard_map``
     over ``axis_name`` — BASELINE config 5 at pod scale. ``num_envs``,
@@ -175,28 +178,28 @@ def make_on_device_trainer(
         noise_fns=(noise_init, noise_sample, noise_reset),
     )
 
-    def _collect(state, env_states, obs, noise_states, replay, k_roll):
+    def _collect(state, env_states, obs, noise_states, replay, k_roll, scale):
         env_states, obs, noise_states, flat, traj = segment_collect(
             state.actor_params, env_states, obs, noise_states,
-            _fold_local(k_roll), jnp.ones(()),
+            _fold_local(k_roll), scale,
         )
         replay = _append(replay, flat, n_new, config.per_alpha)
         return env_states, obs, noise_states, replay, traj
 
-    def warmup_body(carry):
+    def warmup_body(carry, noise_scale):
         state, env_states, obs, noise_states, replay, key = carry
         key, k_roll = jax.random.split(key)
         env_states, obs, noise_states, replay, _ = _collect(
-            state, env_states, obs, noise_states, replay, k_roll
+            state, env_states, obs, noise_states, replay, k_roll, noise_scale
         )
         return (state, env_states, obs, noise_states, replay, key)
 
-    def iterate_body(carry):
+    def iterate_body(carry, noise_scale):
         state, env_states, obs, noise_states, replay, key = carry
         key, k_roll, k_train = jax.random.split(key, 3)
         k_train = _fold_local(k_train)
         env_states, obs, noise_states, replay, traj = _collect(
-            state, env_states, obs, noise_states, replay, k_roll
+            state, env_states, obs, noise_states, replay, k_roll, noise_scale
         )
 
         # ---- 4. K train steps ----------------------------------------------
@@ -274,13 +277,13 @@ def make_on_device_trainer(
     )
     warmup_fn = jax.jit(
         jax.shard_map(
-            warmup_body, mesh=mesh, in_specs=(carry_spec,),
+            warmup_body, mesh=mesh, in_specs=(carry_spec, rep),
             out_specs=carry_spec, check_vma=False,
         )
     )
     iterate_fn = jax.jit(
         jax.shard_map(
-            iterate_body, mesh=mesh, in_specs=(carry_spec,),
+            iterate_body, mesh=mesh, in_specs=(carry_spec, rep),
             out_specs=(carry_spec, rep), check_vma=False,
         )
     )
@@ -301,14 +304,16 @@ def run_on_device(config) -> dict:
 
     Pure-JAX envs only. The device replay ring is rebuilt on ``--resume``
     and re-warmed with ``warmup_steps`` of fresh exploration (ring contents
-    are not checkpointed); ``noise_decay_steps`` is not threaded into the
-    fused rollout (exploration ε is constant — the reference's effective
-    behavior, SURVEY.md quirk #10).
+    are not checkpointed). Exploration noise follows the same env-step
+    schedule as the host trainer (``noise_decay_steps``/``noise_scale_final``;
+    constant when decay is 0 — the reference's effective behavior, SURVEY.md
+    quirk #10) and warmup collects at 3× scale, matching the host warmup.
     """
     import time
 
     from d4pg_tpu.agent import create_train_state
     from d4pg_tpu.envs import make_env
+    from d4pg_tpu.replay import noise_scale_schedule
     from d4pg_tpu.runtime.checkpoint import (
         CheckpointManager,
         load_trainer_meta,
@@ -378,15 +383,21 @@ def run_on_device(config) -> dict:
     t0 = time.monotonic()
     grad_steps_done = 0
     env_steps_done = 0
+    def _noise_scale() -> float:
+        return noise_scale_schedule(
+            env_steps, agent_cfg.noise_decay_steps, agent_cfg.noise_scale_final
+        )
+
     try:
-        # Replay pre-fill without training (reference warmup, main.py:200-207).
-        # Needed after resume too: the device ring starts empty every run.
-        # Skipped when the checkpoint already satisfies total_steps — the
-        # eval-only path below never samples the ring.
+        # Replay pre-fill without training (reference warmup, main.py:200-207)
+        # at 3× noise like the host warmup. Needed after resume too: the
+        # device ring starts empty every run. Skipped when the checkpoint
+        # already satisfies total_steps — the eval-only path below never
+        # samples the ring.
         while grad_steps < total and env_steps_done < max(
             config.warmup_steps, config.batch_size
         ):
-            carry = warmup_fn(carry)
+            carry = warmup_fn(carry, 3.0)
             env_steps_done += n_new
             env_steps += n_new
 
@@ -409,6 +420,7 @@ def run_on_device(config) -> dict:
             dt = time.monotonic() - t0
             scalars.update(
                 avg_test_reward_ewma=ewma,
+                noise_scale=_noise_scale(),
                 grad_steps_per_sec=grad_steps_done / dt,
                 env_steps_per_sec=env_steps_done / dt,
                 # carry[4].size is the per-shard counter (identical on every
@@ -444,7 +456,7 @@ def run_on_device(config) -> dict:
             _eval_and_log(None)
             return last
         while grad_steps < total:
-            carry, m = iterate_fn(carry)
+            carry, m = iterate_fn(carry, _noise_scale())
             prev = grad_steps
             grad_steps += K
             grad_steps_done += K

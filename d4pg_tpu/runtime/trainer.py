@@ -47,7 +47,7 @@ from d4pg_tpu.replay import (
     PrioritizedReplayBuffer,
     ReplayBuffer,
     Transition,
-    linear_schedule,
+    noise_scale_schedule,
 )
 from d4pg_tpu.runtime.checkpoint import (
     CheckpointManager,
@@ -286,13 +286,12 @@ class Trainer:
         return 0 if self._replay_restored else self.config.warmup_steps
 
     def _noise_scale(self) -> float:
-        """Exploration scale schedule over env steps (constant when
-        noise_decay_steps == 0 — the reference's effective behavior)."""
-        decay = self.config.agent.noise_decay_steps
-        if decay <= 0:
-            return 1.0
-        return linear_schedule(
-            self.env_steps, decay, 1.0, self.config.agent.noise_scale_final
+        """Exploration scale schedule over env steps (shared helper; see
+        noise_scale_schedule)."""
+        return noise_scale_schedule(
+            self.env_steps,
+            self.config.agent.noise_decay_steps,
+            self.config.agent.noise_scale_final,
         )
 
     # ------------------------------------------------------------------ sync

@@ -38,7 +38,7 @@ def test_on_device_iteration_shapes_and_replay_fill():
     state = create_train_state(config, jax.random.PRNGKey(0))
     carry = init_fn(state, jax.random.PRNGKey(1))
     for i in range(3):
-        carry, metrics = iterate_fn(carry)
+        carry, metrics = iterate_fn(carry, 1.0)
     state2, _, _, _, replay, _ = carry
     assert int(replay.size) == 3 * 4 * 16
     assert int(state2.step) == 3 * 4
@@ -72,7 +72,7 @@ def test_on_device_learns_pendulum_signal():
     carry = init_fn(state, jax.random.PRNGKey(1))
     losses = []
     for i in range(150):
-        carry, metrics = iterate_fn(carry)
+        carry, metrics = iterate_fn(carry, 1.0)
         losses.append(float(metrics["critic_loss"]))
     from d4pg_tpu.runtime import evaluate
 
@@ -98,7 +98,7 @@ def test_on_device_prioritized_sampling_and_updates():
     )
     state = create_train_state(config, jax.random.PRNGKey(0))
     carry = init_fn(state, jax.random.PRNGKey(1))
-    carry, m1 = iterate_fn(carry)
+    carry, m1 = iterate_fn(carry, 1.0)
     _, _, _, _, replay, _ = carry
     n = int(replay.size)
     pr = np.asarray(replay.priority)
@@ -106,7 +106,7 @@ def test_on_device_prioritized_sampling_and_updates():
     assert np.all(pr[:n] > 0) and np.all(pr[n:] == 0)
     # trained-on rows got real (non-seed) priorities: not all equal
     assert np.unique(pr[:n]).size > 1
-    carry, m2 = iterate_fn(carry)
+    carry, m2 = iterate_fn(carry, 1.0)
     assert np.isfinite(float(m2["critic_loss"]))
     assert float(carry[4].max_priority) >= 1.0
 
@@ -195,10 +195,10 @@ def test_on_device_dp_over_mesh():
     from d4pg_tpu.parallel.dp import replicate
 
     carry = init_fn(replicate(state, mesh), jax.random.PRNGKey(1))
-    carry = warmup_fn(carry)
+    carry = warmup_fn(carry, 1.0)
     losses = []
     for _ in range(8):
-        carry, m = iterate_fn(carry)
+        carry, m = iterate_fn(carry, 1.0)
         losses.append(float(m["critic_loss"]))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]  # distributional CE collapses from init
