@@ -57,12 +57,17 @@ class DeviceReplay(NamedTuple):
     size: jax.Array       # scalar int32 — filled entries
 
 
-def device_replay_init(capacity: int, obs_dim: int, action_dim: int) -> DeviceReplay:
+def device_replay_init(
+    capacity: int, obs_dim: int, action_dim: int, obs_dtype=jnp.float32
+) -> DeviceReplay:
+    """``obs_dtype=jnp.uint8`` stores observations quantized ×255 (pixel
+    envs with [0,1] float frames) — 4× less HBM per ring row, mirroring the
+    host buffer's uint8 storage (``replay/uniform.py``)."""
     return DeviceReplay(
-        obs=jnp.zeros((capacity, obs_dim), jnp.float32),
+        obs=jnp.zeros((capacity, obs_dim), obs_dtype),
         action=jnp.zeros((capacity, action_dim), jnp.float32),
         reward=jnp.zeros((capacity,), jnp.float32),
-        next_obs=jnp.zeros((capacity, obs_dim), jnp.float32),
+        next_obs=jnp.zeros((capacity, obs_dim), obs_dtype),
         discount=jnp.zeros((capacity,), jnp.float32),
         priority=jnp.zeros((capacity,), jnp.float32),
         max_priority=jnp.ones((), jnp.float32),
@@ -71,18 +76,40 @@ def device_replay_init(capacity: int, obs_dim: int, action_dim: int) -> DeviceRe
     )
 
 
-def _append(replay: DeviceReplay, batch: dict, count: int, alpha: float) -> DeviceReplay:
+def _encode_obs(x: jax.Array, obs_dtype, scale: float = 255.0) -> jax.Array:
+    """Same contract as the host ``ReplayBuffer._encode_obs``
+    (``replay/uniform.py``): store ``clip(rint(x·scale), 0, 255)`` —
+    ``scale`` is 255 for [0,1]-float envs, 1.0 for byte-image envs."""
+    if obs_dtype == jnp.uint8:
+        return jnp.clip(jnp.round(x * scale), 0.0, 255.0).astype(jnp.uint8)
+    return x
+
+
+def _decode_obs(x: jax.Array, obs_dtype) -> jax.Array:
+    """Decoded batches are always [0,1] floats (host convention)."""
+    if obs_dtype == jnp.uint8:
+        return x.astype(jnp.float32) / 255.0
+    return x
+
+
+def _append(
+    replay: DeviceReplay, batch: dict, count: int, alpha: float,
+    obs_scale: float = 255.0,
+) -> DeviceReplay:
     """Write ``count`` rows at the ring position. Requires capacity % count
     == 0 so a write never wraps mid-block (enforced by the factory). New
     rows enter at max_priority^α (reference ``prioritized_replay_memory.py:251-256``)."""
     p = replay.pos
+    obs_dtype = replay.obs.dtype
     new_prio = jnp.full((count,), replay.max_priority**alpha, jnp.float32)
     return replay._replace(
-        obs=jax.lax.dynamic_update_slice(replay.obs, batch["obs"], (p, 0)),
+        obs=jax.lax.dynamic_update_slice(
+            replay.obs, _encode_obs(batch["obs"], obs_dtype, obs_scale), (p, 0)
+        ),
         action=jax.lax.dynamic_update_slice(replay.action, batch["action"], (p, 0)),
         reward=jax.lax.dynamic_update_slice(replay.reward, batch["reward"], (p,)),
         next_obs=jax.lax.dynamic_update_slice(
-            replay.next_obs, batch["next_obs"], (p, 0)
+            replay.next_obs, _encode_obs(batch["next_obs"], obs_dtype, obs_scale), (p, 0)
         ),
         discount=jax.lax.dynamic_update_slice(
             replay.discount, batch["discount"], (p,)
@@ -103,6 +130,8 @@ def make_on_device_trainer(
     train_steps_per_iter: int = 32,
     mesh=None,
     axis_name: str = "dp",
+    obs_uint8: bool = False,
+    obs_scale: float = 255.0,
 ):
     """Build (init_fn, warmup_fn, iterate_fn) for the fully-jitted loop.
 
@@ -153,6 +182,12 @@ def make_on_device_trainer(
             + (f" — both are per-device ÷{D})" if D > 1 else ")")
         )
     noise_init, noise_sample, noise_reset = make_noise(config)
+    obs_dtype = jnp.uint8 if obs_uint8 else jnp.float32
+
+    def _decode_batches(b: dict) -> dict:
+        b["obs"] = _decode_obs(b["obs"], obs_dtype)
+        b["next_obs"] = _decode_obs(b["next_obs"], obs_dtype)
+        return b
 
     def _fold_local(key):
         """Distinct per-device stream from the replicated carry key."""
@@ -167,7 +202,8 @@ def make_on_device_trainer(
         env_states, obs = jax.vmap(env.reset)(reset_keys)
         noise_states = jax.vmap(lambda _: noise_init())(jnp.arange(num_envs))
         replay = device_replay_init(
-            replay_capacity, config.obs_dim, config.action_dim
+            replay_capacity, config.obs_dim, config.action_dim,
+            obs_dtype=obs_dtype,
         )
         return (state, env_states, obs, noise_states, replay, k_carry)
 
@@ -183,7 +219,7 @@ def make_on_device_trainer(
             state.actor_params, env_states, obs, noise_states,
             _fold_local(k_roll), scale,
         )
-        replay = _append(replay, flat, n_new, config.per_alpha)
+        replay = _append(replay, flat, n_new, config.per_alpha, obs_scale)
         return env_states, obs, noise_states, replay, traj
 
     def warmup_body(carry, noise_scale):
@@ -224,7 +260,7 @@ def make_on_device_trainer(
             weights = (p * size_f) ** (-beta)
             min_p = jnp.min(jnp.where(prio > 0, prio, jnp.inf)) / total
             weights = weights / ((min_p * size_f) ** (-beta))
-            batches = gather_batches(replay, idx)
+            batches = _decode_batches(gather_batches(replay, idx))
             batches["weights"] = weights
             state, metrics, new_pri = fused_train_scan(
                 config, state, batches, axis_name=axis
@@ -247,7 +283,8 @@ def make_on_device_trainer(
         else:
             idx = jax.random.randint(k_train, (K, B), 0, replay.size)
             state, metrics, _ = fused_train_scan(
-                config, state, gather_batches(replay, idx), axis_name=axis
+                config, state, _decode_batches(gather_batches(replay, idx)),
+                axis_name=axis,
             )
         metrics = jax.tree_util.tree_map(jnp.mean, metrics)
         proxy = jnp.sum(traj.reward) / jnp.maximum(
@@ -354,6 +391,12 @@ def run_on_device(config) -> dict:
         batch_size=config.batch_size,
         train_steps_per_iter=K,
         mesh=mesh,
+        # Pixel frames store uint8-quantized in the HBM ring — the same 4×
+        # saving and obs_scale convention as the host buffer
+        # (replay/uniform.py: scale 255 for [0,1]-float envs, 1.0 for
+        # byte-image envs; decoded batches are always [0,1]).
+        obs_uint8=bool(agent_cfg.pixel_shape),
+        obs_scale=getattr(env, "obs_scale", None) or 255.0,
     )
 
     key = jax.random.PRNGKey(config.seed)

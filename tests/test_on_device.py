@@ -232,3 +232,64 @@ def test_run_on_device_cli_driver_dp(tmp_path):
     out = run_on_device(config_from_args(build_parser().parse_args(argv)))
     assert np.isfinite(out["critic_loss"])
     assert "eval_return_mean" in out
+
+
+def test_on_device_uint8_obs_ring():
+    """Pixel-style [0,1] obs store uint8 in the device ring and decode to
+    within quantization error on the training path."""
+    import jax.numpy as jnp
+    from d4pg_tpu.runtime.on_device import (
+        _append,
+        _decode_obs,
+        device_replay_init,
+    )
+
+    replay = device_replay_init(64, 8, 1, obs_dtype=jnp.uint8)
+    assert replay.obs.dtype == jnp.uint8
+    rng = np.random.default_rng(0)
+    obs = jnp.asarray(rng.uniform(0, 1, (16, 8)), jnp.float32)
+    batch = {
+        "obs": obs,
+        "action": jnp.zeros((16, 1)),
+        "reward": jnp.zeros((16,)),
+        "next_obs": obs,
+        "discount": jnp.full((16,), 0.99),
+    }
+    replay = _append(replay, batch, 16, alpha=0.6)
+    decoded = _decode_obs(replay.obs[:16], jnp.uint8)
+    np.testing.assert_allclose(np.asarray(decoded), np.asarray(obs), atol=1 / 255)
+
+
+def test_on_device_pixel_trainer_uint8(tmp_path, monkeypatch):
+    """run_on_device on the pixel env: the uint8 ring path is actually
+    engaged (factory receives obs_uint8=True, scale 255) and a training
+    iteration is finite."""
+    import dataclasses
+
+    import d4pg_tpu.runtime.on_device as od
+    from train import build_parser, config_from_args
+    from d4pg_tpu.runtime.on_device import run_on_device
+
+    argv = [
+        "--env", "pixel_pendulum", "--on-device", "--num-envs", "2",
+        "--total-steps", "2", "--eval-interval", "2", "--eval-episodes", "1",
+        "--checkpoint-interval", "1000000", "--max-steps", "24",
+        "--env-steps-per-train-step", "32",
+        "--bsize", "16", "--rmsize", "128", "--warmup", "0",
+        "--log-dir", str(tmp_path / "run"),
+    ]
+    cfg = config_from_args(build_parser().parse_args(argv))
+    cfg = dataclasses.replace(
+        cfg, agent=dataclasses.replace(cfg.agent, hidden_sizes=(32, 32))
+    )
+    captured = {}
+    orig = od.make_on_device_trainer
+
+    def spy(*a, **kw):
+        captured.update(kw)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(od, "make_on_device_trainer", spy)
+    out = run_on_device(cfg)
+    assert np.isfinite(out["critic_loss"])
+    assert captured["obs_uint8"] is True and captured["obs_scale"] == 255.0
