@@ -20,3 +20,26 @@ TPU-first design (not a port):
 """
 
 __version__ = "0.1.0"
+
+# Lazy top-level API (PEP 562): the package's primary surface without
+# importing JAX-heavy modules until first use.
+_EXPORTS = {
+    "D4PGConfig": "d4pg_tpu.agent.state",
+    "TrainState": "d4pg_tpu.agent.state",
+    "DistConfig": "d4pg_tpu.models.critic",
+    "TrainConfig": "d4pg_tpu.config",
+    "apply_env_preset": "d4pg_tpu.config",
+    "create_train_state": "d4pg_tpu.agent",
+    "train_step": "d4pg_tpu.agent",
+    "Trainer": "d4pg_tpu.runtime",
+    "evaluate": "d4pg_tpu.runtime",
+    "make_on_device_trainer": "d4pg_tpu.runtime.on_device",
+    "run_on_device": "d4pg_tpu.runtime.on_device",
+    "make_env": "d4pg_tpu.envs",
+}
+
+__all__ = list(_EXPORTS) + ["__version__"]
+
+from d4pg_tpu._lazy import lazy_exports as _lazy_exports
+
+__getattr__, __dir__ = _lazy_exports(__name__, _EXPORTS)

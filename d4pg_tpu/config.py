@@ -16,7 +16,6 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from d4pg_tpu.agent.state import D4PGConfig
-from d4pg_tpu.models.critic import DistConfig
 
 
 @dataclass(frozen=True)

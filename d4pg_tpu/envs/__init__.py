@@ -28,10 +28,6 @@ _EXPORTS = {
 
 __all__ = list(_EXPORTS)
 
+from d4pg_tpu._lazy import lazy_exports as _lazy_exports
 
-def __getattr__(name: str):
-    if name in _EXPORTS:
-        import importlib
-
-        return getattr(importlib.import_module(_EXPORTS[name]), name)
-    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+__getattr__, __dir__ = _lazy_exports(__name__, _EXPORTS)

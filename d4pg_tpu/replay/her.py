@@ -17,7 +17,7 @@ exactly as the reference does (``main.py:73-79,144``).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, List
 
 import numpy as np

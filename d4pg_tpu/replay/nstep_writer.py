@@ -17,7 +17,7 @@ from collections import deque
 
 import numpy as np
 
-from d4pg_tpu.replay.uniform import ReplayBuffer, Transition
+from d4pg_tpu.replay.uniform import ReplayBuffer
 
 
 class NStepWriter:

@@ -18,7 +18,6 @@ min tree), priorities update as ``(|td| + ε)^α``. Differences by design:
 
 from __future__ import annotations
 
-from typing import Mapping
 
 import numpy as np
 

@@ -27,7 +27,6 @@ reference's Hogwild staleness).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import NamedTuple
 
 import jax
