@@ -93,6 +93,17 @@ class TrainConfig:
     snapshot_replay: bool = False
     # capture a jax.profiler trace of grad steps [10, 60) into this dir
     profile_dir: Optional[str] = None
+    # Failure detection / elastic restart: when > 0, the trainer watches its
+    # own RSS at every eval crossing and, past the limit, checkpoints
+    # (state + replay snapshot if enabled), sets Trainer.preempted, and
+    # returns; train.py then exits 75 (vs 0 on completion) so a supervisor
+    # reruns with --resume and the remaining --total-steps budget
+    # (docs/REMOTE_TPU.md has the loop). Exists because long runs can be
+    # killed by the host (OOM killers, leaky device-client libraries: the
+    # tunneled-TPU client here leaks every host→device transfer's host
+    # buffer, ~1.3 MB per fused dispatch); a clean self-preemption beats a
+    # SIGKILL that loses everything since the last checkpoint.
+    max_rss_gb: float = 0.0
 
     # distribution
     dp: Optional[int] = None           # None → single device

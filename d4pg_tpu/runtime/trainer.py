@@ -59,6 +59,37 @@ from d4pg_tpu.runtime.metrics import MetricsLogger, interval_crossed
 from d4pg_tpu.utils.profiling import annotate
 
 
+_warned_no_procfs = False
+
+
+def _rss_gb() -> float:
+    """This process's resident set size in GB. /proc on Linux; elsewhere
+    falls back to the peak RSS from getrusage (for a leak watchdog,
+    peak ≈ current) with a one-time warning rather than silently reporting
+    0 and disarming the watchdog."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS"):
+                    return int(line.split()[1]) / 1024 / 1024
+    except OSError:
+        pass
+    global _warned_no_procfs
+    if not _warned_no_procfs:
+        _warned_no_procfs = True
+        print(
+            "[rss-watchdog] /proc/self/status unavailable; using peak RSS "
+            "from getrusage"
+        )
+    import resource
+    import sys
+
+    peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    if sys.platform == "darwin":  # bytes there, KB on Linux/BSD
+        return peak / 1024**3
+    return peak / 1024 / 1024
+
+
 def _env_dims(env) -> tuple[int, int]:
     """Ground-truth obs/action dims from a constructed env."""
     if isinstance(env, PointMassGoal):
@@ -184,6 +215,9 @@ class Trainer:
         self.grad_steps = 0
         self.env_steps = 0
         self.ewma_return: Optional[float] = None
+        # Set when the RSS watchdog ends a run early (checkpointed); lets
+        # callers distinguish preemption from completion (train.py exits 75)
+        self.preempted = False
         self._replay_restored = False
         if config.resume and self.ckpt.latest_step() is not None:
             self.state = self.ckpt.restore(self.state)
@@ -930,8 +964,23 @@ class Trainer:
                     self._publish_params()
                 if crossed(cfg.eval_interval) or step >= total:
                     last = self._periodic(step, metrics, t_start, grad_steps_done)
-                if crossed(cfg.checkpoint_interval) or step >= total:
+                saved = crossed(cfg.checkpoint_interval) or step >= total
+                if saved:
                     self._save_checkpoint()
+                if (
+                    cfg.max_rss_gb > 0
+                    and crossed(cfg.eval_interval)
+                    and _rss_gb() > cfg.max_rss_gb
+                ):
+                    if not saved:  # don't rewrite meta + replay snapshot
+                        self._save_checkpoint()
+                    print(
+                        f"[rss-watchdog] RSS {_rss_gb():.1f} GB > "
+                        f"--max-rss-gb {cfg.max_rss_gb}: checkpointed at step "
+                        f"{self.grad_steps}; exiting for a --resume restart"
+                    )
+                    self.preempted = True
+                    break
         finally:
             if tracing:
                 jax.profiler.stop_trace()

@@ -263,3 +263,27 @@ def test_trainer_meta_roundtrip(tmp_path):
     assert meta == {"env_steps": 12345, "ewma_return": -42.5}
     # atomic write: no .tmp left behind
     assert not os.path.exists(trainer_meta_path(log_dir) + ".tmp")
+
+
+def test_rss_watchdog_checkpoints_and_exits(tmp_path):
+    """--max-rss-gb: a tiny limit trips at the first eval crossing; the
+    trainer checkpoints and returns early instead of running to total."""
+    import dataclasses
+
+    cfg = config_from_args(_tiny_args(tmp_path / "w"))
+
+    cfg = dataclasses.replace(
+        cfg, max_rss_gb=0.001, total_steps=200, eval_interval=10,
+        checkpoint_interval=1000,
+    )
+    t = Trainer(cfg)
+    try:
+        t.train()
+        assert t.preempted  # callers key exit-75 off this
+        assert t.grad_steps < 200  # preempted, not completed
+        assert t.ckpt.latest_step() == t.grad_steps  # checkpointed at exit
+        assert os.path.exists(
+            os.path.join(cfg.log_dir, "checkpoints", "trainer_meta.json")
+        )
+    finally:
+        t.close()

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import sys
 
 from d4pg_tpu.agent.state import D4PGConfig
 from d4pg_tpu.config import TrainConfig
@@ -112,6 +113,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tree-backend", choices=["auto", "numpy", "native"], default="auto")
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace of grad steps 10-60 here")
+    p.add_argument("--max-rss-gb", type=float, default=0.0,
+                   help="RSS watchdog: past this limit the trainer "
+                        "checkpoints and exits cleanly so a supervisor can "
+                        "--resume (0 = off); guards against host OOM kills "
+                        "and leaky device-client libraries")
     return p
 
 
@@ -172,6 +178,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         resume=args.resume,
         snapshot_replay=args.snapshot_replay,
         profile_dir=args.profile_dir,
+        max_rss_gb=args.max_rss_gb,
         dp=args.dp,
         tp=args.tp,
         agent=agent,
@@ -213,6 +220,11 @@ def main(argv=None) -> None:
         print(f"done: {final}")
     finally:
         trainer.close()
+    if trainer.preempted:
+        # EX_TEMPFAIL: "checkpointed, restart me with --resume" — a
+        # supervisor loop keys on this to distinguish preemption (75) from
+        # completion (0). See docs/REMOTE_TPU.md.
+        sys.exit(75)
 
 
 if __name__ == "__main__":
