@@ -357,7 +357,7 @@ def run_on_device(config) -> dict:
     )
     from d4pg_tpu.runtime.evaluator import evaluate
     from d4pg_tpu.runtime.metrics import MetricsLogger, interval_crossed
-    from d4pg_tpu.runtime.trainer import _reconcile_config
+    from d4pg_tpu.runtime.trainer import _reconcile_config, _rss_gb
 
     env = make_env(config.env, config.max_episode_steps)
     if hasattr(env, "last_goal_obs"):
@@ -508,10 +508,27 @@ def run_on_device(config) -> dict:
                 grad_steps >= total
             ):
                 _eval_and_log(m)
-            if interval_crossed(prev, grad_steps, config.checkpoint_interval) or (
-                grad_steps >= total
-            ):
+            saved = interval_crossed(
+                prev, grad_steps, config.checkpoint_interval
+            ) or (grad_steps >= total)
+            if saved:
                 _save()
+            if (
+                config.max_rss_gb > 0
+                and grad_steps < total
+                and interval_crossed(prev, grad_steps, config.eval_interval)
+                and _rss_gb() > config.max_rss_gb
+            ):
+                if not saved:
+                    _save()
+                print(
+                    f"[rss-watchdog] RSS {_rss_gb():.1f} GB > "
+                    f"--max-rss-gb {config.max_rss_gb}: checkpointed at "
+                    f"step {grad_steps}; exiting for a --resume restart"
+                )
+                last = dict(last)
+                last["_preempted"] = True
+                break
     finally:
         ckpt.wait()
         logger.close()

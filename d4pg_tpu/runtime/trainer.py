@@ -271,6 +271,12 @@ class Trainer:
         # fresh collection; this one is cumulative across chunked train()
         # calls but starts at 0 per process.
         self._learner_steps = 0
+        # Per-process env-step origin, for the same reason on the other
+        # side: pacing against the checkpoint-restored global env_steps
+        # made resumed legs collect NOTHING (the global counter already
+        # dwarfed ratio·learner_steps, so the collector slept forever and
+        # the learner trained off the frozen restored buffer).
+        self._env_steps_origin = self.env_steps
         if config.her:
             self._setup_her()
         elif self.is_jax_env:
@@ -545,7 +551,8 @@ class Trainer:
         try:
             while not self._stop_collect.is_set():
                 target = self._effective_warmup() + ratio * self._learner_steps + slack
-                if self.env_steps >= target and len(self.buffer) >= cfg.batch_size:
+                fresh = self.env_steps - self._env_steps_origin
+                if fresh >= target and len(self.buffer) >= cfg.batch_size:
                     time.sleep(0.002)
                     continue
                 noise = 3.0 if self.env_steps < self._effective_warmup() else None
@@ -847,6 +854,7 @@ class Trainer:
             self._start_writeback()
 
         t_start = time.monotonic()
+        env_steps_start = self.env_steps  # per-leg delta for throughput
         grad_steps_done = 0
         pending = None  # (indices, priorities future) — one-step pipeline lag
         last = {}
@@ -879,7 +887,7 @@ class Trainer:
                     # collecting), and never sample a buffer that can't
                     # serve a batch (HER flushes only at episode ends)
                     while (
-                        self.env_steps
+                        self.env_steps - self._env_steps_origin
                         < self._effective_warmup()
                         + cfg.env_steps_per_train_step * self._learner_steps
                     ) or len(self.buffer) < cfg.batch_size:
@@ -963,12 +971,15 @@ class Trainer:
                 if cfg.async_collect and crossed(cfg.publish_interval):
                     self._publish_params()
                 if crossed(cfg.eval_interval) or step >= total:
-                    last = self._periodic(step, metrics, t_start, grad_steps_done)
+                    last = self._periodic(
+                        metrics, t_start, grad_steps_done, env_steps_start
+                    )
                 saved = crossed(cfg.checkpoint_interval) or step >= total
                 if saved:
                     self._save_checkpoint()
                 if (
                     cfg.max_rss_gb > 0
+                    and step < total  # a finished run is completion, not preemption
                     and crossed(cfg.eval_interval)
                     and _rss_gb() > cfg.max_rss_gb
                 ):
@@ -1109,7 +1120,7 @@ class Trainer:
             "success_rate": succ / cfg.eval_episodes,
         }
 
-    def _periodic(self, step, metrics, t_start, grad_steps_done) -> dict:
+    def _periodic(self, metrics, t_start, grad_steps_done, env_steps_start) -> dict:
         cfg = self.config
         scalars = {k: float(v) for k, v in jax.device_get(metrics).items()}
         if self.is_jax_env:
@@ -1133,15 +1144,21 @@ class Trainer:
         dt = time.monotonic() - t_start
         scalars.update(
             {
+                # Both rates are per-leg deltas over per-leg time; the
+                # checkpoint-restored global counters would inflate a
+                # resumed leg's throughput by orders of magnitude.
                 "grad_steps_per_sec": grad_steps_done / dt,
-                "env_steps_per_sec": self.env_steps / dt,
+                "env_steps_per_sec": (self.env_steps - env_steps_start) / dt,
                 "replay_size": len(self.buffer),
                 "env_steps": self.env_steps,
             }
         )
-        self.metrics.log(step, scalars)
+        # Log against the GLOBAL step (survives --resume legs): per-leg
+        # steps made multi-leg metrics.jsonl non-monotone, which zigzags
+        # any step-keyed plot.
+        self.metrics.log(self.grad_steps, scalars)
         print(
-            f"[step {step}] "
+            f"[step {self.grad_steps}] "
             + " ".join(f"{k}={v:.3f}" for k, v in scalars.items() if k != "replay_size")
         )
         return scalars

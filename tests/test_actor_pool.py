@@ -427,3 +427,38 @@ class TestHERPool:
             assert np.isfinite(out["critic_loss"])
         finally:
             t.close()
+
+
+def test_async_resume_still_collects(tmp_path):
+    """Regression: async pacing must compare per-process FRESH env steps
+    against the learner's ratio, not the checkpoint-restored global counter
+    — the global comparison made resumed legs collect nothing and train
+    forever off the frozen restored buffer."""
+    import dataclasses
+
+    from d4pg_tpu.runtime.trainer import Trainer
+
+    base = _cfg(
+        log_dir=str(tmp_path / "run"),
+        async_collect=True,
+        total_steps=6,
+        snapshot_replay=True,
+        checkpoint_interval=6,
+    )
+    t = Trainer(base)
+    try:
+        t.train()
+    finally:
+        t.close()
+
+    cfg2 = dataclasses.replace(base, resume=True, total_steps=8)
+    t2 = Trainer(cfg2)
+    try:
+        restored_env_steps = t2.env_steps
+        assert restored_env_steps > 0  # meta restored
+        t2.train()
+        # the resumed leg collected fresh experience (ratio-paced) instead
+        # of sleeping on the restored global counter
+        assert t2.env_steps > restored_env_steps
+    finally:
+        t2.close()

@@ -293,3 +293,27 @@ def test_on_device_pixel_trainer_uint8(tmp_path, monkeypatch):
     out = run_on_device(cfg)
     assert np.isfinite(out["critic_loss"])
     assert captured["obs_uint8"] is True and captured["obs_scale"] == 255.0
+
+
+def test_on_device_rss_watchdog(tmp_path):
+    """--max-rss-gb works in --on-device mode too: a tiny limit preempts at
+    the first eval crossing with a checkpoint and the _preempted marker."""
+    import dataclasses
+    import os
+
+    from train import build_parser, config_from_args
+    from d4pg_tpu.runtime.on_device import run_on_device
+
+    argv = [
+        "--env", "pendulum", "--on-device", "--num-envs", "2",
+        "--total-steps", "64", "--eval-interval", "4", "--eval-episodes", "1",
+        "--checkpoint-interval", "1000000",
+        "--env-steps-per-train-step", "16",
+        "--bsize", "32", "--rmsize", "256", "--warmup", "0",
+        "--log-dir", str(tmp_path / "run"),
+    ]
+    cfg = config_from_args(build_parser().parse_args(argv))
+    cfg = dataclasses.replace(cfg, max_rss_gb=0.001)
+    out = run_on_device(cfg)
+    assert out.get("_preempted") is True
+    assert os.path.isdir(tmp_path / "run" / "checkpoints")

@@ -212,7 +212,10 @@ def main(argv=None) -> None:
         from d4pg_tpu.runtime.on_device import run_on_device
 
         final = run_on_device(cfg)
+        preempted = final.pop("_preempted", False)
         print(f"done: {final}")
+        if preempted:
+            sys.exit(75)  # rss-watchdog: checkpointed, restart with --resume
         return
     trainer = Trainer(cfg)
     try:
