@@ -1,0 +1,24 @@
+#!/bin/bash
+# Supervised HalfCheetah legs: each leg resumes from the newest checkpoint
+# and self-preempts via --max-rss-gb before the host OOM killer would act
+# (the tunnel client leaks every host->device transfer; docs/REMOTE_TPU.md).
+TOTAL=6000000
+DIR=runs/halfcheetah_tpu_r2
+while :; do
+  STEP=$(ls "$DIR/checkpoints" 2>/dev/null | grep -E '^[0-9]+$' | sort -n | tail -1)
+  STEP=${STEP:-0}
+  REM=$((TOTAL - STEP))
+  if [ "$REM" -le 0 ]; then echo "supervisor: done at step $STEP"; break; fi
+  echo "supervisor: leg from step $STEP, $REM to go"
+  python train.py --env HalfCheetah-v5 --num-envs 8 --async-collect \
+    --async-writeback --steps-per-dispatch 32 --n-step 5 \
+    --v-min -100 --v-max 1500 --noise-decay-steps 2000000 \
+    --noise-scale-final 0.15 --total-steps "$REM" --eval-interval 20000 \
+    --eval-episodes 5 --checkpoint-interval 100000 --snapshot-replay \
+    --resume --max-rss-gb 80 --log-dir "$DIR"
+  RC=$?
+  # 75 = watchdog preemption (checkpointed; go again); 0 = leg budget done
+  if [ "$RC" -ne 75 ] && [ "$RC" -ne 0 ]; then
+    echo "supervisor: leg failed rc=$RC"; exit "$RC"
+  fi
+done
