@@ -18,12 +18,27 @@ min tree), priorities update as ``(|td| + ε)^α``. Differences by design:
 
 from __future__ import annotations
 
+from typing import NamedTuple
 
 import numpy as np
 
 from d4pg_tpu.replay.schedules import linear_schedule
 from d4pg_tpu.replay.segment_tree import MinTree, SumTree
 from d4pg_tpu.replay.uniform import ReplayBuffer, Transition
+
+
+class SampledIndices(NamedTuple):
+    """Slot indices plus the write generations they were sampled at.
+
+    The async priority flusher applies updates hundreds of grad steps after
+    sampling; with a fast collector the slot may have been recycled by then.
+    ``update_priorities`` compares generations and drops write-backs for
+    recycled slots — a Hogwild-class staleness is acceptable, stamping a
+    *different transition* with this batch's TD priority is not.
+    """
+
+    idx: np.ndarray  # [B] int
+    gen: np.ndarray  # [B] int64 — ReplayBuffer._gen[idx] at sample time
 
 
 class PrioritizedReplayBuffer(ReplayBuffer):
@@ -101,8 +116,12 @@ class PrioritizedReplayBuffer(ReplayBuffer):
             min_p = self._min.min() / total
             max_w = (min_p * self._size) ** (-beta)
             weights = weights / max_w
+            # Capture generations BEFORE gather: if a writer recycles a slot
+            # in between, the stale stamp makes update_priorities drop that
+            # entry (conservative) rather than mis-stamp the new transition.
+            gen = self._gen[idx].copy()
         batch = dict(self.gather(idx))
-        batch["indices"] = idx
+        batch["indices"] = SampledIndices(idx, gen)
         batch["weights"] = weights.astype(np.float32)
         return batch
 
@@ -143,11 +162,25 @@ class PrioritizedReplayBuffer(ReplayBuffer):
             self._min.set(tail, np.full(tail.shape, np.inf))
         return n
 
-    def update_priorities(self, indices: np.ndarray, priorities: np.ndarray) -> None:
-        """(|priority| + ε)^α into both trees (reference ``:315-335``)."""
+    def update_priorities(self, indices, priorities: np.ndarray) -> None:
+        """(|priority| + ε)^α into both trees (reference ``:315-335``).
+
+        ``indices`` may be a raw index array or the :class:`SampledIndices`
+        that :meth:`sample` returned; with the latter, entries whose slot was
+        recycled since sampling (write generation changed) are dropped.
+        """
         priorities = np.abs(np.asarray(priorities, np.float64)) + self.eps
         assert np.all(priorities > 0)
         with self._lock:
+            if isinstance(indices, SampledIndices):
+                live = self._gen[indices.idx] == indices.gen
+                if not live.all():
+                    indices = indices.idx[live]
+                    priorities = priorities[live]
+                    if indices.size == 0:
+                        return
+                else:
+                    indices = indices.idx
             pa = priorities**self.alpha
             self._sum.set(indices, pa)
             self._min.set(indices, pa)

@@ -45,18 +45,35 @@ class ReplayBuffer:
         — 4× less host RAM for pixel envs, the standard pixel-replay layout.
         ``obs_scale`` is the fixed store-time multiplier, declared once at
         construction (guessing the convention per frame mis-encodes dark
-        frames): 255.0 for [0,1]-float envs (the default), 1.0 for envs that
-        already emit [0,255] bytes. Decoded batches are always [0,1] floats.
-        Flat envs keep f32 and ignore ``obs_scale``."""
+        frames). Only 255.0 ([0,1]-float envs, the default) is accepted:
+        decoded batches are always [0,1] floats, so an env emitting raw
+        [0,255] bytes would act on a different input range than it trains
+        on — byte envs must normalize at the env boundary instead. Flat
+        envs keep f32 and ignore ``obs_scale``."""
         self.capacity = int(capacity)
         self.obs_dtype = np.dtype(obs_dtype)
         self._quantized = self.obs_dtype == np.uint8
         self._obs_scale = float(obs_scale) if obs_scale is not None else 255.0
+        if self._quantized and self._obs_scale != 255.0:
+            # With scale≠255 the stored rows decode to [0,1] while acting/eval
+            # feed the RAW env range to the same actor — a train/act input
+            # mismatch. Byte envs must normalize at the env boundary (emit
+            # [0,1] floats) instead of relying on store-time scale.
+            raise ValueError(
+                "obs_scale must be 255.0 (env emits [0,1] floats); byte-image "
+                "envs should normalize observations at the env boundary"
+            )
         self.obs = np.zeros((capacity, obs_dim), self.obs_dtype)
         self.action = np.zeros((capacity, action_dim), np.float32)
         self.reward = np.zeros((capacity,), np.float32)
         self.next_obs = np.zeros((capacity, obs_dim), self.obs_dtype)
         self.discount = np.zeros((capacity,), np.float32)
+        # Per-slot write generation: bumped on every overwrite so async
+        # priority write-backs can detect that a sampled slot was recycled
+        # (new transition) before the flush landed, and drop the update
+        # instead of stamping a fresh max-priority insert with another
+        # transition's TD priority.
+        self._gen = np.zeros((capacity,), np.int64)
         self._pos = 0
         self._size = 0
         self._lock = threading.Lock()
@@ -88,6 +105,7 @@ class ReplayBuffer:
             self.reward[idx] = np.asarray(t.reward, np.float32).reshape(n)
             self.next_obs[idx] = self._encode_obs(t.next_obs)
             self.discount[idx] = np.asarray(t.discount, np.float32).reshape(n)
+            self._gen[idx] += 1
             self._pos = int((self._pos + n) % self.capacity)
             self._size = int(min(self._size + n, self.capacity))
         return idx
@@ -164,6 +182,9 @@ class ReplayBuffer:
         self.reward[:n] = data["reward"]
         self.next_obs[:n] = data["next_obs"]
         self.discount[:n] = data["discount"]
+        # Every row changed identity: invalidate any generation stamps
+        # captured by samples taken before the restore.
+        self._gen += 1
         self._size = n
         # Same capacity → resume the saved write head so FIFO eviction order
         # survives a wrapped ring; different capacity → data sits at [0, n).

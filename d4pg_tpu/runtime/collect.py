@@ -13,6 +13,16 @@ Windows never span segment boundaries: the last up-to-(n−1) steps of a
 segment bootstrap early with the exact ``γ^m`` of their shortened window —
 a valid m-step Bellman target, the same convention as episode truncation
 (:func:`d4pg_tpu.ops.nstep_returns` with ``truncations``).
+
+DOCUMENTED DEVIATION from the reference's (intended) continuous n-step
+writer: with 32-step segments and n=5, ~12.5% of stored transitions carry a
+shortened (m<n) window, which slightly shifts the target distribution
+toward 1-step-like backups at segment edges. Every stored target remains an
+exact m-step Bellman target, so this is a sampling-mix difference, not a
+correctness bug (advisor round-1 review). If exact reference parity ever
+matters, ring the last n−1 transitions of each segment into the next
+collect call; the async/HER paths already use the continuous
+``NStepWriter`` and are unaffected.
 """
 
 from __future__ import annotations

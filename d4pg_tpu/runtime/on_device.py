@@ -173,6 +173,14 @@ def make_on_device_trainer(
         replay_capacity //= D
         batch_size //= D
     axis = axis_name if mesh is not None else None
+    if obs_uint8 and obs_scale != 255.0:
+        # Mirror of ReplayBuffer's guard: _decode_obs always maps to [0,1],
+        # so acting on raw env frames and training on decoded batches only
+        # agree when the env itself emits [0,1] floats (scale 255).
+        raise ValueError(
+            "obs_scale must be 255.0 (env emits [0,1] floats); byte-image "
+            "envs should normalize observations at the env boundary"
+        )
     n_new = num_envs * segment_len
     if replay_capacity % n_new != 0:
         raise ValueError(
@@ -421,7 +429,13 @@ def run_on_device(config) -> dict:
     carry = init_fn(state, k_init)
     logger = MetricsLogger(config.log_dir)
     last: dict = {}
-    total = config.total_steps
+    # --total-steps is a PER-INVOCATION budget, exactly like Trainer.train
+    # (`while grad_steps_done < total`): a resumed leg runs `total_steps`
+    # MORE grad steps on top of the restored counter. Supervisors
+    # (runs/hc_supervisor.sh, docs/REMOTE_TPU.md) pass the remainder each
+    # leg; with a global interpretation a restored step >= the remainder
+    # would make every leg eval-only and livelock the supervisor loop.
+    total = grad_steps + config.total_steps
     t0 = time.monotonic()
     grad_steps_done = 0
     env_steps_done = 0
@@ -490,10 +504,10 @@ def run_on_device(config) -> dict:
             save_trainer_meta(config.log_dir, env_steps, ewma)
 
         if grad_steps >= total:
-            # Resumed past total_steps: report instead of silently no-opping.
+            # Zero per-invocation budget: report instead of silently no-opping.
             print(
-                f"checkpoint already at step {grad_steps} >= total {total}; "
-                "running final eval only"
+                f"--total-steps {config.total_steps} leaves no budget at "
+                f"step {grad_steps}; running final eval only"
             )
             _eval_and_log(None)
             return last

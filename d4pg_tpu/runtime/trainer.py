@@ -162,8 +162,9 @@ class Trainer:
         # host RAM; [0,1] floats round-trip through ×255)
         obs_dim, act_dim = agent_cfg.obs_dim, agent_cfg.action_dim
         obs_dtype = np.uint8 if agent_cfg.pixel_shape else np.float32
-        # Envs declare their pixel convention once ([0,1] floats unless the
-        # env advertises obs_scale, e.g. 1.0 for byte-image envs).
+        # Envs declare their pixel convention once; only [0,1] floats
+        # (obs_scale 255.0) are accepted — byte-image envs must normalize at
+        # the env boundary (ReplayBuffer raises otherwise).
         obs_scale = getattr(self.env, "obs_scale", None)
         if config.prioritized:
             self.buffer = PrioritizedReplayBuffer(
@@ -263,6 +264,12 @@ class Trainer:
         self._wb_queue: Optional[queue.Queue] = None
         self._wb_thread: Optional[threading.Thread] = None
         self._wb_error: Optional[BaseException] = None
+        self._wb_idle = threading.Event()  # set ⇔ flusher applied all queued
+        self._wb_idle.set()
+        # Orders producer clear+put against flusher empty-check+set; without
+        # it the flusher can see empty(), lose the CPU to a producer's
+        # clear+put, then set() over a queued-but-unapplied item (TOCTOU).
+        self._wb_idle_lock = threading.Lock()
         self._actor_pub = None  # published param copy the async collector acts on
         self._eval_pool = None  # lazy parallel eval envs (host pool mode)
         # Trainer-lifetime grad-step counter for async pacing. Deliberately
@@ -625,16 +632,25 @@ class Trainer:
                         for k, ix in enumerate(idx_all):
                             if ix is not None:
                                 self.buffer.update_priorities(ix, pri[k])
+                with self._wb_idle_lock:
+                    if self._wb_queue.empty():
+                        # idle == queue drained AND updates applied; producers
+                        # clear it (under the same lock) before every put, so
+                        # a snapshot waiting on it never reads priorities with
+                        # flushes still in flight
+                        self._wb_idle.set()
                 if stop:
                     return
         except BaseException as e:
             self._wb_error = e
+            self._wb_idle.set()  # never leave a snapshot drain hanging
             raise
 
     def _start_writeback(self):
         if self._wb_thread is not None and self._wb_thread.is_alive():
             raise RuntimeError("a priority write-back thread is already running")
         self._wb_queue = queue.Queue()
+        self._wb_idle.set()
         self._wb_error = None
         self._wb_thread = threading.Thread(
             target=self._writeback_loop, name="priority-writeback", daemon=True
@@ -669,7 +685,21 @@ class Trainer:
             priorities = priorities[None]
         if hasattr(priorities, "copy_to_host_async"):
             priorities.copy_to_host_async()
-        self._wb_queue.put((indices, priorities))
+        with self._wb_idle_lock:
+            self._wb_idle.clear()
+            self._wb_queue.put((indices, priorities))
+
+    def _drain_writeback(self, timeout: float = 60.0) -> None:
+        """Block until the flusher has applied everything queued so far —
+        called before a replay snapshot so snapshotted priorities are not
+        stale. A dead flusher is surfaced by the next _queue_writeback."""
+        if self._wb_thread is None or not self._wb_thread.is_alive():
+            return
+        if not self._wb_idle.wait(timeout):
+            print(
+                "[priority-writeback] queue not drained within "
+                f"{timeout:.0f} s; replay snapshot may hold stale priorities"
+            )
 
     # ------------------------------------------------------------------- HER
     def _make_her_writer(self, reward_fn) -> HindsightWriter:
@@ -997,7 +1027,18 @@ class Trainer:
                 jax.profiler.stop_trace()
             if cfg.async_collect:
                 self._stop_collector()
-            self._stop_writeback()  # flushes everything still queued
+            try:
+                self._stop_writeback()  # flushes everything still queued
+            except RuntimeError as e:
+                # __context__ is the exception already propagating out of the
+                # loop body (implicit chaining inside `finally`); raising over
+                # it would mask it and skip the trailing pending write-back +
+                # ckpt.wait below. Report instead; raise only when this is
+                # the sole failure.
+                if e.__context__ is not None:
+                    print(f"[priority-writeback] {e} (original error propagating)")
+                else:
+                    raise
         if pending is not None and self.config.prioritized:
             self._write_back(pending)
         self.ckpt.wait()
@@ -1017,6 +1058,9 @@ class Trainer:
         # would restart exploration at full scale.
         save_trainer_meta(self.config.log_dir, self.env_steps, self.ewma_return)
         if self.config.snapshot_replay:
+            # Apply in-flight async priority updates first, else the snapshot
+            # freezes priorities the flusher was about to overwrite.
+            self._drain_writeback()
             with annotate("host/replay_snapshot"):
                 self.buffer.snapshot(self._replay_snapshot_path())
 

@@ -160,11 +160,10 @@ def test_run_on_device_cli_driver(tmp_path):
     ]
     assert lines and lines[-1]["step"] == 8
     assert os.path.isdir(tmp_path / "run" / "checkpoints")
-    # resume restores the step counter from the checkpoint
+    # resume restores the step counter; --total-steps is a PER-INVOCATION
+    # budget (matches Trainer.train and the supervisor recipe): 8 restored
+    # + 8 more = 16
     cfg2 = config_from_args(build_parser().parse_args(argv + ["--resume"]))
-    import dataclasses
-
-    cfg2 = dataclasses.replace(cfg2, total_steps=16)
     out2 = run_on_device(cfg2)
     lines = [
         json.loads(l)

@@ -4,6 +4,7 @@ fused train step over flattened-pixel batches (BASELINE.json config 4)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from d4pg_tpu.agent import D4PGConfig, create_train_state, jit_train_step
 from d4pg_tpu.envs import PixelPendulum, rollout
@@ -171,22 +172,15 @@ def test_pixel_preset_wires_encoder_and_capacity():
     assert cfg2.replay_capacity == 5_000
 
 
-def test_uint8_replay_accepts_byte_range():
-    """[0,255] byte-image envs declare obs_scale=1.0 once at construction
-    (no per-frame convention guessing — a dark frame would defeat any
-    magnitude heuristic); decoded batches are always [0,1]."""
+def test_uint8_replay_rejects_byte_range_scale():
+    """obs_scale≠255 is a train/act input-scale trap (stored rows decode to
+    [0,1] while acting feeds the raw env range to the same actor), so the
+    buffer refuses it at construction — byte-image envs must normalize at
+    the env boundary instead (advisor round-1 #2)."""
     from d4pg_tpu.replay import ReplayBuffer
-    from d4pg_tpu.replay.uniform import Transition
 
-    rng = np.random.default_rng(1)
-    obs255 = rng.integers(0, 256, size=(8, 16)).astype(np.float32)
-    buf = ReplayBuffer(32, 16, 1, obs_dtype=np.uint8, obs_scale=1.0)
-    idx = buf.add_batch(
-        Transition(obs255, np.zeros((8, 1), np.float32), np.zeros(8, np.float32),
-                   obs255, np.ones(8, np.float32))
-    )
-    got = buf.gather(np.asarray(idx))
-    np.testing.assert_allclose(got["obs"], obs255 / 255.0, atol=1e-6)
+    with pytest.raises(ValueError, match="env boundary"):
+        ReplayBuffer(32, 16, 1, obs_dtype=np.uint8, obs_scale=1.0)
 
 
 def test_cli_default_path_applies_pixel_preset():

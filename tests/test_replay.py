@@ -62,6 +62,33 @@ def test_per_prioritized_sampling_prefers_high_td():
     assert w.max() < 1e-2
 
 
+def test_per_stale_writeback_dropped_for_recycled_slots():
+    """A write-back whose slot was overwritten since sampling must not stamp
+    the NEW transition with the OLD transition's TD priority (the async
+    flusher's slot-recycling hazard, advisor round-1 #3)."""
+    buf = PrioritizedReplayBuffer(8, 1, 1, alpha=1.0, eps=0.0, tree_backend="numpy")
+    _fill(buf, 8, obs_dim=1, act_dim=1)
+    batch = buf.sample(4, np.random.default_rng(0), step=0)
+    sampled = batch["indices"]
+    # recycle every slot (capacity-many fresh writes wrap the whole ring)
+    _fill(buf, 8, obs_dim=1, act_dim=1)
+    seed = buf._max_priority  # fresh inserts sit at max_priority^alpha
+    buf.update_priorities(sampled, np.full(4, 1e-6))
+    # all updates dropped: every leaf still carries the fresh-insert seed
+    np.testing.assert_allclose(buf._sum.get(np.arange(8)), seed, atol=1e-9)
+    # raw arrays (no generation stamp) keep the unconditional behavior
+    buf.update_priorities(np.asarray(sampled.idx), np.full(4, 1e-6))
+    assert buf._min.min() == pytest.approx(1e-6)
+
+
+def test_per_live_writeback_applies_with_generation_stamp():
+    buf = PrioritizedReplayBuffer(16, 1, 1, alpha=1.0, eps=0.0, tree_backend="numpy")
+    _fill(buf, 16, obs_dim=1, act_dim=1)
+    batch = buf.sample(6, np.random.default_rng(0), step=0)
+    buf.update_priorities(batch["indices"], np.full(6, 0.5))
+    assert buf._min.min() == pytest.approx(0.5)
+
+
 def test_per_beta_anneals():
     buf = PrioritizedReplayBuffer(64, 1, 1, beta0=0.4, beta_steps=100, tree_backend="numpy")
     assert buf.beta(0) == pytest.approx(0.4)
