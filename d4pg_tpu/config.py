@@ -83,6 +83,15 @@ class TrainConfig:
     # evaluation / logging / checkpoint
     eval_interval: int = 2_000         # grad steps between evals
     eval_episodes: int = 10            # reference main.py:309
+    # Host-env eval runs in a dedicated thread on a published param copy —
+    # the reference's separate evaluator process (main.py:103-134) — so an
+    # eval crossing costs the learner ZERO grad steps (a 10×1000-step
+    # HalfCheetah eval otherwise stalls it for seconds). If an eval is
+    # still in flight at the next crossing, the newer request replaces the
+    # waiting one (that crossing logs no row — same as the reference's
+    # time-based evaluator missing steps). Pure-JAX envs ignore this: their
+    # jitted on-device eval is already sub-dispatch-cost.
+    concurrent_eval: bool = True
     ewma_alpha: float = 0.05           # reference main.py:131
     log_dir: str = "runs/default"
     checkpoint_interval: int = 10_000

@@ -36,6 +36,12 @@ class DistConfig:
     v_min: float = -10.0
     v_max: float = 10.0
     num_mixtures: int = 5
+    # Gauss–Hermite nodes per target component for the MoG Bellman
+    # cross-entropy (mixture_gaussian head only): the target distribution
+    # r + γZ' is integrated against the online log-density with M×Q node
+    # evaluations — deterministic and exact for polynomials up to degree
+    # 2Q−1, so 8 nodes are ample for a smooth log-mixture.
+    quadrature_points: int = 8
 
     @property
     def head_dim(self) -> int:
@@ -83,13 +89,40 @@ class Critic(nn.Module):
                 name=f"hidden_{i}",
             )(x)
             x = nn.relu(x)
-        out = nn.Dense(
-            self.dist.head_dim,
-            kernel_init=nn.initializers.uniform(scale=self.final_init_scale),
-            bias_init=nn.initializers.uniform(scale=self.final_init_scale),
-            dtype=self.dtype,
-            name="out",
-        )(x)
+        if self.dist.kind == "mixture_gaussian":
+            # Scale-aware head init, mirroring what the categorical head
+            # gets for free from its fixed support: component means start
+            # spread across [v_min, v_max] and stds at one bin width, so
+            # the mixture covers the return range from step 0 instead of
+            # spending thousands of grad steps migrating from N(0, 1) to
+            # the environment's value scale (at Pendulum's −300..0 that
+            # migration dominated training and the head never caught up).
+            bias_init = nn.initializers.uniform(scale=self.final_init_scale)
+            M = self.dist.num_mixtures
+            span = self.dist.v_max - self.dist.v_min
+            centers = self.dist.v_min + (jnp.arange(M) + 0.5) * span / M
+
+            def mog_bias(key, shape, dtype=jnp.float32):
+                base = bias_init(key, shape, dtype)
+                return base.at[M : 2 * M].add(centers.astype(dtype)).at[
+                    2 * M :
+                ].add(jnp.log(span / M))
+
+            out = nn.Dense(
+                self.dist.head_dim,
+                kernel_init=nn.initializers.uniform(scale=self.final_init_scale),
+                bias_init=mog_bias,
+                dtype=self.dtype,
+                name="out",
+            )(x)
+        else:
+            out = nn.Dense(
+                self.dist.head_dim,
+                kernel_init=nn.initializers.uniform(scale=self.final_init_scale),
+                bias_init=nn.initializers.uniform(scale=self.final_init_scale),
+                dtype=self.dtype,
+                name="out",
+            )(x)
         return out.astype(jnp.float32)
 
 

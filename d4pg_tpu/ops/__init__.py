@@ -17,6 +17,11 @@ from d4pg_tpu.ops.noise import (
     ou_noise_reset,
     ou_noise_sample,
 )
+from d4pg_tpu.ops.mog import (
+    mog_bellman_targets,
+    mog_cross_entropy,
+    mog_log_prob,
+)
 from d4pg_tpu.ops.nstep import nstep_returns
 from d4pg_tpu.ops.polyak import polyak_update
 
@@ -34,6 +39,9 @@ __all__ = [
     "ou_noise_init",
     "ou_noise_reset",
     "ou_noise_sample",
+    "mog_bellman_targets",
+    "mog_cross_entropy",
+    "mog_log_prob",
     "nstep_returns",
     "polyak_update",
 ]

@@ -16,12 +16,16 @@ def initialize_distributed(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
     process_id: int | None = None,
+    autodetect: bool = False,
 ) -> dict:
     """Initialize the multi-host runtime (no-op on a single host).
 
-    On Cloud TPU pods, ``jax.distributed.initialize()`` with no arguments
-    autodetects everything from the TPU metadata server; explicit arguments
-    support other clusters. Returns a summary dict for logging.
+    Reached from the CLI via ``train.py --coordinator/--num-processes/
+    --process-id`` (explicit clusters) or ``--distributed`` (Cloud TPU pod:
+    ``jax.distributed.initialize()`` with no arguments autodetects
+    everything from the TPU metadata server). MUST run before the first
+    device access — the JAX backend binds to the local slice at first use
+    and cannot be re-spanned afterwards. Returns a summary dict for logging.
     """
     if coordinator_address is not None or (num_processes or 0) > 1:
         jax.distributed.initialize(
@@ -29,6 +33,8 @@ def initialize_distributed(
             num_processes=num_processes,
             process_id=process_id,
         )
+    elif autodetect:
+        jax.distributed.initialize()
     return {
         "process_index": jax.process_index(),
         "process_count": jax.process_count(),

@@ -400,8 +400,9 @@ def run_on_device(config) -> dict:
         mesh=mesh,
         # Pixel frames store uint8-quantized in the HBM ring — the same 4×
         # saving and obs_scale convention as the host buffer
-        # (replay/uniform.py: scale 255 for [0,1]-float envs, 1.0 for
-        # byte-image envs; decoded batches are always [0,1]).
+        # (replay/uniform.py: envs emit [0,1] floats, scale is always 255;
+        # byte-image envs must normalize at the env boundary — the factory
+        # guard rejects anything else; decoded batches are always [0,1]).
         obs_uint8=bool(agent_cfg.pixel_shape),
         obs_scale=getattr(env, "obs_scale", None) or 255.0,
     )

@@ -272,6 +272,20 @@ class Trainer:
         self._wb_idle_lock = threading.Lock()
         self._actor_pub = None  # published param copy the async collector acts on
         self._eval_pool = None  # lazy parallel eval envs (host pool mode)
+        # Concurrent evaluator (host envs): a dedicated thread scores
+        # published param copies so eval crossings cost the learner zero
+        # grad steps (reference evaluator process, main.py:103-134).
+        self._eval_thread: Optional[threading.Thread] = None
+        self._eval_req = None            # latest pending (params, step, scalars)
+        self._eval_req_lock = threading.Lock()
+        self._eval_pending = threading.Event()
+        self._eval_idle = threading.Event()
+        self._eval_idle.set()
+        self._eval_stop = threading.Event()
+        self._eval_error: Optional[BaseException] = None
+        self._eval_env = None            # dedicated env for single-env mode
+        self._last_eval_row: dict = {}   # most recent full logged row
+        self._last_eval_ev: dict = {}    # most recent eval-only scalars
         # Trainer-lifetime grad-step counter for async pacing. Deliberately
         # NOT self.grad_steps: that one is restored from checkpoints, which
         # would make a resumed learner wait for ratio·(all past steps) of
@@ -897,6 +911,7 @@ class Trainer:
             total = -(-total // K) * K
             print(f"total_steps rounded up to {total} (multiple of steps_per_dispatch={K})")
         profiled = False
+        loop_exc: Optional[BaseException] = None
         try:
             while grad_steps_done < total:
                 if (
@@ -1022,6 +1037,9 @@ class Trainer:
                     )
                     self.preempted = True
                     break
+        except BaseException as e:
+            loop_exc = e
+            raise
         finally:
             if tracing:
                 jax.profiler.stop_trace()
@@ -1030,17 +1048,25 @@ class Trainer:
             try:
                 self._stop_writeback()  # flushes everything still queued
             except RuntimeError as e:
-                # __context__ is the exception already propagating out of the
-                # loop body (implicit chaining inside `finally`); raising over
-                # it would mask it and skip the trailing pending write-back +
-                # ckpt.wait below. Report instead; raise only when this is
-                # the sole failure.
-                if e.__context__ is not None:
+                # An exception already propagating out of the loop body must
+                # not be masked by a drain failure (which would also skip the
+                # trailing pending write-back + ckpt.wait below). loop_exc is
+                # tracked explicitly — inspecting e.__context__ would misfire
+                # when train() itself runs inside a caller's except block
+                # (implicit chaining sets it there too).
+                if loop_exc is not None:
                     print(f"[priority-writeback] {e} (original error propagating)")
                 else:
                     raise
         if pending is not None and self.config.prioritized:
             self._write_back(pending)
+        if not self.is_jax_env and cfg.concurrent_eval:
+            # The final crossing's eval is (at most) still in flight; its row
+            # must exist before train() returns (callers read eval scalars
+            # from the result, supervisors from metrics.jsonl).
+            self._drain_eval()
+            if self._last_eval_row:
+                last = self._last_eval_row
         self.ckpt.wait()
         return last
 
@@ -1077,7 +1103,7 @@ class Trainer:
             elif idx is not None:
                 self.buffer.update_priorities(idx, pri)
 
-    def _pool_eval(self) -> dict:
+    def _pool_eval(self, eval_params=None) -> dict:
         """All eval episodes in parallel through a dedicated actor pool —
         one batched device call per env step instead of per episode-step,
         so eval cost is amortized eval_episodes-fold (it is dispatch-latency
@@ -1099,7 +1125,8 @@ class Trainer:
         rets = np.zeros(n, np.float64)
         ep_success = np.zeros(n, bool)
         eval_act = self._get_eval_act()
-        eval_params = self._eval_params()
+        if eval_params is None:
+            eval_params = self._eval_params()
         for _ in range(cfg.max_episode_steps or 1000):
             a = np.asarray(eval_act(eval_params, np.asarray(obs)))
             obs2, r, term, trunc, pol_obs, s, s_rep = self._eval_pool.step(a)
@@ -1139,20 +1166,130 @@ class Trainer:
         thread only (no dispatch can be in flight on the donated state)."""
         return self._to_act_device(self.state.actor_params)
 
-    def _host_eval(self) -> dict:
-        """Greedy eval episodes through a host env (reference main.py:309-347)."""
+    # ------------------------------------------------------ concurrent eval
+    def _copy_eval_params(self):
+        """A REAL copy of the live actor params for the evaluator thread —
+        the live buffers get donated into the next dispatch, so the copy
+        must be materialized before the learner loop continues (same
+        discipline as _publish_params)."""
+        if self._act_backend == "cpu":
+            return self._to_act_device(jax.device_get(self.state.actor_params))
+        return jax.tree.map(jnp.copy, self.state.actor_params)
+
+    def _eval_worker(self):
+        try:
+            while True:
+                self._eval_pending.wait()
+                if self._eval_stop.is_set():
+                    return
+                with self._eval_req_lock:
+                    req, self._eval_req = self._eval_req, None
+                    self._eval_pending.clear()
+                if req is None:
+                    continue
+                params, step, scalars = req
+                ev = self._host_eval(eval_params=params)
+                self._apply_eval(step, scalars, ev)
+                with self._eval_req_lock:
+                    if self._eval_req is None:
+                        self._eval_idle.set()
+        except BaseException as e:
+            self._eval_error = e
+            self._eval_idle.set()  # never leave the end-of-train drain hanging
+            raise
+
+    def _apply_eval(self, step: int, scalars: dict, ev: dict) -> None:
+        """EWMA + log + print for one completed eval, at the step it was
+        REQUESTED (the params it scored). Runs on the evaluator thread in
+        concurrent mode (requests are processed one at a time in request
+        order, so the EWMA recursion sees evals in sequence; ewma_return is
+        a single float slot — the learner-thread reader tolerates being one
+        eval stale) and inline on the learner thread in sync/jax-env modes."""
+        cfg = self.config
+        if self.ewma_return is None:
+            self.ewma_return = ev["eval_return_mean"]
+        else:
+            self.ewma_return = (
+                (1 - cfg.ewma_alpha) * self.ewma_return
+                + cfg.ewma_alpha * ev["eval_return_mean"]
+            )
+        scalars = dict(scalars)
+        scalars.update(ev)
+        scalars["avg_test_reward_ewma"] = self.ewma_return
+        self.metrics.log(step, scalars)
+        print(
+            f"[step {step}] "
+            + " ".join(f"{k}={v:.3f}" for k, v in scalars.items() if k != "replay_size")
+        )
+        self._last_eval_ev = {**ev, "avg_test_reward_ewma": self.ewma_return}
+        self._last_eval_row = scalars
+
+    def _request_eval(self, scalars: dict) -> None:
+        """Hand the evaluator thread a param copy + this crossing's train
+        scalars. If an eval is still in flight, the newer request REPLACES
+        the waiting one (latest params win; the replaced crossing logs no
+        row — the reference's 10 s-cadence evaluator misses steps the same
+        way)."""
+        if self._eval_error is not None:
+            raise RuntimeError("evaluator thread died") from self._eval_error
+        if self._eval_thread is None or not self._eval_thread.is_alive():
+            self._eval_stop.clear()
+            self._eval_thread = threading.Thread(
+                target=self._eval_worker, name="evaluator", daemon=True
+            )
+            self._eval_thread.start()
+        params = self._copy_eval_params()
+        with self._eval_req_lock:
+            self._eval_idle.clear()
+            self._eval_req = (params, self.grad_steps, scalars)
+            self._eval_pending.set()
+
+    def _drain_eval(self, timeout: float = 600.0) -> None:
+        """Wait for in-flight + pending evals (end of train(): the final
+        crossing's row must exist before returning)."""
+        # Error check FIRST: a worker that died processing the final request
+        # leaves a dead thread, and the dead-thread early-return below would
+        # otherwise swallow the crash (no further _request_eval surfaces it).
+        if self._eval_error is not None:
+            raise RuntimeError("evaluator thread died") from self._eval_error
+        if self._eval_thread is None or not self._eval_thread.is_alive():
+            return
+        if not self._eval_idle.wait(timeout):
+            print(f"[evaluator] eval still running after {timeout:.0f} s")
+        if self._eval_error is not None:
+            raise RuntimeError("evaluator thread died") from self._eval_error
+
+    def _stop_eval_thread(self):
+        if self._eval_thread is not None:
+            self._eval_stop.set()
+            self._eval_pending.set()  # wake the wait()
+            self._eval_thread.join(timeout=60)
+            self._eval_thread = None
+
+    def _host_eval(self, eval_params=None) -> dict:
+        """Greedy eval episodes through a host env (reference main.py:309-347).
+
+        ``eval_params`` set → a published copy from the concurrent
+        evaluator; the single-env path then steps a DEDICATED eval env
+        (never ``self.env``, which the learner thread is collecting on)."""
         cfg = self.config
         if self.has_pool and cfg.eval_episodes > 1:
-            return self._pool_eval()
+            return self._pool_eval(eval_params)
+        if eval_params is None:
+            env = self.env
+            eval_params = self._eval_params()
+        else:
+            if self._eval_env is None:
+                self._eval_env = make_env(cfg.env, cfg.max_episode_steps)
+            env = self._eval_env
         rets, succ = [], 0
         eval_act = self._get_eval_act()
-        eval_params = self._eval_params()
         for _ in range(cfg.eval_episodes):
-            obs = self.env.reset()
+            obs = env.reset()
             ep_ret, term, trunc = 0.0, False, False
             for _ in range(cfg.max_episode_steps or 1000):
                 a = np.asarray(eval_act(eval_params, np.asarray(obs)[None])[0])
-                obs, r, term, trunc, info = self.env.step(a)
+                obs, r, term, trunc, info = env.step(a)
                 ep_ret += r
                 if term or trunc:
                     break
@@ -1167,23 +1304,6 @@ class Trainer:
     def _periodic(self, metrics, t_start, grad_steps_done, env_steps_start) -> dict:
         cfg = self.config
         scalars = {k: float(v) for k, v in jax.device_get(metrics).items()}
-        if self.is_jax_env:
-            self.key, ek = jax.random.split(self.key)
-            ev = evaluate(
-                cfg.agent, self.env, self.state.actor_params, ek, cfg.eval_episodes
-            )
-        else:
-            ev = self._host_eval()
-        # EWMA smoothing (reference main.py:131)
-        if self.ewma_return is None:
-            self.ewma_return = ev["eval_return_mean"]
-        else:
-            self.ewma_return = (
-                (1 - cfg.ewma_alpha) * self.ewma_return
-                + cfg.ewma_alpha * ev["eval_return_mean"]
-            )
-        scalars.update(ev)
-        scalars["avg_test_reward_ewma"] = self.ewma_return
         scalars["noise_scale"] = self._noise_scale()
         dt = time.monotonic() - t_start
         scalars.update(
@@ -1197,18 +1317,29 @@ class Trainer:
                 "env_steps": self.env_steps,
             }
         )
-        # Log against the GLOBAL step (survives --resume legs): per-leg
+        if not self.is_jax_env and cfg.concurrent_eval:
+            # Evaluator-thread path: hand off a param copy; logging/print
+            # happen in _apply_eval when the eval completes. Return the
+            # latest finished eval's scalars so callers always see the keys.
+            self._request_eval(scalars)
+            return {**scalars, **self._last_eval_ev}
+        if self.is_jax_env:
+            self.key, ek = jax.random.split(self.key)
+            ev = evaluate(
+                cfg.agent, self.env, self.state.actor_params, ek, cfg.eval_episodes
+            )
+        else:
+            ev = self._host_eval()
+        # Same EWMA/log/print path as the concurrent evaluator, inline.
+        # Logs against the GLOBAL step (survives --resume legs): per-leg
         # steps made multi-leg metrics.jsonl non-monotone, which zigzags
         # any step-keyed plot.
-        self.metrics.log(self.grad_steps, scalars)
-        print(
-            f"[step {self.grad_steps}] "
-            + " ".join(f"{k}={v:.3f}" for k, v in scalars.items() if k != "replay_size")
-        )
-        return scalars
+        self._apply_eval(self.grad_steps, scalars, ev)
+        return self._last_eval_row
 
     def close(self):
         self._stop_collector()
+        self._stop_eval_thread()
         self._stop_writeback()
         self.metrics.close()
         self.ckpt.close()
@@ -1216,5 +1347,7 @@ class Trainer:
             self.pool.close()
         if self._eval_pool is not None:
             self._eval_pool.close()
+        if self._eval_env is not None and hasattr(self._eval_env, "close"):
+            self._eval_env.close()
         if hasattr(self.env, "close"):
             self.env.close()

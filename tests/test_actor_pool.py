@@ -25,6 +25,7 @@ def _random_actions(rng, n, dim=1):
 
 
 class TestHostActorPool:
+    @pytest.mark.slow
     def test_step_shapes_and_autoreset(self):
         pool = HostActorPool(ENV, 3, max_episode_steps=10, seed=0)
         try:
@@ -44,6 +45,7 @@ class TestHostActorPool:
         finally:
             pool.close()
 
+    @pytest.mark.slow
     def test_seeding_disjoint_and_reproducible(self):
         a = HostActorPool(ENV, 2, max_episode_steps=10, seed=7)
         b = HostActorPool(ENV, 2, max_episode_steps=10, seed=7)
@@ -99,6 +101,7 @@ def _cfg(**kw):
 
 
 class TestTrainerPool:
+    @pytest.mark.slow
     def test_pool_mode_trains(self, tmp_path):
         from d4pg_tpu.runtime.trainer import Trainer
 
@@ -112,6 +115,7 @@ class TestTrainerPool:
         finally:
             t.close()
 
+    @pytest.mark.slow
     def test_pool_mode_cpu_actor_device(self, tmp_path):
         """--actor-device cpu: collection/eval forwards jit on the CPU
         backend against numpy params (the remote-TPU layout, where every
@@ -136,6 +140,7 @@ class TestTrainerPool:
         finally:
             t.close()
 
+    @pytest.mark.slow
     def test_async_cpu_actor_publishes_numpy(self, tmp_path):
         from d4pg_tpu.runtime.trainer import Trainer
 
@@ -161,6 +166,7 @@ class TestTrainerPool:
         finally:
             t.close()
 
+    @pytest.mark.slow
     def test_async_priority_writeback(self, tmp_path):
         """Background PER flusher: training proceeds without the learner
         blocking on priority fetches; the thread drains and joins cleanly,
@@ -187,6 +193,7 @@ class TestTrainerPool:
         finally:
             t.close()
 
+    @pytest.mark.slow
     def test_async_mode_trains_and_joins(self, tmp_path):
         from d4pg_tpu.runtime.trainer import Trainer
 
@@ -208,6 +215,7 @@ class TestTrainerPool:
         finally:
             t.close()
 
+    @pytest.mark.slow
     def test_async_single_env_gets_pool(self, tmp_path):
         """--async-collect with num_envs=1 must still route through the pool
         (a dedicated worker process), not the in-thread single-env path."""
@@ -228,6 +236,7 @@ class TestTrainerPool:
         finally:
             t.close()
 
+    @pytest.mark.slow
     def test_async_train_twice(self, tmp_path):
         """Chunked training: a second train() must restart the collector
         (the stop event is cleared, not latched)."""
@@ -291,6 +300,7 @@ def test_gym_adapter_imports_without_jax():
     assert envs == "[]", f"gym_adapter import loaded JAX env modules: {envs}"
 
 
+@pytest.mark.slow
 def test_pool_eval_parallel(tmp_path):
     """Host eval routes through a parallel eval pool when eval_episodes > 1:
     one batched act per env step across all episodes."""
@@ -312,6 +322,7 @@ GOAL_ENV = "toy_goal_env:ToyGoal-v0"
 
 
 class TestHERPool:
+    @pytest.mark.slow
     def test_step_goal_views(self):
         """step_goal returns consistent pre/post goal views: prev.next == next
         under the flat obs the policy sees."""
@@ -333,6 +344,7 @@ class TestHERPool:
         finally:
             pool.close()
 
+    @pytest.mark.slow
     def test_her_pool_trains_and_relabels(self, tmp_path):
         """HER through the pool: original + relabeled transitions land in
         replay, training runs, and the env actually solves-ish under noise
@@ -367,6 +379,7 @@ class TestHERPool:
         finally:
             t.close()
 
+    @pytest.mark.slow
     def test_her_pool_async(self, tmp_path):
         from d4pg_tpu.runtime.trainer import Trainer
 
@@ -397,6 +410,7 @@ class TestHERPool:
         finally:
             t.close()
 
+    @pytest.mark.slow
     def test_her_pool_warmup_fills_buffer(self, tmp_path):
         """Warmup must not exit before the buffer can serve a batch: HER
         only flushes at episode ends, so step-counted warmup alone could
@@ -429,6 +443,7 @@ class TestHERPool:
             t.close()
 
 
+@pytest.mark.slow
 def test_async_resume_still_collects(tmp_path):
     """Regression: async pacing must compare per-process FRESH env steps
     against the learner's ratio, not the checkpoint-restored global counter

@@ -25,6 +25,7 @@ def test_nstep_truncation_stops_window_keeps_bootstrap():
     np.testing.assert_allclose(np.asarray(r[1]), 1 + 0.5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_on_device_iteration_shapes_and_replay_fill():
     config = D4PGConfig(
         obs_dim=3, action_dim=1, hidden_sizes=(32, 32),
@@ -83,6 +84,7 @@ def test_on_device_learns_pendulum_signal():
     assert losses[-1] < losses[2]
 
 
+@pytest.mark.slow
 def test_on_device_prioritized_sampling_and_updates():
     """Device PER: cumsum+searchsorted sampling is proportional, priorities
     update after the train scan, new rows seed at max_priority^alpha."""
@@ -131,6 +133,7 @@ def test_device_per_proportional_statistics():
     assert 0.88 < frac < 0.92
 
 
+@pytest.mark.slow
 def test_run_on_device_cli_driver(tmp_path):
     """train.py --on-device end-to-end: the run_on_device periphery (eval,
     EWMA, metrics files, checkpoints, resume) around the fused loop."""
@@ -172,6 +175,7 @@ def test_run_on_device_cli_driver(tmp_path):
     assert lines[-1]["step"] == 16
 
 
+@pytest.mark.slow
 def test_on_device_dp_over_mesh():
     """Distributed fully-on-device loop (config 5 at pod scale): envs,
     replay shards and batch split over the 8-device mesh, grads pmean'd,
@@ -215,6 +219,7 @@ def test_on_device_dp_over_mesh():
     assert int(jax.device_get(new_state.step)) == (1 + 8) * 4 - 4  # warmup trains 0
 
 
+@pytest.mark.slow
 def test_run_on_device_cli_driver_dp(tmp_path):
     """--on-device --dp 8: the CLI driver runs the distributed loop."""
     from train import build_parser, config_from_args
@@ -259,6 +264,7 @@ def test_on_device_uint8_obs_ring():
     np.testing.assert_allclose(np.asarray(decoded), np.asarray(obs), atol=1 / 255)
 
 
+@pytest.mark.slow
 def test_on_device_pixel_trainer_uint8(tmp_path, monkeypatch):
     """run_on_device on the pixel env: the uint8 ring path is actually
     engaged (factory receives obs_uint8=True, scale 255) and a training
@@ -294,6 +300,7 @@ def test_on_device_pixel_trainer_uint8(tmp_path, monkeypatch):
     assert captured["obs_uint8"] is True and captured["obs_scale"] == 255.0
 
 
+@pytest.mark.slow
 def test_on_device_rss_watchdog(tmp_path):
     """--max-rss-gb works in --on-device mode too: a tiny limit preempts at
     the first eval crossing with a checkpoint and the _preempted marker."""

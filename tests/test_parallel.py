@@ -33,6 +33,7 @@ def test_eight_virtual_devices_present():
     assert jax.device_count() == 8
 
 
+@pytest.mark.slow
 def test_dp_train_step_matches_single_device():
     """Sharded-DP and single-device training must agree numerically: the psum
     of shard-mean gradients equals the full-batch mean gradient."""
@@ -72,6 +73,7 @@ def test_dp_batch_not_divisible_raises():
         step(state, _batch(np.random.default_rng(0), B=12))  # 12 % 8 != 0
 
 
+@pytest.mark.slow
 def test_auto_parallel_dp_tp_mesh():
     """GSPMD path on a 4x2 dp×tp mesh: state shards over tp, batch over dp,
     and the step still computes the same loss as single-device."""
@@ -124,6 +126,7 @@ def test_mesh_validation():
     assert mesh.shape["dp"] == 4 and mesh.shape["tp"] == 2
 
 
+@pytest.mark.slow
 def test_dp_fused_scan_matches_sequential_steps():
     """K fused grad steps under DP must equal K sequential DP steps: same
     final params, same per-step priorities."""

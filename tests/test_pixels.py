@@ -60,6 +60,7 @@ def test_pixel_rollout_scans_on_device():
     assert traj.next_obs.shape == (8, 16 * 16 * 2)
 
 
+@pytest.mark.slow
 def test_pixel_train_step_runs_and_learns():
     H, W, C = 16, 16, 2
     config = D4PGConfig(
@@ -102,6 +103,7 @@ def test_pixel_train_step_runs_and_learns():
     assert max(deltas) > 0
 
 
+@pytest.mark.slow
 def test_pixel_trainer_smoke(tmp_path):
     """Trainer end-to-end on the pixel env: warmup, a few fused grad steps
     over conv-encoded flattened-pixel batches, eval — no host renderer."""

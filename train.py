@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import os
 import sys
 
 from d4pg_tpu.agent.state import D4PGConfig
@@ -102,6 +103,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "once per dispatch)")
     p.add_argument("--eval-interval", type=int, default=2_000)
     p.add_argument("--eval-episodes", type=int, default=10)
+    p.add_argument("--concurrent-eval", dest="concurrent_eval",
+                   action=argparse.BooleanOptionalAction, default=True,
+                   help="host-env eval runs in a dedicated thread on a "
+                        "published param copy (reference evaluator process) "
+                        "so eval crossings cost zero grad steps")
     p.add_argument("--checkpoint-interval", type=int, default=10_000)
     p.add_argument("--resume", action="store_true")
     p.add_argument("--snapshot-replay", action="store_true",
@@ -118,6 +124,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "checkpoints and exits cleanly so a supervisor can "
                         "--resume (0 = off); guards against host OOM kills "
                         "and leaky device-client libraries")
+    # multi-host bring-up (jax.distributed): every host runs the same
+    # command; after initialize, jax.devices() spans the whole cluster and
+    # make_mesh builds one global mesh (docs/REMOTE_TPU.md has the recipe).
+    # Env-var fallbacks let pod launchers template one command line.
+    p.add_argument("--distributed", action="store_true",
+                   help="initialize jax.distributed with Cloud-TPU-pod "
+                        "autodetection (metadata server supplies "
+                        "coordinator/process ids)")
+    p.add_argument("--coordinator",
+                   default=os.environ.get("D4PG_COORDINATOR"),
+                   help="coordinator address host:port for explicit "
+                        "clusters (env D4PG_COORDINATOR)")
+    p.add_argument("--num-processes", type=int,
+                   default=int(os.environ.get("D4PG_NUM_PROCESSES", "0")) or None,
+                   help="total process count (env D4PG_NUM_PROCESSES)")
+    p.add_argument("--process-id", type=int,
+                   default=int(os.environ.get("D4PG_PROCESS_ID", "-1"))
+                   if os.environ.get("D4PG_PROCESS_ID") is not None else None,
+                   help="this process's rank (env D4PG_PROCESS_ID)")
     return p
 
 
@@ -173,6 +198,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         tree_backend=args.tree_backend,
         eval_interval=args.eval_interval,
         eval_episodes=args.eval_episodes,
+        concurrent_eval=args.concurrent_eval,
         log_dir=log_dir,
         checkpoint_interval=args.checkpoint_interval,
         resume=args.resume,
@@ -203,10 +229,29 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
 
 
 def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    if args.distributed or args.coordinator or (args.num_processes or 0) > 1:
+        # Before config_from_args/Trainer import anything that touches
+        # devices: the backend binds to the local slice at first use.
+        from d4pg_tpu.parallel import initialize_distributed
+
+        info = initialize_distributed(
+            args.coordinator, args.num_processes, args.process_id,
+            autodetect=args.distributed,
+        )
+        print(f"[distributed] {info}")
+    else:
+        info = None
     from d4pg_tpu.runtime import Trainer
 
-    args = build_parser().parse_args(argv)
     cfg = config_from_args(args)
+    if info is not None and info["process_index"] != 0:
+        # Every process runs the same command line; secondary hosts write
+        # metrics/checkpoints to their own subdir so a shared filesystem
+        # sees no clobbering (process 0 owns the canonical run dir).
+        cfg = dataclasses.replace(
+            cfg, log_dir=os.path.join(cfg.log_dir, f"worker{info['process_index']}")
+        )
     print(f"config: {cfg}")
     if args.on_device:
         from d4pg_tpu.runtime.on_device import run_on_device
