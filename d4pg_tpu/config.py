@@ -140,6 +140,11 @@ ENV_PRESETS = {
         v_min=-300.0, v_max=0.0, obs_dim=48 * 48 * 2, action_dim=1,
         max_episode_steps=200, pixel_shape=(48, 48, 2), replay_capacity=100_000,
     ),
+    # Pure-JAX on-device locomotion (envs/locomotion.py) — the flagship
+    # tasks with rollout + replay + learn in one XLA program (--on-device).
+    "halfcheetah": dict(v_min=0.0, v_max=1000.0, obs_dim=17, action_dim=6, max_episode_steps=1000),
+    "hopper": dict(v_min=0.0, v_max=500.0, obs_dim=11, action_dim=3, max_episode_steps=1000),
+    "walker2d": dict(v_min=0.0, v_max=500.0, obs_dim=17, action_dim=6, max_episode_steps=1000),
     "Pendulum-v1": dict(v_min=-300.0, v_max=0.0, obs_dim=3, action_dim=1, max_episode_steps=200),
     "HalfCheetah-v4": dict(v_min=0.0, v_max=1000.0, obs_dim=17, action_dim=6, max_episode_steps=1000),
     "HalfCheetah-v5": dict(v_min=0.0, v_max=1000.0, obs_dim=17, action_dim=6, max_episode_steps=1000),

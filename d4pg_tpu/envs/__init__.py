@@ -17,6 +17,9 @@ JAX env modules (and with them the JAX runtime).
 _EXPORTS = {
     "Env": "d4pg_tpu.envs.api",
     "EnvState": "d4pg_tpu.envs.api",
+    "HalfCheetah": "d4pg_tpu.envs.locomotion",
+    "Hopper": "d4pg_tpu.envs.locomotion",
+    "Walker2d": "d4pg_tpu.envs.locomotion",
     "Pendulum": "d4pg_tpu.envs.pendulum",
     "PixelPendulum": "d4pg_tpu.envs.pixel_pendulum",
     "PointMassGoal": "d4pg_tpu.envs.pointmass_goal",

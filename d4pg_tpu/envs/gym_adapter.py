@@ -135,4 +135,13 @@ def make_env(name: str, max_episode_steps: Optional[int] = None):
         return PixelPendulum()
     if name == "pointmass_goal":
         return PointMassGoal()
+    if name in ("halfcheetah", "hopper", "walker2d"):
+        from d4pg_tpu.envs import locomotion
+
+        cls = {
+            "halfcheetah": locomotion.HalfCheetah,
+            "hopper": locomotion.Hopper,
+            "walker2d": locomotion.Walker2d,
+        }[name]
+        return cls(max_episode_steps=max_episode_steps)
     return GymAdapter(name, max_episode_steps)

@@ -1,0 +1,375 @@
+"""Pure-JAX planar articulated-body physics — on-device MuJoCo-class envs.
+
+Why this exists: BASELINE.json config 5 ("on-device envs: rollout + learn
+both on TPU, end-to-end jit") needs the FLAGSHIP tasks (HalfCheetah,
+Hopper, Walker2d — the envs the reference trains via gym host processes,
+``main.py:68``) as pure-JAX envs behind :mod:`d4pg_tpu.envs.api`. Neither
+Brax nor MJX is available in this image, so this module implements the
+physics itself — TPU-first rather than a port:
+
+- **Dynamics from the Lagrangian via autodiff.** Hand-derived recursive
+  dynamics (CRBA/RNEA) are pointer-chasing and error-prone; here only the
+  forward kinematics is written by hand. Kinetic energy
+  ``T(q, q̇) = ½ Σ_b m_b|ċom_b|² + I_b θ̇_b²`` is a composition of jnp ops,
+  so the mass matrix is EXACTLY ``M(q) = ∂²T/∂q̇²`` (one ``jax.hessian``,
+  exact because T is quadratic in q̇) and the bias force falls out of the
+  Euler–Lagrange equation with two more autodiff calls. XLA fuses the
+  whole thing; a 9-DoF tree is microseconds of MXU-free elementwise work,
+  and the entire env step lives inside the training program's jit scope —
+  no host physics, no per-step dispatch.
+- **Structure extracted from the installed MuJoCo model, not copied.**
+  :func:`extract_planar_model` reads masses, inertias, joint tree, geoms,
+  gears, damping/stiffness/armature from the same MJCF gymnasium uses
+  (public model data), so the rigid-body dynamics quantitatively match
+  ``mj_fullM``/``mj_rne`` (tested to ~1e-5 in tests/test_planar.py).
+- **Contacts by smooth penalty, not an LCP solver.** Capsule endpoints act
+  as contact spheres against the ground plane: one-sided spring-damper
+  normal force + tanh-regularized Coulomb friction, applied through
+  ``J_cᵀf`` where J_c comes from ``jax.vjp`` of the contact-point FK.
+  This is the standard differentiable-physics approximation (Brax's
+  spring/positional backends make the same trade): control-flow-free,
+  branch-free, vmappable — the properties XLA needs. It is the one
+  deliberate deviation from MuJoCo's soft-LCP contact model.
+
+Integration is semi-implicit Euler with substeps under ``lax.scan``
+(static shapes, no data-dependent control flow anywhere).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class PlanarModel(NamedTuple):
+    """Static description of a planar kinematic tree (x-z plane, rotations
+    about +y). Structure fields are host-side numpy (consumed at trace
+    time); numeric fields become jnp constants inside jit."""
+
+    # tree structure (movable bodies only; index 0 = first child of world)
+    parent: np.ndarray        # [NB] int, -1 = world
+    body_pos: np.ndarray      # [NB, 2] frame offset in parent frame (x, z)
+    # joints, in MuJoCo joint order (= qpos order)
+    jnt_body: np.ndarray      # [NJ] int body index
+    jnt_type: np.ndarray      # [NJ] 0 = slide, 1 = hinge
+    jnt_axis: np.ndarray      # [NJ, 2] slide axis in joint frame (slides)
+    jnt_sign: np.ndarray      # [NJ] hinge sign (axis·ŷ)
+    jnt_pos: np.ndarray       # [NJ, 2] hinge anchor in body frame
+    qpos0: np.ndarray         # [NJ] joint reference (MJCF ref): displacement
+                              # is q − qpos0, and q = qpos0 is the XML pose
+    # per-body mass properties
+    mass: np.ndarray          # [NB]
+    ipos: np.ndarray          # [NB, 2] COM in body frame
+    inertia_y: np.ndarray     # [NB] ŷᵀ I ŷ (planar rotational inertia)
+    # per-dof passive/actuation parameters
+    armature: np.ndarray      # [NJ]
+    damping: np.ndarray       # [NJ]
+    stiffness: np.ndarray     # [NJ] spring toward spring_ref
+    spring_ref: np.ndarray    # [NJ]
+    limited: np.ndarray       # [NJ] bool
+    range_lo: np.ndarray      # [NJ]
+    range_hi: np.ndarray      # [NJ]
+    gear: np.ndarray          # [NU] actuator gear
+    act_dof: np.ndarray       # [NU] int dof driven by each actuator
+    # contact spheres (capsule endpoints)
+    con_body: np.ndarray      # [NC] int body index
+    con_pos: np.ndarray       # [NC, 2] point in body frame
+    con_radius: np.ndarray    # [NC]
+    friction: np.ndarray      # [NC] sliding friction coefficient
+    # world / integration
+    gravity: float
+    timestep: float           # physics dt (MuJoCo opt.timestep)
+    # Contact penalty parameters (the differentiable-contact trade).
+    # CALIBRATED, not guessed: a D4PG policy trained to 14k on real MuJoCo
+    # HalfCheetah was evaluated zero-shot in this engine across a
+    # (stiffness, damping) grid; soft contacts (12k/160, the solref-derived
+    # first guess) absorbed push-off energy and capped it at 3.7k/4.2 m/s,
+    # while 60k/350 lets the same policy run 10k/10.5 m/s upright — so the
+    # defaults are the values under which reference-physics gaits transfer
+    # best (still stable: ω·dt = 0.61 at the 2.5 ms substep).
+    contact_stiffness: float
+    contact_damping: float
+    slip_vel: float           # tanh friction regularization scale [m/s]
+    limit_stiffness: float    # one-sided joint-limit spring
+    limit_damping: float
+
+
+def _quat_y_angle(q: np.ndarray) -> float:
+    """Rotation angle about +y of a (w,x,y,z) quaternion that is a pure
+    y-rotation (all planar-model geom/body quats are)."""
+    return 2.0 * np.arctan2(q[2], q[0])
+
+
+def extract_planar_model(
+    xml_path: str,
+    contact_stiffness: float = 60_000.0,
+    contact_damping: float = 350.0,
+    slip_vel: float = 0.05,
+    limit_stiffness: float = 400.0,
+    limit_damping: float = 4.0,
+) -> PlanarModel:
+    """Build a :class:`PlanarModel` from a planar MJCF via the host MuJoCo
+    compiler (model DATA only — the dynamics implementation is ours).
+
+    Requires every hinge axis ∥ ±y, every slide axis in the x-z plane, and
+    capsule/sphere collision geoms (true for gym's halfcheetah, hopper,
+    walker2d)."""
+    import mujoco
+
+    m = mujoco.MjModel.from_xml_path(xml_path)
+    nb = m.nbody - 1  # drop world
+
+    def b2i(mj_body: int) -> int:
+        return mj_body - 1
+
+    parent = np.array([b2i(m.body_parentid[b + 1]) for b in range(nb)])
+    body_pos = np.array([[m.body_pos[b + 1][0], m.body_pos[b + 1][2]] for b in range(nb)])
+    mass = np.array([m.body_mass[b + 1] for b in range(nb)])
+    ipos = np.array([[m.body_ipos[b + 1][0], m.body_ipos[b + 1][2]] for b in range(nb)])
+    inertia_y = np.empty(nb)
+    for b in range(nb):
+        quat = m.body_iquat[b + 1]
+        R = np.zeros((3, 3))
+        mujoco.mju_quat2Mat(R.reshape(-1), quat)
+        I_world = R @ np.diag(m.body_inertia[b + 1]) @ R.T
+        inertia_y[b] = I_world[1, 1]
+
+    nj = m.njnt
+    jnt_body = np.array([b2i(m.jnt_bodyid[j]) for j in range(nj)])
+    jnt_type = np.empty(nj, np.int64)
+    jnt_axis = np.zeros((nj, 2))
+    jnt_sign = np.ones(nj)
+    jnt_pos = np.array([[m.jnt_pos[j][0], m.jnt_pos[j][2]] for j in range(nj)])
+    for j in range(nj):
+        ax = m.jnt_axis[j]
+        if m.jnt_type[j] == mujoco.mjtJoint.mjJNT_SLIDE:
+            if abs(ax[1]) > 1e-9:
+                raise ValueError(f"slide joint {j} axis {ax} leaves the x-z plane")
+            jnt_type[j] = 0
+            jnt_axis[j] = [ax[0], ax[2]]
+        elif m.jnt_type[j] == mujoco.mjtJoint.mjJNT_HINGE:
+            if abs(ax[0]) > 1e-9 or abs(ax[2]) > 1e-9:
+                raise ValueError(f"hinge joint {j} axis {ax} is not ±y")
+            jnt_type[j] = 1
+            jnt_sign[j] = np.sign(ax[1])
+        else:
+            raise ValueError(f"joint {j}: only slide/hinge supported")
+
+    con_body, con_pos, con_radius, friction = [], [], [], []
+    for g in range(m.ngeom):
+        b = m.geom_bodyid[g]
+        if b == 0:  # world geoms = the floor plane itself
+            continue
+        gtype = m.geom_type[g]
+        gpos = np.array([m.geom_pos[g][0], m.geom_pos[g][2]])
+        if gtype == mujoco.mjtGeom.mjGEOM_CAPSULE:
+            alpha = _quat_y_angle(m.geom_quat[g])
+            # capsule local axis is z; under R_y(α): ẑ → (sin α, cos α)
+            axis2 = np.array([np.sin(alpha), np.cos(alpha)])
+            half = m.geom_size[g][1]
+            ends = [gpos - half * axis2, gpos + half * axis2]
+        elif gtype == mujoco.mjtGeom.mjGEOM_SPHERE:
+            ends = [gpos]
+        else:
+            raise ValueError(f"geom {g}: only capsule/sphere collide in planar")
+        for e in ends:
+            con_body.append(b2i(b))
+            con_pos.append(e)
+            con_radius.append(m.geom_size[g][0])
+            friction.append(m.geom_friction[g][0])
+
+    nu = m.nu
+    gear = np.array([m.actuator_gear[u][0] for u in range(nu)])
+    act_dof = np.array([m.actuator_trnid[u][0] for u in range(nu)])
+
+    return PlanarModel(
+        parent=parent,
+        body_pos=body_pos,
+        jnt_body=jnt_body,
+        jnt_type=jnt_type,
+        jnt_axis=jnt_axis,
+        jnt_sign=jnt_sign,
+        jnt_pos=jnt_pos,
+        qpos0=np.array(m.qpos0),
+        mass=mass,
+        ipos=ipos,
+        inertia_y=inertia_y,
+        armature=np.array(m.dof_armature),
+        damping=np.array(m.dof_damping),
+        stiffness=np.array([m.jnt_stiffness[j] for j in range(nj)]),
+        spring_ref=np.array([m.qpos_spring[j] for j in range(nj)]),
+        limited=np.array([bool(m.jnt_limited[j]) for j in range(nj)]),
+        range_lo=np.array([m.jnt_range[j][0] for j in range(nj)]),
+        range_hi=np.array([m.jnt_range[j][1] for j in range(nj)]),
+        gear=gear,
+        act_dof=act_dof,
+        con_body=np.array(con_body),
+        con_pos=np.array(con_pos),
+        con_radius=np.array(con_radius),
+        friction=np.array(friction),
+        gravity=float(-m.opt.gravity[2]),
+        timestep=float(m.opt.timestep),
+        contact_stiffness=contact_stiffness,
+        contact_damping=contact_damping,
+        slip_vel=slip_vel,
+        limit_stiffness=limit_stiffness,
+        limit_damping=limit_damping,
+    )
+
+
+def _rot(theta):
+    """R_y(θ) restricted to the x-z plane: (x,z) → (c·x + s·z, −s·x + c·z)."""
+    c, s = jnp.cos(theta), jnp.sin(theta)
+    return jnp.array([[c, s], [-s, c]])
+
+
+def fk(model: PlanarModel, q: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Forward kinematics: world (origin [NB,2], angle [NB]) per body.
+
+    Unrolled over the (static, tiny) tree at trace time; joints compose in
+    MuJoCo order within each body (slides translate along the axis in the
+    pre-joint frame, hinges rotate about their anchor)."""
+    nb = len(model.parent)
+    joints_of = [[] for _ in range(nb)]
+    for j in range(len(model.jnt_body)):
+        joints_of[int(model.jnt_body[j])].append(j)
+    origins: list = [None] * nb
+    thetas: list = [None] * nb
+    for b in range(nb):
+        p = int(model.parent[b])
+        if p < 0:
+            o, th = jnp.zeros(2), jnp.asarray(0.0)
+        else:
+            o, th = origins[p], thetas[p]
+        o = o + _rot(th) @ jnp.asarray(model.body_pos[b])
+        for j in joints_of[b]:
+            dq = q[j] - model.qpos0[j]  # MJCF ref: XML pose at q = qpos0
+            if int(model.jnt_type[j]) == 0:  # slide
+                o = o + _rot(th) @ jnp.asarray(model.jnt_axis[j]) * dq
+            else:  # hinge about anchor jnt_pos
+                anchor = o + _rot(th) @ jnp.asarray(model.jnt_pos[j])
+                th = th + model.jnt_sign[j] * dq
+                o = anchor - _rot(th) @ jnp.asarray(model.jnt_pos[j])
+        origins[b] = o
+        thetas[b] = th
+    return jnp.stack(origins), jnp.stack(thetas)
+
+
+def body_coms(model: PlanarModel, q: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """World COM positions [NB,2] and body angles [NB]."""
+    origins, thetas = fk(model, q)
+    coms = origins + jax.vmap(lambda th, r: _rot(th) @ r)(
+        thetas, jnp.asarray(model.ipos)
+    )
+    return coms, thetas
+
+
+def kinetic_energy(model: PlanarModel, q: jax.Array, qd: jax.Array) -> jax.Array:
+    """T(q, q̇) incl. rotor armature — quadratic in q̇ by construction."""
+    coms_fn = lambda qq: body_coms(model, qq)
+    (coms, thetas), (dcoms, dthetas) = jax.jvp(coms_fn, (q,), (qd,))
+    T = 0.5 * jnp.sum(jnp.asarray(model.mass) * jnp.sum(dcoms**2, axis=-1))
+    T = T + 0.5 * jnp.sum(jnp.asarray(model.inertia_y) * dthetas**2)
+    T = T + 0.5 * jnp.sum(jnp.asarray(model.armature) * qd**2)
+    return T
+
+
+def potential_energy(model: PlanarModel, q: jax.Array) -> jax.Array:
+    coms, _ = body_coms(model, q)
+    return model.gravity * jnp.sum(jnp.asarray(model.mass) * coms[:, 1])
+
+
+def mass_matrix(model: PlanarModel, q: jax.Array) -> jax.Array:
+    """M(q) = ∂²T/∂q̇² — exact (T is quadratic in q̇), matches mj_fullM."""
+    nv = q.shape[0]
+    return jax.hessian(lambda v: kinetic_energy(model, q, v))(jnp.zeros(nv))
+
+
+def bias_force(model: PlanarModel, q: jax.Array, qd: jax.Array) -> jax.Array:
+    """c(q, q̇) with M(q)q̈ + c(q, q̇) = τ_applied (Euler–Lagrange):
+
+        c = (∂p/∂q)q̇ − ∂T/∂q + ∂V/∂q,   p = ∂T/∂q̇ = M q̇
+
+    Matches mj_rne(flg_acc=0) (Coriolis + centrifugal + gravity)."""
+    p_fn = lambda qq: jax.grad(kinetic_energy, argnums=2)(model, qq, qd)
+    dp_dq = jax.jacfwd(p_fn)(q)
+    dT_dq = jax.grad(kinetic_energy, argnums=1)(model, q, qd)
+    dV_dq = jax.grad(potential_energy, argnums=1)(model, q)
+    return dp_dq @ qd - dT_dq + dV_dq
+
+
+def contact_points(model: PlanarModel, q: jax.Array) -> jax.Array:
+    """World positions [NC, 2] of all contact spheres."""
+    origins, thetas = fk(model, q)
+    o = origins[jnp.asarray(model.con_body)]
+    th = thetas[jnp.asarray(model.con_body)]
+    return o + jax.vmap(lambda t, r: _rot(t) @ r)(th, jnp.asarray(model.con_pos))
+
+
+def _applied_force(
+    model: PlanarModel, q: jax.Array, qd: jax.Array, tau: jax.Array
+) -> jax.Array:
+    """All generalized forces except bias: actuation, passive spring/damper,
+    joint-limit penalty, ground contact."""
+    # actuation (gear·ctrl onto the actuated dofs)
+    f = jnp.zeros_like(q).at[jnp.asarray(model.act_dof)].add(
+        jnp.asarray(model.gear) * tau
+    )
+    # passive joint spring + damper (MuJoCo qfrc_passive)
+    f = f - jnp.asarray(model.stiffness) * (q - jnp.asarray(model.spring_ref))
+    f = f - jnp.asarray(model.damping) * qd
+
+    # joint limits: stiff one-sided spring, damped only when moving outward
+    lo, hi = jnp.asarray(model.range_lo), jnp.asarray(model.range_hi)
+    lim = jnp.asarray(model.limited, jnp.float32)
+    over = jnp.maximum(q - hi, 0.0)
+    under = jnp.maximum(lo - q, 0.0)
+    f = f - lim * model.limit_stiffness * (over - under)
+    f = f - lim * model.limit_damping * qd * ((over > 0) | (under > 0))
+
+    # ground contact: penalty normal + regularized Coulomb friction at every
+    # contact sphere, mapped to generalized coords through J_cᵀ via vjp
+    points, vjp_fn = jax.vjp(lambda qq: contact_points(model, qq), q)
+    vels = jax.jvp(lambda qq: contact_points(model, qq), (q,), (qd,))[1]
+    phi = points[:, 1] - jnp.asarray(model.con_radius)  # signed gap to z=0
+    pen = jnp.maximum(-phi, 0.0)
+    active = pen > 0.0
+    fn = model.contact_stiffness * pen - model.contact_damping * vels[:, 1] * active
+    fn = jnp.maximum(fn, 0.0)
+    ft = -jnp.asarray(model.friction) * fn * jnp.tanh(vels[:, 0] / model.slip_vel)
+    f_points = jnp.stack([ft, fn], axis=-1)
+    f = f + vjp_fn(f_points)[0]
+    return f
+
+
+def forward_dynamics(
+    model: PlanarModel, q: jax.Array, qd: jax.Array, tau: jax.Array
+) -> jax.Array:
+    """q̈ = M(q)⁻¹ (f_applied − c(q, q̇)). 9×9 solve — trivial on any backend."""
+    M = mass_matrix(model, q)
+    rhs = _applied_force(model, q, qd, tau) - bias_force(model, q, qd)
+    return jnp.linalg.solve(M, rhs)
+
+
+def step_physics(
+    model: PlanarModel,
+    q: jax.Array,
+    qd: jax.Array,
+    tau: jax.Array,
+    n_substeps: int,
+    substep_dt: float,
+) -> Tuple[jax.Array, jax.Array]:
+    """Semi-implicit Euler over a lax.scan of substeps (torque held)."""
+
+    def sub(carry, _):
+        q, qd = carry
+        qdd = forward_dynamics(model, q, qd, tau)
+        qd = qd + substep_dt * qdd
+        q = q + substep_dt * qd
+        return (q, qd), None
+
+    (q, qd), _ = jax.lax.scan(sub, (q, qd), None, length=n_substeps)
+    return q, qd
