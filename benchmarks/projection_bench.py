@@ -1,0 +1,121 @@
+"""Benchmark: Pallas vs XLA categorical projection, f32 vs bf16 compute.
+
+VERDICT round-1 weak #4/#7: the Pallas kernel was equivalence-tested but
+never benchmarked on the real chip, and --compute-dtype bfloat16 existed
+unmeasured. This script measures BOTH inside the fused train scan (the
+context that matters — a kernel that wins in isolation but loses fused is
+worthless) and standalone, across atom counts, and prints a JSON line per
+configuration. Run on the real TPU:
+
+    python benchmarks/projection_bench.py
+
+Results feed PARITY.md and the evidence-based projection_backend default.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _bench(fn, *args, iters: int = 30, warmup: int = 3) -> float:
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_projection_standalone(batch: int = 256) -> list[dict]:
+    """Raw projection op: XLA one-hot-matmul vs Pallas kernel."""
+    from d4pg_tpu.ops import categorical_projection, make_support
+    from d4pg_tpu.ops.pallas_projection import categorical_projection_pallas
+
+    rows = []
+    rng = np.random.default_rng(0)
+    for atoms in (51, 101, 201):
+        support = make_support(-150.0, 150.0, atoms)
+        probs = jnp.asarray(
+            rng.dirichlet(np.ones(atoms), size=batch), jnp.float32
+        )
+        rewards = jnp.asarray(rng.uniform(-1, 0, batch), jnp.float32)
+        discounts = jnp.full((batch,), 0.99**3, jnp.float32)
+        interpret = jax.default_backend() != "tpu"
+
+        xla_fn = jax.jit(lambda p, r, d: categorical_projection(support, p, r, d))
+        pallas_fn = jax.jit(
+            lambda p, r, d: categorical_projection_pallas(
+                support, p, r, d, interpret
+            )
+        )
+        t_xla = _bench(xla_fn, probs, rewards, discounts)
+        t_pallas = _bench(pallas_fn, probs, rewards, discounts)
+        rows.append(
+            {
+                "bench": "projection_standalone",
+                "atoms": atoms,
+                "batch": batch,
+                "xla_us": round(t_xla * 1e6, 1),
+                "pallas_us": round(t_pallas * 1e6, 1),
+                "pallas_speedup": round(t_xla / t_pallas, 2),
+            }
+        )
+    return rows
+
+
+def bench_fused_train(atoms: int, backend: str, dtype: str, K: int = 64,
+                      batch: int = 256) -> dict:
+    """grad-steps/s of the fused K-step train scan under each config."""
+    from d4pg_tpu.agent import D4PGConfig, create_train_state
+    from d4pg_tpu.agent.d4pg import fused_train_scan
+    from d4pg_tpu.models.critic import DistConfig
+
+    config = D4PGConfig(
+        obs_dim=17, action_dim=6, hidden_sizes=(256, 256, 256),
+        dist=DistConfig(kind="categorical", num_atoms=atoms,
+                        v_min=-150.0, v_max=150.0),
+        compute_dtype=dtype,
+        projection_backend=backend,
+    )
+    state = create_train_state(config, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    batches = {
+        "obs": jnp.asarray(rng.normal(size=(K, batch, 17)), jnp.float32),
+        "action": jnp.asarray(rng.uniform(-1, 1, (K, batch, 6)), jnp.float32),
+        "reward": jnp.asarray(rng.uniform(-1, 0, (K, batch)), jnp.float32),
+        "next_obs": jnp.asarray(rng.normal(size=(K, batch, 17)), jnp.float32),
+        "discount": jnp.full((K, batch), 0.99**3, jnp.float32),
+        "weights": jnp.ones((K, batch), jnp.float32),
+    }
+    step = jax.jit(lambda s, b: fused_train_scan(config, s, b)[0])
+    t = _bench(step, state, batches, iters=10)
+    return {
+        "bench": "fused_train_scan",
+        "atoms": atoms,
+        "projection": backend,
+        "compute_dtype": dtype,
+        "grad_steps_per_sec": round(K / t),
+    }
+
+
+def main() -> None:
+    print(f"# backend: {jax.default_backend()}, device: {jax.devices()[0]}")
+    for row in bench_projection_standalone():
+        print(json.dumps(row))
+    for atoms in (51, 101, 201):
+        for backend in ("xla", "pallas"):
+            print(json.dumps(bench_fused_train(atoms, backend, "float32")))
+    # bf16 compute path (MXU-native matmuls), XLA projection
+    for atoms in (51,):
+        print(json.dumps(bench_fused_train(atoms, "xla", "bfloat16")))
+
+
+if __name__ == "__main__":
+    main()

@@ -222,6 +222,24 @@ def train_step(
     if weights is None:
         weights = jnp.ones_like(batch["reward"])
 
+    # DrQ random shift on pixel batches (ops/augment.py): the one
+    # regularizer that makes Q-learning from images train at all. Keys come
+    # from the TrainState's PRNG slot, so the scan/jit stays pure and every
+    # step shifts differently.
+    new_key = state.key
+    if config.pixel_shape and config.augment_pad > 0:
+        from d4pg_tpu.ops.augment import random_shift
+
+        k_obs, k_next, new_key = jax.random.split(state.key, 3)
+        shape = tuple(config.pixel_shape)
+        batch = dict(batch)
+        batch["obs"] = random_shift(
+            batch["obs"], k_obs, shape, config.augment_pad
+        )
+        batch["next_obs"] = random_shift(
+            batch["next_obs"], k_next, shape, config.augment_pad
+        )
+
     # ---- target: y = Φ(r + γ_eff · Z_target(s', μ_target(s'))) ----
     next_action = actor.apply(state.target_actor_params, batch["next_obs"])
     target_head = critic.apply(
@@ -326,6 +344,7 @@ def train_step(
     # ---- Polyak target updates (reference ddpg.py:250 → 110-116) ----
     new_state = state.replace(
         step=state.step + 1,
+        key=new_key,
         actor_params=actor_params,
         critic_params=critic_params,
         target_actor_params=polyak_update(

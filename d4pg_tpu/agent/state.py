@@ -37,6 +37,11 @@ class D4PGConfig:
     # them (d4pg_tpu/models/encoders.py) in front of the MLP trunk.
     pixel_shape: tuple | None = None
     encoder_embed_dim: int = 50
+    # DrQ random-shift augmentation of pixel batches inside the train step
+    # (ops/augment.py). Effectively required: without it the conv critic
+    # overfits and pixel tasks sit at random-policy return indefinitely
+    # (measured on pixel_pendulum). 0 disables.
+    augment_pad: int = 4
     dist: DistConfig = field(default_factory=DistConfig)
     gamma: float = 0.99
     n_step: int = 1

@@ -24,6 +24,7 @@ _EXPORTS = {
     "PixelPendulum": "d4pg_tpu.envs.pixel_pendulum",
     "PointMassGoal": "d4pg_tpu.envs.pointmass_goal",
     "rollout": "d4pg_tpu.envs.rollouts",
+    "DMControlAdapter": "d4pg_tpu.envs.dmc_adapter",
     "GymAdapter": "d4pg_tpu.envs.gym_adapter",
     "NormalizeAction": "d4pg_tpu.envs.gym_adapter",
     "make_env": "d4pg_tpu.envs.gym_adapter",

@@ -1,0 +1,149 @@
+"""dm_control suite adapter — state or pixel observations.
+
+BASELINE.json config 4 names "dm_control pixels" as a target workload; this
+adapter puts any `dm_control.suite` task behind the same host-env interface
+as :class:`~d4pg_tpu.envs.gym_adapter.GymAdapter` (reset() → obs,
+step(a) → (obs, r, terminated, truncated, info), canonical (−1,1) actions),
+so the Trainer, actor pool, and evaluator drive it unchanged.
+
+Pixel mode follows the repo's pixel convention (envs/pixel_pendulum.py):
+observations are FLATTENED [H, W, 2] float frames in [0, 1] — grayscale
+current + previous frame, so a single observation is Markovian in velocity
+— and the env advertises ``pixel_shape`` for the conv encoder and the
+uint8-quantized replay. Rendering uses MuJoCo's EGL backend (set before
+dm_control import; OSMesa is broken in this image — verified).
+
+dm_control tasks never terminate; episodes end by time limit only, reported
+as truncation (matching gym semantics where TimeLimit truncates).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from d4pg_tpu.envs.gym_adapter import NormalizeAction
+
+# Categorical support for suite tasks: rewards are in [0, 1] per step, so
+# values are bounded by the episode horizon. Exposed as adapter v_min/v_max
+# attributes, which _reconcile_config adopts for envs without a preset.
+DMC_VALUE_RANGE = (0.0, 1000.0)
+
+
+def _load_suite():
+    os.environ.setdefault("MUJOCO_GL", "egl")
+    from dm_control import suite
+
+    return suite
+
+
+class DMControlAdapter:
+    """``dmc:domain:task`` (state) / ``dmc_pixels:domain:task`` (pixels)."""
+
+    def __init__(
+        self,
+        domain: str,
+        task: str,
+        max_episode_steps: Optional[int] = None,
+        pixels: bool = False,
+        size: int = 48,
+        camera_id: int = 0,
+    ):
+        suite = _load_suite()
+        self.env = suite.load(domain, task)
+        self._dt = (domain, task)
+        # Categorical support hint for _reconcile_config (no static preset
+        # can enumerate every suite task; [0, horizon] bounds them all).
+        self.v_min, self.v_max = DMC_VALUE_RANGE
+        # Host-env marker: the Trainer routes envs with this attribute
+        # through the host-collection paths (same convention as GymAdapter);
+        # suite tasks are not goal-conditioned so it stays None.
+        self.last_goal_obs = None
+        self.pixels = pixels
+        self.size = size
+        self.camera_id = camera_id
+        # suite episodes are time_limit/control_timestep steps long
+        try:
+            native_limit = int(round(
+                self.env._time_limit / self.env.control_timestep()
+            ))
+        except (AttributeError, TypeError, OverflowError):
+            native_limit = 1000  # suite default horizon
+        self.max_episode_steps = max_episode_steps or native_limit
+        spec = self.env.action_spec()
+        self._normalize = NormalizeAction(spec.minimum, spec.maximum)
+        self.action_dim = int(np.prod(spec.shape))
+        if pixels:
+            self.pixel_shape = (size, size, 2)
+            self.observation_dim = size * size * 2
+        else:
+            self.observation_dim = int(
+                sum(
+                    np.prod(v.shape) if v.shape else 1
+                    for v in self.env.observation_spec().values()
+                )
+            )
+        self._prev_frame: Optional[np.ndarray] = None
+        self._t = 0
+
+    # ------------------------------------------------------------------ obs
+    def _render_gray(self) -> np.ndarray:
+        rgb = self.env.physics.render(
+            height=self.size, width=self.size, camera_id=self.camera_id
+        )
+        return (rgb.astype(np.float32) / 255.0).mean(axis=-1)
+
+    def _obs(self, time_step) -> np.ndarray:
+        if self.pixels:
+            frame = self._render_gray()
+            prev = frame if self._prev_frame is None else self._prev_frame
+            self._prev_frame = frame
+            return np.stack([frame, prev], axis=-1).ravel().astype(np.float32)
+        return np.concatenate(
+            [np.ravel(v) for v in time_step.observation.values()]
+        ).astype(np.float32)
+
+    # ------------------------------------------------------------- protocol
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            # Reseed the EXISTING task RNG. Rebuilding via suite.load would
+            # recompile the MJCF and (in pixel mode) open a fresh EGL
+            # context per episode — the actor pool seeds every episode, so
+            # that leaked a GL context and paid a model compile per episode.
+            self.env.task._random = np.random.RandomState(seed)
+        self._prev_frame = None
+        self._t = 0
+        return self._obs(self.env.reset())
+
+    def _domain_task(self):
+        return self._dt
+
+    def step(self, action: np.ndarray):
+        ts = self.env.step(self._normalize.to_env(np.asarray(action)))
+        self._t += 1
+        reward = float(ts.reward or 0.0)
+        # suite tasks end by time limit only → truncation, never termination
+        truncated = bool(ts.last() or self._t >= self.max_episode_steps)
+        return self._obs(ts), reward, False, truncated, {}
+
+    def close(self):
+        self.env.close()
+
+
+def make_dmc(name: str, max_episode_steps: Optional[int] = None):
+    """Parse ``dmc:domain:task`` / ``dmc_pixels:domain:task`` into an adapter."""
+    parts = name.split(":", 2)
+    if len(parts) != 3 or not all(parts):
+        raise ValueError(
+            f"bad dm_control env id {name!r}: expected dmc:<domain>:<task> "
+            "or dmc_pixels:<domain>:<task> (e.g. dmc:cartpole:swingup)"
+        )
+    prefix, domain, task = parts
+    return DMControlAdapter(
+        domain,
+        task,
+        max_episode_steps=max_episode_steps,
+        pixels=(prefix == "dmc_pixels"),
+    )

@@ -135,6 +135,10 @@ def make_env(name: str, max_episode_steps: Optional[int] = None):
         return PixelPendulum()
     if name == "pointmass_goal":
         return PointMassGoal()
+    if name.startswith(("dmc:", "dmc_pixels:")):
+        from d4pg_tpu.envs.dmc_adapter import make_dmc
+
+        return make_dmc(name, max_episode_steps)
     if name in ("halfcheetah", "hopper", "walker2d"):
         from d4pg_tpu.envs import locomotion
 

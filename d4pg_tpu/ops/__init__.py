@@ -17,6 +17,7 @@ from d4pg_tpu.ops.noise import (
     ou_noise_reset,
     ou_noise_sample,
 )
+from d4pg_tpu.ops.augment import random_shift
 from d4pg_tpu.ops.mog import (
     mog_bellman_targets,
     mog_cross_entropy,
@@ -39,6 +40,7 @@ __all__ = [
     "ou_noise_init",
     "ou_noise_reset",
     "ou_noise_sample",
+    "random_shift",
     "mog_bellman_targets",
     "mog_cross_entropy",
     "mog_log_prob",

@@ -31,6 +31,22 @@ class MetricsLogger:
         self._tb = None
         if use_tensorboard:
             try:
+                # Force tensorboard onto its TF-free stubs instead of lazily
+                # importing the full TensorFlow runtime — that import
+                # SEGFAULTS when a MuJoCo EGL context is already loaded in
+                # the process (dm_control pixel envs; reproduced via
+                # faulthandler inside tensorflow's preload_check), and the
+                # event-file writer needs none of it. tensorboard switches
+                # on the importability of `tensorboard.compat.notf` (a
+                # bazel-only marker module absent from the pip package), so
+                # provide it.
+                import sys
+                import types
+
+                sys.modules.setdefault(
+                    "tensorboard.compat.notf",
+                    types.ModuleType("tensorboard.compat.notf"),
+                )
                 from torch.utils.tensorboard import SummaryWriter
 
                 self._tb = SummaryWriter(log_dir)

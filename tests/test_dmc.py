@@ -1,0 +1,157 @@
+"""dm_control adapter: state + pixel modes behind the host-env interface."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("dm_control")
+
+
+
+
+def _clean_cpu_env():
+    """A child env with a REAL local CPU backend: the tunneled-TPU plugin
+    registers itself via PYTHONPATH site hooks and AXON_*/TPU_* vars and
+    overrides JAX_PLATFORMS=cpu (a per-step host sync then costs a ~100 ms
+    link round-trip — per-step env loops crawl ~1000x)."""
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("JAX_PLATFORMS", "XLA_FLAGS", "PYTHONPATH")
+        and "AXON" not in k
+        and "TPU" not in k
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo
+    env.setdefault("MUJOCO_GL", "egl")
+    return env
+
+@pytest.fixture(scope="module")
+def state_env():
+    from d4pg_tpu.envs import make_env
+
+    return make_env("dmc:cartpole:swingup", 200)
+
+
+def test_state_mode_shapes_and_protocol(state_env):
+    env = state_env
+    assert env.action_dim == 1
+    assert env.observation_dim == 5  # cartpole: position(3) + velocity(2)
+    obs = env.reset(seed=0)
+    assert obs.shape == (5,) and obs.dtype == np.float32
+    obs2, r, term, trunc, info = env.step(np.array([0.5], np.float32))
+    assert obs2.shape == (5,)
+    assert 0.0 <= r <= 1.0  # suite rewards are [0, 1] per step
+    assert term is False  # suite tasks truncate, never terminate
+
+
+def test_state_mode_truncates_at_limit(state_env):
+    env = state_env
+    env.reset(seed=1)
+    trunc = False
+    for _ in range(200):
+        _, _, _, trunc, _ = env.step(np.array([0.0], np.float32))
+        if trunc:
+            break
+    assert trunc
+
+
+@pytest.mark.slow
+def test_pixel_mode_convention():
+    """Pixels follow the repo convention: flattened [H, W, 2] floats in
+    [0,1], two-frame grayscale stack, pixel_shape advertised for the conv
+    encoder + uint8 replay. Subprocess: EGL rendering in the main pytest
+    process segfaults at interpreter teardown (torch/h5py/JAX all loaded)."""
+    import os
+    import subprocess
+    import sys
+    import textwrap
+
+    script = textwrap.dedent(
+        f"""
+        import sys
+        sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+        import numpy as np
+        from d4pg_tpu.envs import make_env
+
+        env = make_env("dmc_pixels:cartpole:swingup", 100)
+        assert env.pixel_shape == (48, 48, 2)
+        assert env.observation_dim == 48 * 48 * 2
+        obs = env.reset(seed=0)
+        assert obs.shape == (48 * 48 * 2,)
+        assert obs.min() >= 0.0 and obs.max() <= 1.0 and obs.max() > 0.05
+        frames = obs.reshape(48, 48, 2)
+        np.testing.assert_allclose(frames[..., 0], frames[..., 1])
+        prev = frames[..., 0]
+        obs2, *_ = env.step(np.array([1.0], np.float32))
+        frames2 = obs2.reshape(48, 48, 2)
+        np.testing.assert_allclose(frames2[..., 1], prev)
+        print("DMC_PIXEL_CONV_OK")
+        """
+    )
+    p = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        timeout=600, env=_clean_cpu_env(),
+    )
+    assert "DMC_PIXEL_CONV_OK" in p.stdout, p.stdout + p.stderr
+
+
+@pytest.mark.slow
+def test_pixel_mode_trains_with_conv_encoder(tmp_path):
+    """Trainer end-to-end on dm_control pixels: _reconcile_config adopts
+    pixel_shape from the live env, replay stores uint8, conv encoder runs.
+
+    Runs in a SUBPROCESS: EGL rendering inside the main pytest process
+    (with torch/h5py/pandas and the JAX runtime all loaded) segfaults at
+    interpreter teardown; a fresh interpreter is clean."""
+    import os
+    import subprocess
+    import sys
+    import textwrap
+
+    script = textwrap.dedent(
+        f"""
+        import os
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import dataclasses
+        import numpy as np
+        import sys
+        sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+        from train import build_parser, config_from_args
+        from d4pg_tpu.runtime.trainer import Trainer
+
+        args = build_parser().parse_args([
+            "--env", "dmc_pixels:cartpole:swingup",
+            "--total-steps", "3", "--warmup", "40",
+            "--eval-interval", "1000000", "--checkpoint-interval", "1000000",
+            "--num-envs", "1", "--bsize", "8", "--rmsize", "500",
+            "--max-steps", "40",
+            "--log-dir", {str(tmp_path / "dmc")!r},
+        ])
+        cfg = config_from_args(args)
+        cfg = dataclasses.replace(
+            cfg,
+            agent=dataclasses.replace(
+                cfg.agent, hidden_sizes=(32, 32), encoder_embed_dim=16
+            ),
+        )
+        t = Trainer(cfg)
+        assert t.config.agent.pixel_shape == (48, 48, 2)
+        assert t.buffer.obs.dtype == np.uint8
+        t.warmup()
+        out = t.train()
+        t.close()
+        assert np.isfinite(out["critic_loss"])
+        print("DMC_PIXEL_TRAIN_OK")
+        """
+    )
+    p = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=900,
+        env=_clean_cpu_env(),
+    )
+    assert "DMC_PIXEL_TRAIN_OK" in p.stdout, p.stdout + p.stderr

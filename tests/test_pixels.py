@@ -136,6 +136,60 @@ def test_pixel_trainer_smoke(tmp_path):
     assert np.isfinite(out["critic_loss"])
 
 
+def test_random_shift_augmentation():
+    """DrQ shift: content preserved (interior pixels move, edge-pad fills),
+    per-sample independent, deterministic under a fixed key, zero pad = id."""
+    from d4pg_tpu.ops import random_shift
+
+    H = W = 12
+    rng = np.random.default_rng(0)
+    flat = jnp.asarray(rng.uniform(0, 1, (8, H * W * 2)), jnp.float32)
+    out = random_shift(flat, jax.random.PRNGKey(0), (H, W, 2), pad=4)
+    assert out.shape == flat.shape
+    assert 0.0 <= float(out.min()) and float(out.max()) <= 1.0
+    # deterministic
+    out2 = random_shift(flat, jax.random.PRNGKey(0), (H, W, 2), pad=4)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+    # different key → different shifts (almost surely)
+    out3 = random_shift(flat, jax.random.PRNGKey(1), (H, W, 2), pad=4)
+    assert not np.allclose(np.asarray(out), np.asarray(out3))
+    # shifts are per-sample: identical inputs can land on distinct crops
+    same = jnp.broadcast_to(flat[:1], flat.shape)
+    outs = np.asarray(random_shift(same, jax.random.PRNGKey(2), (H, W, 2)))
+    assert np.unique(outs.round(6), axis=0).shape[0] > 1
+
+
+def test_train_step_augment_keys_advance():
+    """Pixel configs thread the PRNG through the state so every train step
+    augments differently; flat configs leave the key untouched."""
+    cfgs = {
+        "pixel": D4PGConfig(
+            obs_dim=8 * 8 * 2, action_dim=1, hidden_sizes=(16, 16),
+            pixel_shape=(8, 8, 2), encoder_embed_dim=8,
+            dist=DistConfig(num_atoms=11, v_min=-5, v_max=5),
+        ),
+        "flat": D4PGConfig(
+            obs_dim=4, action_dim=1, hidden_sizes=(16, 16),
+            dist=DistConfig(num_atoms=11, v_min=-5, v_max=5),
+        ),
+    }
+    rng = np.random.default_rng(0)
+    for name, cfg in cfgs.items():
+        state = create_train_state(cfg, jax.random.PRNGKey(0))
+        B = 4
+        batch = {
+            "obs": jnp.asarray(rng.uniform(0, 1, (B, cfg.obs_dim)), jnp.float32),
+            "action": jnp.zeros((B, 1), jnp.float32),
+            "reward": jnp.zeros((B,), jnp.float32),
+            "next_obs": jnp.asarray(rng.uniform(0, 1, (B, cfg.obs_dim)), jnp.float32),
+            "discount": jnp.full((B,), 0.9, jnp.float32),
+            "weights": jnp.ones((B,), jnp.float32),
+        }
+        state2, _, _ = jit_train_step(cfg, donate=False)(state, batch)
+        changed = not np.array_equal(np.asarray(state.key), np.asarray(state2.key))
+        assert changed == (name == "pixel"), name
+
+
 def test_uint8_replay_roundtrip():
     """Pixel replay stores uint8 (4x less RAM); [0,1] floats round-trip
     within quantization error 1/255."""
