@@ -124,9 +124,15 @@ class DMControlAdapter:
         ts = self.env.step(self._normalize.to_env(np.asarray(action)))
         self._t += 1
         reward = float(ts.reward or 0.0)
-        # suite tasks end by time limit only → truncation, never termination
-        truncated = bool(ts.last() or self._t >= self.max_episode_steps)
-        return self._obs(ts), reward, False, truncated, {}
+        # Standard suite tasks end by time limit only, but dm_control marks
+        # a TRUE termination (early task end, physics divergence) with
+        # ts.last() and discount == 0 — bootstrapping through that state
+        # would corrupt the Bellman target, so distinguish the two
+        # (ADVICE round-2; dm_control environment.py TimeStep semantics).
+        last = bool(ts.last())
+        terminated = last and float(ts.discount or 0.0) == 0.0
+        truncated = (last and not terminated) or self._t >= self.max_episode_steps
+        return self._obs(ts), reward, terminated, truncated, {}
 
     def close(self):
         self.env.close()

@@ -32,6 +32,9 @@ class PointMassGoal:
     v_min = -50.0
     v_max = 0.0
     success_threshold = 0.1
+    # Goal env: termination == goal reached, so success_rate is meaningful
+    # (the evaluator omits it for envs without this flag).
+    reports_success = True
 
     def __init__(self, arena: float = 1.0, dt: float = 0.1, max_accel: float = 1.0):
         self.arena = arena

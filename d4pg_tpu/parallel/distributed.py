@@ -28,6 +28,13 @@ def initialize_distributed(
     and cannot be re-spanned afterwards. Returns a summary dict for logging.
     """
     if coordinator_address is not None or (num_processes or 0) > 1:
+        if coordinator_address is None:
+            # jax.distributed.initialize(None, ...) fails deep in the
+            # backend with an opaque error; name the missing flag instead.
+            raise ValueError(
+                f"--num-processes {num_processes} needs a coordinator: pass "
+                "--coordinator HOST:PORT or set D4PG_COORDINATOR"
+            )
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Mapping
 
@@ -53,17 +54,25 @@ class MetricsLogger:
             except Exception:
                 self._tb = None
         self._t0 = time.monotonic()
+        # log() is called from the learner thread (replaced-request train
+        # rows) AND the evaluator thread (completed evals); serialize so
+        # jsonl lines never interleave mid-record.
+        self._log_lock = threading.Lock()
 
     def log(self, step: int, scalars: Mapping[str, float]) -> None:
         rec = {"step": int(step), "t": time.monotonic() - self._t0}
         rec.update({k: float(v) for k, v in scalars.items()})
-        self._jsonl.write(json.dumps(rec) + "\n")
-        self._jsonl.flush()
-        if self._tb is not None:
-            for k, v in scalars.items():
-                self._tb.add_scalar(k, float(v), int(step))
+        with self._log_lock:
+            self._jsonl.write(json.dumps(rec) + "\n")
+            self._jsonl.flush()
+            if self._tb is not None:
+                for k, v in scalars.items():
+                    self._tb.add_scalar(k, float(v), int(step))
 
     def close(self) -> None:
-        self._jsonl.close()
-        if self._tb is not None:
-            self._tb.close()
+        # Under the log lock so a concurrent log() can never be torn by the
+        # file closing between its write and flush.
+        with self._log_lock:
+            self._jsonl.close()
+            if self._tb is not None:
+                self._tb.close()

@@ -284,6 +284,10 @@ class Trainer:
         self._eval_stop = threading.Event()
         self._eval_error: Optional[BaseException] = None
         self._eval_env = None            # dedicated env for single-env mode
+        # Set when the evaluator thread outlived the shutdown join: close()
+        # must then LEAK the eval pool/env rather than close them under a
+        # still-stepping worker (ADVICE round-2: use-after-close crash).
+        self._eval_leaked = False
         self._last_eval_row: dict = {}   # most recent full logged row
         self._last_eval_ev: dict = {}    # most recent eval-only scalars
         # Trainer-lifetime grad-step counter for async pacing. Deliberately
@@ -1124,6 +1128,7 @@ class Trainer:
         alive = np.ones(n, bool)
         rets = np.zeros(n, np.float64)
         ep_success = np.zeros(n, bool)
+        any_reported = False
         eval_act = self._get_eval_act()
         if eval_params is None:
             eval_params = self._eval_params()
@@ -1132,20 +1137,25 @@ class Trainer:
             obs2, r, term, trunc, pol_obs, s, s_rep = self._eval_pool.step(a)
             rets += r * alive
             # final-step semantics, matching the single-env path: the
-            # episode's success is is_success at its last step if the env
-            # reports it, else terminal termination (reference main.py:327)
+            # episode's success is is_success at its last step — ONLY where
+            # the env reports it (reference main.py:327; it only ran goal
+            # envs). Counting bare termination as success inverts the
+            # metric on locomotion envs, where termination = falling
+            # (VERDICT round-2 weak #1: Humanoid logged success 1.0).
             done_now = (term | trunc) & alive
-            final = np.where(s_rep, s, term)
-            ep_success = np.where(done_now, final, ep_success)
+            ep_success = np.where(done_now, s & s_rep, ep_success)
+            any_reported |= bool((done_now & s_rep).any())
             alive &= ~(term | trunc)
             obs = pol_obs
             if not alive.any():
                 break
-        return {
+        out = {
             "eval_return_mean": float(rets.mean()),
             "eval_return_std": float(rets.std()),
-            "success_rate": float(ep_success.mean()),
         }
+        if any_reported:
+            out["success_rate"] = float(ep_success.mean())
+        return out
 
     def _get_eval_act(self):
         """Cached jitted greedy-actor forward (a fresh lambda per eval would
@@ -1227,9 +1237,11 @@ class Trainer:
     def _request_eval(self, scalars: dict) -> None:
         """Hand the evaluator thread a param copy + this crossing's train
         scalars. If an eval is still in flight, the newer request REPLACES
-        the waiting one (latest params win; the replaced crossing logs no
-        row — the reference's 10 s-cadence evaluator misses steps the same
-        way)."""
+        the waiting one (latest params win — the reference's 10 s-cadence
+        evaluator misses steps the same way). The replaced crossing still
+        logs a train-scalars-only row, so losses/steps-per-sec keep their
+        eval_interval cadence in metrics.jsonl even when evals are slow
+        relative to the interval (ADVICE round-2)."""
         if self._eval_error is not None:
             raise RuntimeError("evaluator thread died") from self._eval_error
         if self._eval_thread is None or not self._eval_thread.is_alive():
@@ -1240,9 +1252,13 @@ class Trainer:
             self._eval_thread.start()
         params = self._copy_eval_params()
         with self._eval_req_lock:
+            replaced = self._eval_req
             self._eval_idle.clear()
             self._eval_req = (params, self.grad_steps, scalars)
             self._eval_pending.set()
+        if replaced is not None:
+            _, r_step, r_scalars = replaced
+            self.metrics.log(r_step, r_scalars)
 
     def _drain_eval(self, timeout: float = 600.0) -> None:
         """Wait for in-flight + pending evals (end of train(): the final
@@ -1264,6 +1280,16 @@ class Trainer:
             self._eval_stop.set()
             self._eval_pending.set()  # wake the wait()
             self._eval_thread.join(timeout=60)
+            if self._eval_thread.is_alive():
+                # A host eval can legitimately run for minutes (_drain_eval
+                # allows 600 s); closing the eval pool/env under a worker
+                # that is still stepping them is a use-after-close crash.
+                # Leak them instead and say so.
+                self._eval_leaked = True
+                print(
+                    "[evaluator] still running after 60 s shutdown join; "
+                    "leaking eval pool/env rather than closing them mid-step"
+                )
             self._eval_thread = None
 
     def _host_eval(self, eval_params=None) -> dict:
@@ -1283,6 +1309,7 @@ class Trainer:
                 self._eval_env = make_env(cfg.env, cfg.max_episode_steps)
             env = self._eval_env
         rets, succ = [], 0
+        any_reported = False
         eval_act = self._get_eval_act()
         for _ in range(cfg.eval_episodes):
             obs = env.reset()
@@ -1293,13 +1320,20 @@ class Trainer:
                 ep_ret += r
                 if term or trunc:
                     break
-            succ += int(bool(info.get("is_success", term))) if isinstance(info, dict) else int(term)
+            # success only where the env actually emits is_success —
+            # falling back to `term` turned falling-over into success on
+            # locomotion envs (VERDICT round-2 weak #1)
+            if isinstance(info, dict) and "is_success" in info:
+                any_reported = True
+                succ += int(bool(info["is_success"]))
             rets.append(ep_ret)
-        return {
+        out = {
             "eval_return_mean": float(np.mean(rets)),
             "eval_return_std": float(np.std(rets)),
-            "success_rate": succ / cfg.eval_episodes,
         }
+        if any_reported:
+            out["success_rate"] = succ / cfg.eval_episodes
+        return out
 
     def _periodic(self, metrics, t_start, grad_steps_done, env_steps_start) -> dict:
         cfg = self.config
@@ -1341,13 +1375,21 @@ class Trainer:
         self._stop_collector()
         self._stop_eval_thread()
         self._stop_writeback()
-        self.metrics.close()
+        if not self._eval_leaked:
+            # A leaked evaluator thread will still call metrics.log() when
+            # its eval completes; closing the logger under it would raise
+            # in that thread / tear the final jsonl record. Leak it too.
+            self.metrics.close()
         self.ckpt.close()
         if self.has_pool:
             self.pool.close()
-        if self._eval_pool is not None:
+        if self._eval_pool is not None and not self._eval_leaked:
             self._eval_pool.close()
-        if self._eval_env is not None and hasattr(self._eval_env, "close"):
+        if (
+            self._eval_env is not None
+            and not self._eval_leaked
+            and hasattr(self._eval_env, "close")
+        ):
             self._eval_env.close()
         if hasattr(self.env, "close"):
             self.env.close()
