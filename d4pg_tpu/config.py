@@ -79,6 +79,15 @@ class TrainConfig:
     prioritized: bool = True           # reference --p_replay
     n_step: int = 3                    # reference --n_steps
     tree_backend: str = "auto"
+    # Host→device batch staging dtype for observations. "bfloat16" halves
+    # the bytes-per-dispatch on the link (the wall for wide-obs host envs —
+    # docs/REMOTE_TPU.md "fourth tax"; Humanoid's 348-dim obs saturate a
+    # tunneled link at ~14-16 grad-steps/s in f32). Obs are cast back to
+    # f32 INSIDE the jitted step, so only the wire format changes; bf16's
+    # 8-bit mantissa is ~3 decimal digits of obs precision, far above
+    # exploration-noise scale. Host-path only (pure-JAX envs never
+    # transfer batches).
+    transfer_dtype: str = "float32"
 
     # evaluation / logging / checkpoint
     eval_interval: int = 2_000         # grad steps between evals
@@ -145,6 +154,9 @@ ENV_PRESETS = {
     "halfcheetah": dict(v_min=0.0, v_max=1000.0, obs_dim=17, action_dim=6, max_episode_steps=1000),
     "hopper": dict(v_min=0.0, v_max=500.0, obs_dim=11, action_dim=3, max_episode_steps=1000),
     "walker2d": dict(v_min=0.0, v_max=500.0, obs_dim=17, action_dim=6, max_episode_steps=1000),
+    # On-device 3D Humanoid (envs/spatial.py engine) — 45-dim proprioceptive
+    # obs (see envs/locomotion.py:Humanoid docstring for the layout rationale).
+    "humanoid": dict(v_min=0.0, v_max=1000.0, obs_dim=45, action_dim=17, max_episode_steps=1000),
     "Pendulum-v1": dict(v_min=-300.0, v_max=0.0, obs_dim=3, action_dim=1, max_episode_steps=200),
     "HalfCheetah-v4": dict(v_min=0.0, v_max=1000.0, obs_dim=17, action_dim=6, max_episode_steps=1000),
     "HalfCheetah-v5": dict(v_min=0.0, v_max=1000.0, obs_dim=17, action_dim=6, max_episode_steps=1000),

@@ -19,6 +19,7 @@ _EXPORTS = {
     "EnvState": "d4pg_tpu.envs.api",
     "HalfCheetah": "d4pg_tpu.envs.locomotion",
     "Hopper": "d4pg_tpu.envs.locomotion",
+    "Humanoid": "d4pg_tpu.envs.locomotion",
     "Walker2d": "d4pg_tpu.envs.locomotion",
     "Pendulum": "d4pg_tpu.envs.pendulum",
     "PixelPendulum": "d4pg_tpu.envs.pixel_pendulum",

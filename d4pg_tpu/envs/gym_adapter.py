@@ -139,13 +139,14 @@ def make_env(name: str, max_episode_steps: Optional[int] = None):
         from d4pg_tpu.envs.dmc_adapter import make_dmc
 
         return make_dmc(name, max_episode_steps)
-    if name in ("halfcheetah", "hopper", "walker2d"):
+    if name in ("halfcheetah", "hopper", "walker2d", "humanoid"):
         from d4pg_tpu.envs import locomotion
 
         cls = {
             "halfcheetah": locomotion.HalfCheetah,
             "hopper": locomotion.Hopper,
             "walker2d": locomotion.Walker2d,
+            "humanoid": locomotion.Humanoid,
         }[name]
         return cls(max_episode_steps=max_episode_steps)
     return GymAdapter(name, max_episode_steps)

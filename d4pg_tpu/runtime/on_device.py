@@ -496,7 +496,11 @@ def run_on_device(config) -> dict:
             )
             if best_eval is None or scalars["eval_return_mean"] > best_eval:
                 best_eval = scalars["eval_return_mean"]
-                best_ckpt.save(grad_steps, carry[0])
+                # A resumed eval-only leg can re-cross the same grad_steps a
+                # previous leg already saved at; Orbax raises on an existing
+                # step, so only the score/JSON update happens in that case.
+                if best_ckpt.latest_step() != grad_steps:
+                    best_ckpt.save(grad_steps, carry[0])
                 # Orbax saves are async: wait before recording the score so
                 # a crash can never leave best_eval.json claiming params
                 # that were never persisted (same ordering as _save below);

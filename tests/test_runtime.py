@@ -51,6 +51,27 @@ def test_trainer_uniform_replay_mode(tmp_path):
     assert np.isfinite(out["critic_loss"])
 
 
+def test_trainer_bf16_transfer_staging(tmp_path):
+    """--transfer-dtype bfloat16 (the wide-obs link-bandwidth rung,
+    docs/REMOTE_TPU.md): obs go over the wire as bf16 and are restored to
+    f32 in-jit — training must stay finite and the staged arrays must
+    actually be 2 bytes/element."""
+    import ml_dtypes
+
+    t = Trainer(
+        config_from_args(
+            _tiny_args(tmp_path / "bf", ["--env", "Pendulum-v1",
+                                         "--transfer-dtype", "bfloat16"])
+        )
+    )
+    staged = t._stage("obs", np.ones((4, 3), np.float32))
+    assert staged.dtype == ml_dtypes.bfloat16
+    assert t._stage("reward", np.ones(4, np.float32)).dtype == np.float32
+    out = t.train()
+    t.close()
+    assert np.isfinite(out["critic_loss"])
+
+
 @pytest.mark.slow
 def test_trainer_her_mode(tmp_path):
     args = build_parser().parse_args(

@@ -1,0 +1,184 @@
+"""3D spatial physics engine: quantitative validation against MuJoCo on the
+humanoid + the on-device Humanoid env built on it.
+
+Same correctness bar as tests/test_planar.py: mass matrix, bias forces, and
+FK must MATCH host MuJoCo compiled from the same MJCF (the f32 engine vs
+f64 MuJoCo ⇒ f32-resolution tolerances). Contacts are penalty-based by
+design (documented deviation) and validated behaviorally: the passive
+humanoid falls and comes to rest at ground level without blowing up.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+mujoco = pytest.importorskip("mujoco")
+
+from d4pg_tpu.envs.locomotion import Humanoid, _gym_xml
+from d4pg_tpu.envs.spatial import (
+    bias_force,
+    body_coms,
+    contact_points,
+    extract_spatial_model,
+    mass_matrix,
+    step_physics,
+)
+
+XML = _gym_xml("humanoid.xml")
+
+
+@pytest.fixture(scope="module")
+def model():
+    return extract_spatial_model(XML)
+
+
+@pytest.fixture(scope="module")
+def mj():
+    m = mujoco.MjModel.from_xml_path(XML)
+    return m, mujoco.MjData(m)
+
+
+def _random_state(m, rng):
+    """Random airborne pose: arbitrary root quaternion, joints inside their
+    ranges, so only rigid-body terms are exercised (contacts inactive)."""
+    q = np.array(m.qpos0)
+    q[:2] = rng.uniform(-1, 1, 2)
+    q[2] = 2.5
+    quat = rng.normal(0, 1, 4)
+    q[3:7] = quat / np.linalg.norm(quat)
+    q[7:] += rng.uniform(-0.5, 0.5, m.nq - 7)
+    v = rng.normal(0, 1.0, m.nv)
+    return q, v
+
+
+def test_mass_matrix_matches_mujoco(model, mj):
+    m, d = mj
+    rng = np.random.default_rng(0)
+    for _ in range(3):
+        q, v = _random_state(m, rng)
+        d.qpos[:], d.qvel[:] = q, v
+        mujoco.mj_forward(m, d)
+        M_mj = np.zeros((m.nv, m.nv))
+        mujoco.mj_fullM(m, d, M_mj)
+        M_ours = np.asarray(mass_matrix(model, jnp.asarray(q)))
+        np.testing.assert_allclose(M_ours, M_mj, atol=2e-4, rtol=2e-4)
+
+
+def test_bias_force_matches_mujoco_rne(model, mj):
+    """Newton–Euler-through-autodiff == mj_rne(flg_acc=0): coriolis +
+    centrifugal + gyroscopic + gravity, in MuJoCo's qvel conventions
+    (world-frame linear, body-frame angular for the free joint)."""
+    m, d = mj
+    rng = np.random.default_rng(1)
+    for _ in range(3):
+        q, v = _random_state(m, rng)
+        d.qpos[:], d.qvel[:] = q, v
+        mujoco.mj_forward(m, d)
+        bias_mj = np.zeros(m.nv)
+        mujoco.mj_rne(m, d, 0, bias_mj)
+        bias_ours = np.asarray(
+            bias_force(model, jnp.asarray(q), jnp.asarray(v))
+        )
+        # bias components reach ~400 N at these velocities; f32 FK noise
+        # accumulates through two jvps → absolute 2e-2 ≈ 5e-5 relative
+        np.testing.assert_allclose(bias_ours, bias_mj, atol=2e-2, rtol=1e-3)
+
+
+def test_fk_coms_match_mujoco(model, mj):
+    m, d = mj
+    rng = np.random.default_rng(2)
+    q, v = _random_state(m, rng)
+    d.qpos[:], d.qvel[:] = q, v
+    mujoco.mj_forward(m, d)
+    coms, _ = body_coms(model, jnp.asarray(q))
+    np.testing.assert_allclose(np.asarray(coms), d.xipos[1:], atol=1e-5)
+
+
+def test_passive_drop_stays_finite_and_settles(model):
+    """Contact model check: the passive humanoid falls from the XML pose and
+    comes to rest ON the ground (no sinking through, no explosion)."""
+
+    @jax.jit
+    def roll(q, v):
+        def body(c, _):
+            q, v = c
+            q, v = step_physics(model, q, v, jnp.zeros(17), 10, 0.0015)
+            return (q, v), None
+
+        (q, v), _ = jax.lax.scan(body, (q, v), None, length=400)
+        return q, v
+
+    q0 = jnp.asarray(model.qpos0, jnp.float32)
+    q, v = roll(q0, jnp.zeros(model.nv))
+    assert bool(jnp.all(jnp.isfinite(q))) and bool(jnp.all(jnp.isfinite(v)))
+    # fallen: torso z well below standing height but above the floor
+    assert 0.05 < float(q[2]) < 1.0
+    # at rest (velocities decayed)
+    assert float(jnp.max(jnp.abs(v))) < 0.5
+    # nothing sunk through the floor: worst penetration < 2 cm
+    gaps = np.asarray(contact_points(model, q))[:, 2] - np.asarray(
+        model.con_radius
+    )
+    assert gaps.min() > -0.02
+
+
+class TestHumanoidEnv:
+    def test_reset_and_step_shapes_jit_vmap(self):
+        env = Humanoid()
+        keys = jax.random.split(jax.random.PRNGKey(0), 3)
+        states, obs = jax.vmap(env.reset)(keys)
+        assert obs.shape == (3, 45)
+        actions = jnp.zeros((3, 17))
+        states2, obs2, r, term, trunc = jax.vmap(env.step)(states, actions)
+        assert obs2.shape == (3, 45) and r.shape == (3,)
+        # starts healthy at the XML pose (z = 1.4) → no termination
+        assert bool(jnp.all(term == 0.0))
+        assert not np.allclose(np.asarray(obs[0]), np.asarray(obs[1]))
+
+    def test_reward_healthy_bonus_and_termination(self):
+        env = Humanoid()
+        state, _ = env.reset(jax.random.PRNGKey(0))
+        step = jax.jit(env.step)
+        state2, _, r, term, _ = step(state, jnp.zeros(17))
+        # standing start with zero ctrl: reward ≈ healthy bonus (5.0)
+        assert float(term) == 0.0 and 3.0 < float(r) < 7.0
+        # fallen root (z below 1.0) terminates
+        q, v = state.physics
+        fallen = state._replace(physics=(q.at[2].set(0.5), v))
+        _, _, _, term2, _ = step(fallen, jnp.zeros(17))
+        assert float(term2) == 1.0
+
+    def test_obs_layout(self):
+        env = Humanoid()
+        state, obs = env.reset(jax.random.PRNGKey(3))
+        q, v = state.physics
+        np.testing.assert_allclose(np.asarray(obs[:22]), np.asarray(q[2:]))
+        np.testing.assert_allclose(np.asarray(obs[22:]), np.asarray(v))
+        # root quaternion stays unit under reset noise
+        np.testing.assert_allclose(float(jnp.linalg.norm(q[3:7])), 1.0, atol=1e-6)
+
+    def test_ctrl_scaled_by_ctrlrange(self):
+        """Actions are canonical (−1,1); the MJCF ctrlrange is ±0.4, so the
+        ctrl cost of a full-scale action is 0.1 · 17 · 0.4² = 0.272."""
+        env = Humanoid()
+        state, _ = env.reset(jax.random.PRNGKey(0))
+        a = jnp.ones(17)
+        state2, _, r, _, _ = jax.jit(env.step)(state, a)
+        from d4pg_tpu.envs.spatial import body_coms as bc
+
+        m = jnp.asarray(env.model.mass)
+        com_x = lambda q: float(jnp.sum(m * bc(env.model, q)[0][:, 0]) / jnp.sum(m))
+        x_vel = (com_x(state2.physics[0]) - com_x(state.physics[0])) / env.control_dt
+        expect = 1.25 * x_vel - 0.1 * 17 * 0.16 + 5.0
+        np.testing.assert_allclose(float(r), expect, rtol=1e-4)
+
+    def test_registry_and_preset(self):
+        from d4pg_tpu.config import ENV_PRESETS, TrainConfig, apply_env_preset
+        from d4pg_tpu.envs import make_env
+
+        env = make_env("humanoid", None)
+        assert isinstance(env, Humanoid)
+        cfg = apply_env_preset(TrainConfig(env="humanoid"))
+        assert cfg.agent.obs_dim == 45 and cfg.agent.action_dim == 17
+        assert ENV_PRESETS["humanoid"]["v_max"] == 1000.0

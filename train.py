@@ -117,6 +117,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lr-critic", type=float, default=1e-4)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--tree-backend", choices=["auto", "numpy", "native"], default="auto")
+    p.add_argument("--transfer-dtype", choices=["float32", "bfloat16"],
+                   default="float32",
+                   help="host->device batch wire format for observations; "
+                        "bfloat16 halves link bytes on wide-obs configs "
+                        "(docs/REMOTE_TPU.md 'fourth tax')")
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace of grad steps 10-60 here")
     p.add_argument("--max-rss-gb", type=float, default=0.0,
@@ -196,6 +201,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         prioritized=args.prioritized,
         n_step=args.n_step,
         tree_backend=args.tree_backend,
+        transfer_dtype=args.transfer_dtype,
         eval_interval=args.eval_interval,
         eval_episodes=args.eval_episodes,
         concurrent_eval=args.concurrent_eval,
