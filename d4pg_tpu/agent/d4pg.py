@@ -72,13 +72,26 @@ def support_of(config: D4PGConfig) -> CategoricalSupport:
 
 
 def create_train_state(config: D4PGConfig, key: jax.Array) -> TrainState:
-    """Initialize params, hard-copy targets (reference ``ddpg.py:57-64,92-94``)."""
+    """Initialize params, hard-copy targets (reference ``ddpg.py:57-64,92-94``).
+
+    With ``config.twin_critic`` the critic pytree carries a leading [2]
+    axis (two independent inits); Adam moments and Polyak targets stack
+    along with it, and :func:`train_step` vmaps the critic over it.
+    """
     actor, critic = build_networks(config)
     k_actor, k_critic, k_state = jax.random.split(key, 3)
     obs = jnp.zeros((1, config.obs_dim))
     action = jnp.zeros((1, config.action_dim))
     actor_params = actor.init(k_actor, obs)
-    critic_params = critic.init(k_critic, obs, action)
+    if config.twin_critic:
+        k_c1, k_c2 = jax.random.split(k_critic)
+        critic_params = jax.tree_util.tree_map(
+            lambda a, b: jnp.stack([a, b]),
+            critic.init(k_c1, obs, action),
+            critic.init(k_c2, obs, action),
+        )
+    else:
+        critic_params = critic.init(k_critic, obs, action)
     actor_opt, critic_opt = make_optimizers(config)
     return TrainState(
         step=jnp.zeros((), jnp.int32),
@@ -242,9 +255,22 @@ def train_step(
 
     # ---- target: y = Φ(r + γ_eff · Z_target(s', μ_target(s'))) ----
     next_action = actor.apply(state.target_actor_params, batch["next_obs"])
-    target_head = critic.apply(
-        state.target_critic_params, batch["next_obs"], next_action
-    )
+    if config.twin_critic:
+        # Clipped double-Q, distributionally: back up whichever target
+        # critic's WHOLE distribution has the smaller mean, per sample —
+        # the distributional analogue of TD3's min(Q1, Q2) (taking an
+        # elementwise min of probs would not be a distribution).
+        heads = jax.vmap(
+            lambda p: critic.apply(p, batch["next_obs"], next_action)
+        )(state.target_critic_params)
+        vals = jax.vmap(lambda h: _critic_value(config, support, h))(heads)
+        target_head = jnp.where(
+            (vals[0] <= vals[1])[..., None], heads[0], heads[1]
+        )
+    else:
+        target_head = critic.apply(
+            state.target_critic_params, batch["next_obs"], next_action
+        )
 
     if config.dist.kind == "categorical":
         target_probs = jax.nn.softmax(target_head, axis=-1)
@@ -319,6 +345,16 @@ def train_step(
     else:
         raise ValueError(config.dist.kind)
 
+    if config.twin_critic:
+        # Both critics regress the same clipped-min target; one vmap over
+        # the stacked params turns the single-critic loss into both. PER
+        # priority = mean of the two TD magnitudes (less noisy than either).
+        _single_loss_fn = critic_loss_fn
+
+        def critic_loss_fn(stacked_params):
+            losses, per_sample = jax.vmap(_single_loss_fn)(stacked_params)
+            return jnp.sum(losses), jnp.mean(per_sample, axis=0)
+
     (critic_loss, priorities), critic_grads = jax.value_and_grad(
         critic_loss_fn, has_aux=True
     )(state.critic_params)
@@ -329,9 +365,16 @@ def train_step(
     critic_params = optax.apply_updates(state.critic_params, critic_updates)
 
     # ---- actor: maximize E[Q(s, μ(s))] against the UPDATED critic ----
+    # (critic 0 under twin critics — TD3 convention)
+    actor_critic_params = (
+        jax.tree_util.tree_map(lambda x: x[0], critic_params)
+        if config.twin_critic
+        else critic_params
+    )
+
     def actor_loss_fn(actor_params):
         a = actor.apply(actor_params, batch["obs"])
-        head = critic.apply(critic_params, batch["obs"], a)
+        head = critic.apply(actor_critic_params, batch["obs"], a)
         return -jnp.mean(_critic_value(config, support, head))
 
     actor_loss, actor_grads = jax.value_and_grad(actor_loss_fn)(state.actor_params)
@@ -358,7 +401,10 @@ def train_step(
     )
     metrics = _sync(
         {
-            "critic_loss": critic_loss,
+            # Per-critic scale: the twin loss SUMS both critics (right for
+            # the gradient), but the logged metric must stay comparable to
+            # single-critic runs.
+            "critic_loss": critic_loss / 2 if config.twin_critic else critic_loss,
             "actor_loss": actor_loss,
             "priority_mean": jnp.mean(priorities),
             "q_mean": -actor_loss,

@@ -74,6 +74,16 @@ class D4PGConfig:
     # categorical projection implementation: "xla" (one-hot matmul) or
     # "pallas" (hand-written TPU kernel, d4pg_tpu/ops/pallas_projection.py)
     projection_backend: str = "xla"
+    # Twin critics with a clipped-min target (TD3's fix for the DDPG-family
+    # overestimation spiral, applied distributionally: the Bellman backup
+    # uses whichever target critic's distribution has the SMALLER expected
+    # value, per sample). Beyond-reference capability: measured necessary
+    # for Hopper/Walker2d-class tasks, where single-critic D4PG plateaus at
+    # ~2000 while the true policy ceiling is ~3000+ (runs/hopper_ondevice_*
+    # hyperparameter study, round 3). Critic params/targets/opt-state gain
+    # a leading [2] axis; the actor trains against critic 0 (TD3
+    # convention); PER priorities average the two critics' TD magnitudes.
+    twin_critic: bool = False
 
 
 class TrainState(struct.PyTreeNode):

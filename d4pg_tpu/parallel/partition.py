@@ -76,6 +76,14 @@ def match_partition_rules(rules: Sequence[tuple[str, P]], tree, mesh: Mesh | Non
             continue
         for pattern, spec in rules:
             if re.search(pattern, name):
+                # Rules are written against a param's own [in, out] (or
+                # [out]) shape. A leaf with ONE extra leading dim is a
+                # stacked variant of the same param (twin critics stack two
+                # critics on axis 0, agent/state.py): replicate the stack
+                # axis and apply the rule to the trailing dims — otherwise
+                # the specs would silently shard the wrong dimensions.
+                if len(spec) and np.ndim(leaf) == len(spec) + 1:
+                    spec = P(None, *spec)
                 specs.append(spec if _spec_fits(spec, shape, mesh) else P())
                 break
         else:

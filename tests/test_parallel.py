@@ -119,6 +119,48 @@ def test_match_partition_rules():
     assert specs["params"]["out"]["bias"] == P()
 
 
+def test_match_partition_rules_stacked_twin_axis():
+    """Twin-critic stacked leaves ([2, in, out] kernels, [2, out] biases):
+    the stack axis must replicate and the rule apply to the TRAILING dims —
+    positional application would shard the wrong dimensions silently."""
+    tree = {
+        "params": {
+            "hidden_0": {"kernel": np.zeros((2, 4, 8)), "bias": np.zeros((2, 8))},
+            "out": {"kernel": np.zeros((2, 8, 2)), "bias": np.zeros((2, 2))},
+        }
+    }
+    from d4pg_tpu.parallel import DEFAULT_RULES
+
+    specs = match_partition_rules(DEFAULT_RULES, tree)
+    assert specs["params"]["hidden_0"]["kernel"] == P(None, None, "tp")
+    assert specs["params"]["hidden_0"]["bias"] == P(None, "tp")
+    assert specs["params"]["out"]["kernel"] == P(None, "tp", None)
+
+
+def test_auto_parallel_twin_critic_tp():
+    """GSPMD dp×tp with twin critics: trains, stays finite, and the stacked
+    kernels shard their fan-out (not the twin axis) over tp."""
+    config = D4PGConfig(
+        obs_dim=3, action_dim=1, hidden_sizes=(64, 64), twin_critic=True
+    )
+    mesh = make_mesh(dp=4, tp=2)
+    state = shard_train_state(create_train_state(config, jax.random.PRNGKey(2)), mesh)
+    step = auto_parallel_train_step(config, mesh, donate=False)
+    rng = np.random.default_rng(2)
+    batch = _batch(rng)
+    out_state, metrics, priorities = step(state, shard_batch(batch, mesh))
+    assert np.isfinite(float(metrics["critic_loss"]))
+    assert priorities.shape == (batch["obs"].shape[0],)
+    shard_shapes = [
+        s.data.shape
+        for s in out_state.critic_params["params"]["hidden_0"][
+            "kernel"
+        ].addressable_shards
+    ]
+    # [2, in, 64] kernel: twin axis intact, 64 cols split over tp=2
+    assert all(s[0] == 2 and s[-1] == 32 for s in shard_shapes)
+
+
 def test_mesh_validation():
     with pytest.raises(ValueError):
         make_mesh(dp=16, tp=1)  # only 8 devices

@@ -77,6 +77,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dp", type=int, default=None,
                    help="data-parallel device count (None = single device)")
     p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--twin-critic", action="store_true",
+                   help="clipped double-Q (TD3-style) distributional twin "
+                        "critics; fixes the single-critic plateau on "
+                        "Hopper/Walker2d-class tasks")
     p.add_argument("--critic-head", choices=["categorical", "scalar", "mixture_gaussian"],
                    default="categorical")
     p.add_argument("--compute-dtype", choices=["float32", "bfloat16"], default="float32")
@@ -175,6 +179,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         prioritized=args.prioritized,
         compute_dtype=args.compute_dtype,
         projection_backend=args.projection,
+        twin_critic=args.twin_critic,
     )
     # run-identity log dir (reference main.py:59-66)
     log_dir = args.log_dir or (
