@@ -47,6 +47,24 @@ def _cached_model(asset: str) -> PlanarModel:
     return _MODEL_CACHE[asset]
 
 
+def _state_finite(q: jax.Array, qd: jax.Array) -> jax.Array:
+    """True while the physics state is finite and below blow-up speed."""
+    return (
+        jnp.all(jnp.isfinite(q))
+        & jnp.all(jnp.isfinite(qd))
+        & (jnp.max(jnp.abs(qd)) < 1e4)
+    )
+
+
+def _sanitize_reward(reward: jax.Array, finite: jax.Array) -> jax.Array:
+    """Zero the reward on a blown-up step and bound it elsewhere: a finite
+    but diverging state (|q̇| just under the guard) can put a ~1e4 forward
+    'velocity' into the reward, which the scalar critic has no projection
+    to clamp. Legit per-step rewards for these tasks are < ~10²."""
+    reward = jnp.nan_to_num(reward, nan=0.0, posinf=0.0, neginf=0.0)
+    return jnp.where(finite, jnp.clip(reward, -1e3, 1e3), 0.0)
+
+
 class _PlanarLocomotion:
     """Shared reset/step machinery for the gym-v5-style planar tasks.
 
@@ -109,19 +127,29 @@ class _PlanarLocomotion:
             self.model, q, qd, a, self.n_substeps, self.substep_dt
         )
         x_velocity = (q2[0] - q[0]) / self.control_dt
-        healthy = self._is_healthy(q2, qd2)
+        # Finiteness guard (shared by every penalty-contact env; see the
+        # Humanoid docstring for the incident): a blow-up must terminate —
+        # even for envs whose _is_healthy is constant-True, like cheetah —
+        # and must not write NaN or blow-up-scale finite rewards/obs into
+        # the replay ring.
+        finite = _state_finite(q2, qd2)
+        healthy = self._is_healthy(q2, qd2) & finite
         reward = (
             self.forward_reward_weight * x_velocity
             - self.ctrl_cost_weight * jnp.sum(jnp.square(a))
             + self.healthy_reward * healthy
         )
+        reward = _sanitize_reward(reward, finite)
         t = state.t + 1
         terminated = 1.0 - healthy.astype(jnp.float32)
         truncated = (t >= self.max_episode_steps).astype(jnp.float32) * (
             1.0 - terminated
         )
+        obs = jnp.nan_to_num(
+            self._obs(q2, qd2), nan=0.0, posinf=0.0, neginf=0.0
+        )
         new_state = EnvState(physics=(q2, qd2), t=t, key=state.key)
-        return new_state, self._obs(q2, qd2), reward, terminated, truncated
+        return new_state, obs, reward, terminated, truncated
 
 
 class HalfCheetah(_PlanarLocomotion):
@@ -282,16 +310,30 @@ class Humanoid:
             self.model, q, v, ctrl, self.n_substeps, self.substep_dt
         )
         x_velocity = (self._com_x(q2) - self._com_x(q)) / self.control_dt
-        healthy = (q2[2] > self.healthy_z[0]) & (q2[2] < self.healthy_z[1])
+        # Finiteness guard: a penalty-contact blow-up (rare — one in ~3M
+        # steps observed) must terminate the episode AND keep NaN or
+        # blow-up-scale values out of the replay ring — one poisoned
+        # transition NaNs the whole learner state within a few hundred
+        # grad steps. NaN z fails both comparisons, so the explicit
+        # isfinite/overspeed check is what turns "physics diverged" into a
+        # clean terminal reset.
+        finite = _state_finite(q2, v2)
+        healthy = (
+            (q2[2] > self.healthy_z[0]) & (q2[2] < self.healthy_z[1]) & finite
+        )
         reward = (
             self.forward_reward_weight * x_velocity
             - self.ctrl_cost_weight * jnp.sum(jnp.square(ctrl))
             + self.healthy_reward * healthy
         )
+        reward = _sanitize_reward(reward, finite)
         t = state.t + 1
         terminated = 1.0 - healthy.astype(jnp.float32)
         truncated = (t >= self.max_episode_steps).astype(jnp.float32) * (
             1.0 - terminated
         )
+        obs = jnp.nan_to_num(
+            self._obs(q2, v2), nan=0.0, posinf=0.0, neginf=0.0
+        )
         new_state = EnvState(physics=(q2, v2), t=t, key=state.key)
-        return new_state, self._obs(q2, v2), reward, terminated, truncated
+        return new_state, obs, reward, terminated, truncated

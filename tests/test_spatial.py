@@ -173,6 +173,43 @@ class TestHumanoidEnv:
         expect = 1.25 * x_vel - 0.1 * 17 * 0.16 + 5.0
         np.testing.assert_allclose(float(r), expect, rtol=1e-4)
 
+    def test_nan_state_terminates_and_obs_stays_finite(self):
+        """A physics blow-up (NaN/overspeed state) must read as a terminal
+        step with finite obs/reward — one poisoned transition in the replay
+        ring NaNs the learner within a few hundred grad steps (observed
+        once in ~3M humanoid steps before this guard)."""
+        env = Humanoid()
+        state, _ = env.reset(jax.random.PRNGKey(0))
+        q, v = state.physics
+        bad = state._replace(physics=(q.at[3].set(jnp.nan), v))
+        _, obs, r, term, _ = jax.jit(env.step)(bad, jnp.zeros(17))
+        assert float(term) == 1.0
+        assert bool(jnp.all(jnp.isfinite(obs))) and np.isfinite(float(r))
+        assert float(r) == 0.0  # blown-up step: no reward, not just finite
+        fast = state._replace(physics=(q, v.at[0].set(2e4)))
+        _, obs2, r2, term2, _ = jax.jit(env.step)(fast, jnp.zeros(17))
+        assert float(term2) == 1.0
+        assert bool(jnp.all(jnp.isfinite(obs2))) and float(r2) == 0.0
+        # sub-threshold divergence (finite=True, huge velocity): the reward
+        # is bounded so the scalar critic can't be poisoned by a 1e4 spike
+        near = state._replace(physics=(q, v.at[0].set(9e3)))
+        _, _, r3, _, _ = jax.jit(env.step)(near, jnp.zeros(17))
+        assert abs(float(r3)) <= 1e3
+
+    def test_planar_envs_share_the_guard(self):
+        """HalfCheetah's _is_healthy is constant-True — a NaN state must
+        still terminate (and emit sanitized obs/reward), or the poisoned
+        state survives auto-reset and NaNs the ring."""
+        from d4pg_tpu.envs.locomotion import HalfCheetah
+
+        env = HalfCheetah()
+        state, _ = env.reset(jax.random.PRNGKey(0))
+        q, qd = state.physics
+        bad = state._replace(physics=(q.at[0].set(jnp.nan), qd))
+        _, obs, r, term, _ = jax.jit(env.step)(bad, jnp.zeros(6))
+        assert float(term) == 1.0
+        assert bool(jnp.all(jnp.isfinite(obs))) and float(r) == 0.0
+
     def test_registry_and_preset(self):
         from d4pg_tpu.config import ENV_PRESETS, TrainConfig, apply_env_preset
         from d4pg_tpu.envs import make_env
