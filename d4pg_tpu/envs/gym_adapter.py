@@ -123,8 +123,21 @@ ENV_VALUE_RANGES = {
 }
 
 
+def make_host_env(name: str, max_episode_steps: Optional[int] = None):
+    """Build a HOST env (gymnasium id or dm_control ``dmc:``/``dmc_pixels:``)
+    without importing any JAX env module — the single dispatch point shared
+    by :func:`make_env` and the actor-pool workers (a second, divergent
+    prefix table in the worker is how dm_control ids crashed pool children
+    until round 3)."""
+    if name.startswith(("dmc:", "dmc_pixels:")):
+        from d4pg_tpu.envs.dmc_adapter import make_dmc
+
+        return make_dmc(name, max_episode_steps)
+    return GymAdapter(name, max_episode_steps)
+
+
 def make_env(name: str, max_episode_steps: Optional[int] = None):
-    """Build either a pure-JAX env (by short name) or a gymnasium adapter."""
+    """Build either a pure-JAX env (by short name) or a host adapter."""
     from d4pg_tpu.envs.pendulum import Pendulum
     from d4pg_tpu.envs.pixel_pendulum import PixelPendulum
     from d4pg_tpu.envs.pointmass_goal import PointMassGoal
@@ -135,10 +148,6 @@ def make_env(name: str, max_episode_steps: Optional[int] = None):
         return PixelPendulum()
     if name == "pointmass_goal":
         return PointMassGoal()
-    if name.startswith(("dmc:", "dmc_pixels:")):
-        from d4pg_tpu.envs.dmc_adapter import make_dmc
-
-        return make_dmc(name, max_episode_steps)
     if name in ("halfcheetah", "hopper", "walker2d", "humanoid"):
         from d4pg_tpu.envs import locomotion
 
@@ -149,4 +158,4 @@ def make_env(name: str, max_episode_steps: Optional[int] = None):
             "humanoid": locomotion.Humanoid,
         }[name]
         return cls(max_episode_steps=max_episode_steps)
-    return GymAdapter(name, max_episode_steps)
+    return make_host_env(name, max_episode_steps)

@@ -35,10 +35,12 @@ import numpy as np
 
 def _worker(conn, env_id: str, max_episode_steps: Optional[int], base_seed: int):
     # Child-process entry: owns exactly one host env. Import here so the
-    # parent's module import stays light and spawn'd children never touch JAX.
-    from d4pg_tpu.envs.gym_adapter import GymAdapter
+    # parent's module import stays light and spawn'd children never touch
+    # JAX. make_host_env is the shared JAX-free dispatcher (gymnasium ids +
+    # dm_control prefixes) — the pool is never built for pure-JAX envs.
+    from d4pg_tpu.envs.gym_adapter import make_host_env
 
-    env = GymAdapter(env_id, max_episode_steps)
+    env = make_host_env(env_id, max_episode_steps)
     episode = 0
 
     def goal_view():
