@@ -20,6 +20,7 @@ _EXPORTS = {
     "HalfCheetah": "d4pg_tpu.envs.locomotion",
     "Hopper": "d4pg_tpu.envs.locomotion",
     "Humanoid": "d4pg_tpu.envs.locomotion",
+    "Ant": "d4pg_tpu.envs.locomotion",
     "Walker2d": "d4pg_tpu.envs.locomotion",
     "Pendulum": "d4pg_tpu.envs.pendulum",
     "PixelPendulum": "d4pg_tpu.envs.pixel_pendulum",

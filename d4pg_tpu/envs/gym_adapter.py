@@ -148,7 +148,7 @@ def make_env(name: str, max_episode_steps: Optional[int] = None):
         return PixelPendulum()
     if name == "pointmass_goal":
         return PointMassGoal()
-    if name in ("halfcheetah", "hopper", "walker2d", "humanoid"):
+    if name in ("halfcheetah", "hopper", "walker2d", "humanoid", "ant"):
         from d4pg_tpu.envs import locomotion
 
         cls = {
@@ -156,6 +156,7 @@ def make_env(name: str, max_episode_steps: Optional[int] = None):
             "hopper": locomotion.Hopper,
             "walker2d": locomotion.Walker2d,
             "humanoid": locomotion.Humanoid,
+            "ant": locomotion.Ant,
         }[name]
         return cls(max_episode_steps=max_episode_steps)
     return make_host_env(name, max_episode_steps)

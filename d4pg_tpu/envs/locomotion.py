@@ -230,36 +230,27 @@ class Walker2d(_PlanarLocomotion):
         return (q[1] > 0.8) & (q[1] < 2.0) & (jnp.abs(q[2]) < 1.0)
 
 
-class Humanoid:
-    """Humanoid-v5 semantics, fully on device, over the 3D spatial engine
-    (:mod:`d4pg_tpu.envs.spatial`) — the reference's scale-out task
-    (``main.py:42,68``) without the host in the loop.
+class _SpatialLocomotion:
+    """Shared machinery for gym-v5-style 3D tasks over the spatial engine
+    (free-joint root: qpos[0:2] = planar position excluded from obs,
+    qpos[2] = height driving the healthy check). Subclasses set the class
+    attributes; reward = healthy·bonus + w·ẋ_com − c·Σctrl², with gym's
+    contact-cost term omitted (the penalty-contact model has no cfrc_ext
+    and the term is ≲0.1% of reward scale on these tasks)."""
 
-    State = (qpos[24], qvel[23]) with MuJoCo's free-joint conventions.
-    obs[45] = qpos[2:] (z + root quaternion + 17 hinge angles) ++ qvel —
-    the proprioceptive core of gym's 348-dim observation; the cinert /
-    cvel / cfrc_ext blocks are derived quantities the reference's MLPs
-    mostly ignore, and dropping them keeps the policy input dense and the
-    HBM-resident replay 7.7× smaller. Reward = 5.0·healthy +
-    1.25·ẋ_com − 0.1·Σctrl² (ctrl = 0.4·action per the MJCF ctrlrange;
-    gym's contact-cost term, weight 5e-7, is omitted — the penalty-contact
-    model has no cfrc_ext and the term is ~0.1% of reward scale).
-    Terminates when the torso z leaves (1.0, 2.0). Reset noise: uniform
-    ±0.01 on qpos and qvel (quaternion renormalized), as gym.
-    """
-
-    asset = "humanoid.xml"
-    observation_dim = 45
-    action_dim = 17
+    asset: str
+    observation_dim: int
+    action_dim: int
     max_episode_steps = 1000
-    mj_timestep = 0.003
-    frame_skip = 5
-    substeps_per_frame = 2   # 1.5 ms substeps keep the penalty feet stable
-    forward_reward_weight = 1.25
-    ctrl_cost_weight = 0.1
-    healthy_reward = 5.0
-    reset_noise_scale = 1e-2
-    healthy_z = (1.0, 2.0)
+    mj_timestep: float
+    frame_skip: int
+    substeps_per_frame: int
+    forward_reward_weight: float
+    ctrl_cost_weight: float
+    healthy_reward: float
+    reset_noise_scale: float
+    uniform_vel_noise = True  # humanoid: U(±s); ant: s·N(0,1)
+    healthy_z: tuple
     v_min = 0.0
     v_max = 1000.0
 
@@ -295,7 +286,10 @@ class Humanoid:
         )
         quat = q[3:7]
         q = q.at[3:7].set(quat / jnp.linalg.norm(quat))
-        v = jax.random.uniform(kv, (self.model.nv,), minval=-s, maxval=s)
+        if self.uniform_vel_noise:
+            v = jax.random.uniform(kv, (self.model.nv,), minval=-s, maxval=s)
+        else:
+            v = s * jax.random.normal(kv, (self.model.nv,))
         state = EnvState(physics=(q, v), t=jnp.zeros((), jnp.int32), key=key)
         return state, self._obs(q, v)
 
@@ -337,3 +331,57 @@ class Humanoid:
         )
         new_state = EnvState(physics=(q2, v2), t=t, key=state.key)
         return new_state, obs, reward, terminated, truncated
+
+
+class Humanoid(_SpatialLocomotion):
+    """Humanoid-v5 semantics, fully on device, over the 3D spatial engine
+    (:mod:`d4pg_tpu.envs.spatial`) — the reference's scale-out task
+    (``main.py:42,68``) without the host in the loop.
+
+    State = (qpos[24], qvel[23]) with MuJoCo's free-joint conventions.
+    obs[45] = qpos[2:] (z + root quaternion + 17 hinge angles) ++ qvel —
+    the proprioceptive core of gym's 348-dim observation; the cinert /
+    cvel / cfrc_ext blocks are derived quantities the reference's MLPs
+    mostly ignore, and dropping them keeps the policy input dense and the
+    HBM-resident replay 7.7× smaller. Reward = 5.0·healthy +
+    1.25·ẋ_com − 0.1·Σctrl² (ctrl = 0.4·action per the MJCF ctrlrange).
+    Terminates when the torso z leaves (1.0, 2.0). Reset noise: uniform
+    ±0.01 on qpos and qvel (quaternion renormalized), as gym.
+    """
+
+    asset = "humanoid.xml"
+    observation_dim = 45
+    action_dim = 17
+    mj_timestep = 0.003
+    frame_skip = 5
+    substeps_per_frame = 2   # 1.5 ms substeps keep the penalty feet stable
+    forward_reward_weight = 1.25
+    ctrl_cost_weight = 0.1
+    healthy_reward = 5.0
+    reset_noise_scale = 1e-2
+    uniform_vel_noise = True
+    healthy_z = (1.0, 2.0)
+
+
+class Ant(_SpatialLocomotion):
+    """Ant-v5 semantics over the same spatial engine — added as the
+    engine-generality witness: ant.xml (free joint + 8 hinges, sphere +
+    capsule geoms) extracts and matches MuJoCo's mass matrix/bias with NO
+    engine changes (tests/test_spatial.py). obs[27] = qpos[2:] ++ qvel
+    (proprioceptive core; gym's 78-dim cfrc_ext block omitted as for
+    Humanoid). Reward = 1.0·healthy + ẋ_com − 0.5·Σctrl²; terminates
+    when torso z leaves (0.2, 1.0). Reset noise: qpos uniform ±0.1,
+    qvel 0.1·N(0,1), as gym."""
+
+    asset = "ant.xml"
+    observation_dim = 27
+    action_dim = 8
+    mj_timestep = 0.01
+    frame_skip = 5
+    substeps_per_frame = 4   # 2.5 ms substeps (same stability point as cheetah)
+    forward_reward_weight = 1.0
+    ctrl_cost_weight = 0.5
+    healthy_reward = 1.0
+    reset_noise_scale = 0.1
+    uniform_vel_noise = False
+    healthy_z = (0.2, 1.0)

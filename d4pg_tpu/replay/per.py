@@ -54,9 +54,11 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         tree_backend: str = "auto",
         obs_dtype=np.float32,
         obs_scale=None,
+        decode_on_sample: bool = True,
     ):
         super().__init__(
-            capacity, obs_dim, action_dim, obs_dtype=obs_dtype, obs_scale=obs_scale
+            capacity, obs_dim, action_dim, obs_dtype=obs_dtype,
+            obs_scale=obs_scale, decode_on_sample=decode_on_sample,
         )
         assert alpha >= 0
         self.alpha = alpha

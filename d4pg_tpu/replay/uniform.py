@@ -40,7 +40,8 @@ class ReplayBuffer:
     """
 
     def __init__(self, capacity: int, obs_dim: int, action_dim: int,
-                 obs_dtype=np.float32, obs_scale: float | None = None):
+                 obs_dtype=np.float32, obs_scale: float | None = None,
+                 decode_on_sample: bool = True):
         """``obs_dtype=np.uint8`` quantizes observations to bytes in storage
         — 4× less host RAM for pixel envs, the standard pixel-replay layout.
         ``obs_scale`` is the fixed store-time multiplier, declared once at
@@ -53,6 +54,13 @@ class ReplayBuffer:
         self.capacity = int(capacity)
         self.obs_dtype = np.dtype(obs_dtype)
         self._quantized = self.obs_dtype == np.uint8
+        # decode_on_sample=False (quantized buffers only) keeps sampled obs
+        # rows in their stored uint8 form so the TRAINER can ship them over
+        # the host→device link at 1 byte/element and dequantize in-jit —
+        # the pixel-batch link wall is 4× the f32 one (302 MB per K=32
+        # batch-256 48×48×2 dispatch; measured ~3 grad-steps/s through the
+        # tunnel). Consumers must divide by 255 before use.
+        self._decode_on_sample = bool(decode_on_sample)
         self._obs_scale = float(obs_scale) if obs_scale is not None else 255.0
         if self._quantized and self._obs_scale != 255.0:
             # With scale≠255 the stored rows decode to [0,1] while acting/eval
@@ -122,12 +130,15 @@ class ReplayBuffer:
         )
 
     def gather(self, idx: np.ndarray) -> Mapping[str, np.ndarray]:
+        decode = (
+            self._decode_obs if self._decode_on_sample else (lambda x: x)
+        )
         with self._lock:
             return {
-                "obs": self._decode_obs(self.obs[idx]),
+                "obs": decode(self.obs[idx]),
                 "action": self.action[idx],
                 "reward": self.reward[idx],
-                "next_obs": self._decode_obs(self.next_obs[idx]),
+                "next_obs": decode(self.next_obs[idx]),
                 "discount": self.discount[idx],
             }
 

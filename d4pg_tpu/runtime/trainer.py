@@ -166,6 +166,16 @@ class Trainer:
         # (obs_scale 255.0) are accepted — byte-image envs must normalize at
         # the env boundary (ReplayBuffer raises otherwise).
         obs_scale = getattr(self.env, "obs_scale", None)
+        # uint8 wire format (transfer_dtype="uint8"): sampled pixel rows
+        # stay in their stored byte form and dequantize in-jit — 4× fewer
+        # link bytes than f32. Only meaningful for quantized (pixel)
+        # buffers.
+        if config.transfer_dtype == "uint8" and obs_dtype != np.uint8:
+            raise ValueError(
+                "--transfer-dtype uint8 requires a pixel env (uint8-"
+                "quantized replay); use bfloat16 for flat observations"
+            )
+        decode_on_sample = config.transfer_dtype != "uint8"
         if config.prioritized:
             self.buffer = PrioritizedReplayBuffer(
                 config.replay_capacity,
@@ -178,6 +188,7 @@ class Trainer:
                 tree_backend=config.tree_backend,
                 obs_dtype=obs_dtype,
                 obs_scale=obs_scale,
+                decode_on_sample=decode_on_sample,
             )
         else:
             self.buffer = ReplayBuffer(
@@ -186,6 +197,7 @@ class Trainer:
                 act_dim,
                 obs_dtype=obs_dtype,
                 obs_scale=obs_scale,
+                decode_on_sample=decode_on_sample,
             )
 
         # learner
@@ -211,26 +223,34 @@ class Trainer:
                     partial(fused_train_scan, agent_cfg), donate_argnums=(0,)
                 )
 
-        # bf16 observation staging (config.transfer_dtype): cast obs on the
-        # host to bf16 before the transfer and back to f32 as the first op
-        # of the jitted step — halves link bytes on wide-obs host configs
-        # (the Humanoid bandwidth wall, docs/REMOTE_TPU.md "fourth tax").
+        # Wire-format staging (config.transfer_dtype): observations cross
+        # the host→device link compact and are restored to f32 as the first
+        # op of the jitted step — the wide-obs/pixel link wall
+        # (docs/REMOTE_TPU.md "fourth tax"):
+        #   bfloat16 — 2 bytes/elem, any env (cast on the host);
+        #   uint8    — 1 byte/elem, pixel envs (the replay's stored bytes
+        #              go out as-is; dequantized ÷255 in-jit).
         self._xfer_dtype = None
-        if config.transfer_dtype == "bfloat16":
+        if config.transfer_dtype in ("bfloat16", "uint8"):
             if config.dp:
                 raise ValueError(
-                    "--transfer-dtype bfloat16 is a host-path link "
+                    "--transfer-dtype staging is a host-path link "
                     "optimization; combine it with --dp once needed"
                 )
-            import ml_dtypes
+            if config.transfer_dtype == "bfloat16":
+                import ml_dtypes
 
-            self._xfer_dtype = ml_dtypes.bfloat16
+                self._xfer_dtype = ml_dtypes.bfloat16
 
             def _restore_f32(batch):
-                return {
-                    k: v.astype(jnp.float32) if v.dtype == jnp.bfloat16 else v
-                    for k, v in batch.items()
-                }
+                out = {}
+                for k, v in batch.items():
+                    if v.dtype == jnp.bfloat16:
+                        v = v.astype(jnp.float32)
+                    elif v.dtype == jnp.uint8:
+                        v = v.astype(jnp.float32) / 255.0
+                    out[k] = v
+                return out
 
             inner_step = self._train_step
             self._train_step = jax.jit(
@@ -247,7 +267,8 @@ class Trainer:
                 )
         elif config.transfer_dtype != "float32":
             raise ValueError(
-                f"transfer_dtype must be float32|bfloat16, got {config.transfer_dtype!r}"
+                "transfer_dtype must be float32|bfloat16|uint8, "
+                f"got {config.transfer_dtype!r}"
             )
 
         self.metrics = MetricsLogger(config.log_dir)

@@ -72,6 +72,48 @@ def test_trainer_bf16_transfer_staging(tmp_path):
     assert np.isfinite(out["critic_loss"])
 
 
+def test_uint8_wire_transfer_staging(tmp_path):
+    """--transfer-dtype uint8 (pixel link rung): sampled rows leave the
+    quantized replay as raw bytes; flat envs are rejected."""
+    from d4pg_tpu.replay import ReplayBuffer
+
+    buf = ReplayBuffer(8, 4, 1, obs_dtype=np.uint8, obs_scale=255.0,
+                       decode_on_sample=False)
+    buf.add(np.full(4, 0.5), np.zeros(1), 0.0, np.full(4, 0.25), 0.99)
+    batch = buf.gather(np.zeros(1, np.int64))
+    assert batch["obs"].dtype == np.uint8 and batch["obs"][0, 0] == 128
+    # flat envs must reject the uint8 wire format with a clear error
+    with pytest.raises(ValueError, match="pixel env"):
+        Trainer(
+            config_from_args(
+                _tiny_args(tmp_path / "u8", ["--env", "Pendulum-v1",
+                                             "--transfer-dtype", "uint8"])
+            )
+        )
+
+
+@pytest.mark.slow
+def test_uint8_wire_trains_end_to_end(tmp_path):
+    """The in-jit dequantize (÷255) actually runs in a training step: a
+    pixel env with the uint8 wire format must train to finite losses (a
+    dropped ÷255 would feed [0,255] batches to an actor acting on [0,1]
+    env obs — a silent 255× train/act scale mismatch)."""
+    args = build_parser().parse_args(
+        [
+            "--env", "pixel_pendulum", "--transfer-dtype", "uint8",
+            "--total-steps", "4", "--warmup", "40", "--num-envs", "2",
+            "--eval-interval", "4", "--checkpoint-interval", "4",
+            "--bsize", "8", "--rmsize", "4096",
+            "--log-dir", str(tmp_path / "pix8"),
+        ]
+    )
+    t = Trainer(config_from_args(args))
+    assert not t.buffer._decode_on_sample  # raw bytes leave the buffer
+    out = t.train()
+    t.close()
+    assert np.isfinite(out["critic_loss"])
+
+
 @pytest.mark.slow
 def test_trainer_her_mode(tmp_path):
     args = build_parser().parse_args(

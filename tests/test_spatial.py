@@ -123,6 +123,58 @@ def test_passive_drop_stays_finite_and_settles(model):
     assert gaps.min() > -0.02
 
 
+def test_ant_dynamics_match_mujoco():
+    """Engine generality: ant.xml (free joint + 8 hinges, sphere + capsule
+    geoms) extracts and matches MuJoCo with NO engine changes."""
+    xml = _gym_xml("ant.xml")
+    model = extract_spatial_model(xml)
+    m = mujoco.MjModel.from_xml_path(xml)
+    d = mujoco.MjData(m)
+    rng = np.random.default_rng(4)
+    for _ in range(2):
+        q, v = _random_state(m, rng)
+        d.qpos[:], d.qvel[:] = q, v
+        mujoco.mj_forward(m, d)
+        M_mj = np.zeros((m.nv, m.nv))
+        mujoco.mj_fullM(m, d, M_mj)
+        np.testing.assert_allclose(
+            np.asarray(mass_matrix(model, jnp.asarray(q))), M_mj,
+            atol=2e-4, rtol=2e-4,
+        )
+        bias_mj = np.zeros(m.nv)
+        mujoco.mj_rne(m, d, 0, bias_mj)
+        np.testing.assert_allclose(
+            np.asarray(bias_force(model, jnp.asarray(q), jnp.asarray(v))),
+            bias_mj, atol=2e-2, rtol=1e-3,
+        )
+
+
+class TestAntEnv:
+    def test_shapes_reward_and_termination(self):
+        from d4pg_tpu.envs.locomotion import Ant
+
+        env = Ant()
+        state, obs = env.reset(jax.random.PRNGKey(0))
+        assert obs.shape == (27,)
+        step = jax.jit(env.step)
+        state2, obs2, r, term, _ = step(state, jnp.zeros(8))
+        # standing start, zero ctrl: reward ≈ healthy bonus (1.0)
+        assert float(term) == 0.0 and 0.0 < float(r) < 2.0
+        q, v = state.physics
+        fallen = state._replace(physics=(q.at[2].set(0.05), v))
+        _, _, _, term2, _ = step(fallen, jnp.zeros(8))
+        assert float(term2) == 1.0
+
+    def test_registry_and_preset(self):
+        from d4pg_tpu.config import ENV_PRESETS, TrainConfig, apply_env_preset
+        from d4pg_tpu.envs import make_env
+        from d4pg_tpu.envs.locomotion import Ant
+
+        assert isinstance(make_env("ant", None), Ant)
+        cfg = apply_env_preset(TrainConfig(env="ant"))
+        assert cfg.agent.obs_dim == 27 and cfg.agent.action_dim == 8
+
+
 class TestHumanoidEnv:
     def test_reset_and_step_shapes_jit_vmap(self):
         env = Humanoid()

@@ -121,10 +121,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lr-critic", type=float, default=1e-4)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--tree-backend", choices=["auto", "numpy", "native"], default="auto")
-    p.add_argument("--transfer-dtype", choices=["float32", "bfloat16"],
+    p.add_argument("--transfer-dtype", choices=["float32", "bfloat16", "uint8"],
                    default="float32",
                    help="host->device batch wire format for observations; "
-                        "bfloat16 halves link bytes on wide-obs configs "
+                        "bfloat16 halves link bytes on wide-obs configs, "
+                        "uint8 (pixel envs) ships the replay's stored bytes "
+                        "raw at 1/4 the f32 traffic "
                         "(docs/REMOTE_TPU.md 'fourth tax')")
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace of grad steps 10-60 here")
@@ -265,6 +267,12 @@ def main(argv=None) -> None:
         )
     print(f"config: {cfg}")
     if args.on_device:
+        if args.transfer_dtype != "float32":
+            raise SystemExit(
+                "--transfer-dtype is a HOST-path link optimization; "
+                "--on-device envs never transfer batches (the flag would "
+                "be silently ignored)"
+            )
         from d4pg_tpu.runtime.on_device import run_on_device
 
         final = run_on_device(cfg)
