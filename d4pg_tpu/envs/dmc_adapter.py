@@ -13,6 +13,14 @@ current + previous frame, so a single observation is Markovian in velocity
 uint8-quantized replay. Rendering uses MuJoCo's EGL backend (set before
 dm_control import; OSMesa is broken in this image — verified).
 
+WARNING (measured, round 3): on this image's GL stack, SEVERAL pixel
+adapters rendering concurrently from separate processes DEADLOCK inside
+``eglMakeCurrent`` (dm_control's render executor never returns; observed
+with 4 collect + 2 eval pool workers — 6/8 wedged, faulthandler dumps in
+the round-3 log). Run ``dmc_pixels:`` training with ``--num-envs 1`` so
+collection and eval each own ONE context inside the trainer process;
+state-feature ``dmc:`` envs never render and pool fine.
+
 dm_control tasks never terminate; episodes end by time limit only, reported
 as truncation (matching gym semantics where TimeLimit truncates).
 """
@@ -135,7 +143,17 @@ class DMControlAdapter:
         return self._obs(ts), reward, terminated, truncated, {}
 
     def close(self):
-        self.env.close()
+        # Shutdown-only guard: dm_control's EGL renderer binds its GL
+        # context to the first thread that rendered (here, the concurrent
+        # evaluator thread); closing from another thread raises
+        # EGL_BAD_ACCESS out of eglMakeCurrent. The process is exiting —
+        # leak the context rather than crash the shutdown path.
+        try:
+            self.env.close()
+        except Exception as e:
+            # Leak, but SAY so, in case a mid-run close swallows a real
+            # failure rather than the cross-thread EGL_BAD_ACCESS case.
+            print(f"[dmc_adapter] close() swallowed {type(e).__name__}: {e}")
 
 
 def make_dmc(name: str, max_episode_steps: Optional[int] = None):

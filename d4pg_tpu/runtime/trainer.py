@@ -459,6 +459,17 @@ class Trainer:
     def _setup_host_collect(self):
         cfg = self.config
         if cfg.num_envs > 1 or cfg.async_collect:
+            if getattr(self.env, "pixels", False):
+                # Pool workers each open an EGL context and render every
+                # step; concurrent cross-process EGL rendering DEADLOCKS on
+                # this image's GL stack (measured — envs/dmc_adapter.py
+                # module docstring). Refuse loudly instead of hanging
+                # silently mid-run.
+                raise ValueError(
+                    "pixel dm_control envs cannot use pooled/async "
+                    "collection (concurrent EGL contexts deadlock): run "
+                    "with --num-envs 1 and without --async-collect"
+                )
             self._setup_pool_collect()
             return
         self.writers = [NStepWriter(self.buffer, cfg.n_step, cfg.agent.gamma)]
@@ -1403,7 +1414,15 @@ class Trainer:
         evaluator; the single-env path then steps a DEDICATED eval env
         (never ``self.env``, which the learner thread is collecting on)."""
         cfg = self.config
-        if self.has_pool and cfg.eval_episodes > 1:
+        # Pixel dm_control envs never eval through a pool: each worker is
+        # another EGL-context process, and concurrent EGL rendering across
+        # processes deadlocks on this image's GL stack (measured —
+        # envs/dmc_adapter.py module docstring).
+        if (
+            self.has_pool
+            and cfg.eval_episodes > 1
+            and not getattr(self.env, "pixels", False)
+        ):
             return self._pool_eval(eval_params)
         if eval_params is None:
             env = self.env

@@ -155,3 +155,21 @@ def test_pixel_mode_trains_with_conv_encoder(tmp_path):
         env=_clean_cpu_env(),
     )
     assert "DMC_PIXEL_TRAIN_OK" in p.stdout, p.stdout + p.stderr
+
+
+def test_pixel_env_refuses_pooled_collection(tmp_path):
+    """Concurrent cross-process EGL rendering deadlocks on this image's GL
+    stack (module docstring) — the trainer must refuse pooled/async
+    collection for pixel dm_control envs instead of hanging silently."""
+    from d4pg_tpu.runtime.trainer import Trainer
+    from train import build_parser, config_from_args
+
+    args = build_parser().parse_args(
+        [
+            "--env", "dmc_pixels:cartpole:swingup", "--num-envs", "4",
+            "--total-steps", "4", "--bsize", "8",
+            "--log-dir", str(tmp_path / "px"),
+        ]
+    )
+    with pytest.raises(ValueError, match="EGL"):
+        Trainer(config_from_args(args))
