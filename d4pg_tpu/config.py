@@ -25,6 +25,11 @@ class TrainConfig:
     # environment
     env: str = "pendulum"
     max_episode_steps: Optional[int] = None  # None → env default
+    # dm_control only (DrQ convention): each agent step applies the action
+    # for N control steps, summing rewards; pixel obs render once per agent
+    # step. Divides frames-to-solve by ~N for pixel tasks (repeat 4 is the
+    # published setting for cartpole swingup).
+    action_repeat: int = 1
     num_envs: int = 16                 # vectorized on-device actors
     her: bool = False                  # hindsight relabeling (goal envs)
     her_k: int = 4

@@ -58,10 +58,21 @@ class DMControlAdapter:
         pixels: bool = False,
         size: int = 48,
         camera_id: int = 0,
+        action_repeat: int = 1,
     ):
         suite = _load_suite()
         self.env = suite.load(domain, task)
         self._dt = (domain, task)
+        if action_repeat < 1:
+            raise ValueError(f"action_repeat must be >= 1, got {action_repeat}")
+        # DrQ convention (Kostrikov et al. 2020, §4 implementation details):
+        # one agent step applies the action for `action_repeat` control
+        # steps, summing the rewards; rendering happens once per AGENT step,
+        # so in pixel mode the 2-frame stack spans the repeat interval —
+        # exactly the velocity baseline published DrQ uses (repeat 4 for
+        # cartpole swingup). Episode returns keep their [0, horizon] scale
+        # because rewards are summed, not sampled.
+        self.action_repeat = action_repeat
         # Categorical support hint for _reconcile_config (no static preset
         # can enumerate every suite task; [0, horizon] bounds them all).
         self.v_min, self.v_max = DMC_VALUE_RANGE
@@ -79,7 +90,11 @@ class DMControlAdapter:
             ))
         except (AttributeError, TypeError, OverflowError):
             native_limit = 1000  # suite default horizon
-        self.max_episode_steps = max_episode_steps or native_limit
+        # Horizon counts AGENT steps: repeat divides it so an episode still
+        # covers the same simulated time (1000 frames @ repeat 4 → 250).
+        self.max_episode_steps = max_episode_steps or max(
+            1, native_limit // action_repeat
+        )
         spec = self.env.action_spec()
         self._normalize = NormalizeAction(spec.minimum, spec.maximum)
         self.action_dim = int(np.prod(spec.shape))
@@ -129,9 +144,14 @@ class DMControlAdapter:
         return self._dt
 
     def step(self, action: np.ndarray):
-        ts = self.env.step(self._normalize.to_env(np.asarray(action)))
+        env_action = self._normalize.to_env(np.asarray(action))
+        reward = 0.0
+        for _ in range(self.action_repeat):
+            ts = self.env.step(env_action)
+            reward += float(ts.reward or 0.0)
+            if ts.last():
+                break  # don't step past an episode boundary mid-repeat
         self._t += 1
-        reward = float(ts.reward or 0.0)
         # Standard suite tasks end by time limit only, but dm_control marks
         # a TRUE termination (early task end, physics divergence) with
         # ts.last() and discount == 0 — bootstrapping through that state
@@ -151,12 +171,26 @@ class DMControlAdapter:
         try:
             self.env.close()
         except Exception as e:
-            # Leak, but SAY so, in case a mid-run close swallows a real
-            # failure rather than the cross-thread EGL_BAD_ACCESS case.
-            print(f"[dmc_adapter] close() swallowed {type(e).__name__}: {e}")
+            # Only the known leak paths are swallowed: the cross-thread
+            # EGL_BAD_ACCESS case (message carries "EGL"/"egl") and closes
+            # during interpreter shutdown. Anything else is a genuine close
+            # failure and propagates (ADVICE round-3).
+            import sys
+
+            if "egl" in str(e).lower() or sys.is_finalizing():
+                print(
+                    f"[dmc_adapter] close() leaked GL context "
+                    f"({type(e).__name__}: {e})"
+                )
+            else:
+                raise
 
 
-def make_dmc(name: str, max_episode_steps: Optional[int] = None):
+def make_dmc(
+    name: str,
+    max_episode_steps: Optional[int] = None,
+    action_repeat: int = 1,
+):
     """Parse ``dmc:domain:task`` / ``dmc_pixels:domain:task`` into an adapter."""
     parts = name.split(":", 2)
     if len(parts) != 3 or not all(parts):
@@ -170,4 +204,5 @@ def make_dmc(name: str, max_episode_steps: Optional[int] = None):
         task,
         max_episode_steps=max_episode_steps,
         pixels=(prefix == "dmc_pixels"),
+        action_repeat=action_repeat,
     )

@@ -123,7 +123,23 @@ ENV_VALUE_RANGES = {
 }
 
 
-def make_host_env(name: str, max_episode_steps: Optional[int] = None):
+def _reject_action_repeat(name: str, action_repeat: int) -> None:
+    # Gym MuJoCo envs already bake frame_skip into their control dt (and the
+    # pure-JAX locomotion envs into their substep counts); the presets'
+    # value ranges assume per-step reward scale. Repeat is a dm_control
+    # (DrQ-convention) knob only until someone needs more.
+    if action_repeat != 1:
+        raise ValueError(
+            f"--action-repeat is only supported for dmc:/dmc_pixels: envs "
+            f"(got {name!r})"
+        )
+
+
+def make_host_env(
+    name: str,
+    max_episode_steps: Optional[int] = None,
+    action_repeat: int = 1,
+):
     """Build a HOST env (gymnasium id or dm_control ``dmc:``/``dmc_pixels:``)
     without importing any JAX env module — the single dispatch point shared
     by :func:`make_env` and the actor-pool workers (a second, divergent
@@ -132,16 +148,24 @@ def make_host_env(name: str, max_episode_steps: Optional[int] = None):
     if name.startswith(("dmc:", "dmc_pixels:")):
         from d4pg_tpu.envs.dmc_adapter import make_dmc
 
-        return make_dmc(name, max_episode_steps)
+        return make_dmc(name, max_episode_steps, action_repeat=action_repeat)
+    _reject_action_repeat(name, action_repeat)
     return GymAdapter(name, max_episode_steps)
 
 
-def make_env(name: str, max_episode_steps: Optional[int] = None):
+def make_env(
+    name: str,
+    max_episode_steps: Optional[int] = None,
+    action_repeat: int = 1,
+):
     """Build either a pure-JAX env (by short name) or a host adapter."""
     from d4pg_tpu.envs.pendulum import Pendulum
     from d4pg_tpu.envs.pixel_pendulum import PixelPendulum
     from d4pg_tpu.envs.pointmass_goal import PointMassGoal
 
+    if not name.startswith(("dmc:", "dmc_pixels:")):
+        # pure-JAX branches return before reaching make_host_env's guard
+        _reject_action_repeat(name, action_repeat)
     if name == "pendulum":
         return Pendulum()
     if name == "pixel_pendulum":
@@ -159,4 +183,4 @@ def make_env(name: str, max_episode_steps: Optional[int] = None):
             "ant": locomotion.Ant,
         }[name]
         return cls(max_episode_steps=max_episode_steps)
-    return make_host_env(name, max_episode_steps)
+    return make_host_env(name, max_episode_steps, action_repeat=action_repeat)

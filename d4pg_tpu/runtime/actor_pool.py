@@ -33,14 +33,20 @@ from typing import Optional
 import numpy as np
 
 
-def _worker(conn, env_id: str, max_episode_steps: Optional[int], base_seed: int):
+def _worker(
+    conn,
+    env_id: str,
+    max_episode_steps: Optional[int],
+    base_seed: int,
+    action_repeat: int = 1,
+):
     # Child-process entry: owns exactly one host env. Import here so the
     # parent's module import stays light and spawn'd children never touch
     # JAX. make_host_env is the shared JAX-free dispatcher (gymnasium ids +
     # dm_control prefixes) — the pool is never built for pure-JAX envs.
     from d4pg_tpu.envs.gym_adapter import make_host_env
 
-    env = make_host_env(env_id, max_episode_steps)
+    env = make_host_env(env_id, max_episode_steps, action_repeat=action_repeat)
     episode = 0
 
     def goal_view():
@@ -97,6 +103,7 @@ class HostActorPool:
         max_episode_steps: Optional[int] = None,
         seed: int = 0,
         start_method: str = "spawn",
+        action_repeat: int = 1,
     ):
         assert num_actors >= 1
         self.num_actors = num_actors
@@ -109,7 +116,13 @@ class HostActorPool:
             # each worker's env independently at fork).
             p = ctx.Process(
                 target=_worker,
-                args=(child, env_id, max_episode_steps, seed + 1_000_003 * (i + 1)),
+                args=(
+                    child,
+                    env_id,
+                    max_episode_steps,
+                    seed + 1_000_003 * (i + 1),
+                    action_repeat,
+                ),
                 daemon=True,
             )
             p.start()

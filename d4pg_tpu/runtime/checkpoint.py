@@ -34,6 +34,11 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def delete(self, step: int) -> None:
+        """Remove one saved step (keep-best re-saves at a colliding step
+        after a resume — Orbax raises on save-over-existing)."""
+        self._mgr.delete(step)
+
     def restore(self, template: TrainState, step: Optional[int] = None) -> TrainState:
         """Restore into the structure of ``template`` (a freshly-created
         state provides dtypes/shapes)."""
@@ -67,6 +72,35 @@ def save_trainer_meta(log_dir: str, env_steps: int, ewma_return) -> None:
     with open(tmp, "w") as f:
         json.dump({"env_steps": env_steps, "ewma_return": ewma_return}, f)
     os.replace(tmp, path)
+
+
+def best_eval_path(log_dir: str) -> str:
+    return os.path.join(log_dir, "best_eval.json")
+
+
+def save_best_eval(log_dir: str, step: int, score: float, env_steps: int) -> None:
+    """Atomically record the keep-best score. Shared by the host Trainer and
+    the on-device driver so their best_eval.json files stay mutually
+    readable. Callers must persist the params FIRST (write-ordering: a crash
+    can never leave the JSON claiming params that were never saved)."""
+    path = best_eval_path(log_dir)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(
+            {"step": step, "eval_return_mean": score, "env_steps": env_steps}, f
+        )
+    os.replace(tmp, path)
+
+
+def invalidate_best_eval(log_dir: str) -> None:
+    """Remove the keep-best attestation before mutating the params it points
+    at (delete-then-resave of a colliding Orbax step): if a crash lands
+    mid-replacement, the consistent state is 'no best recorded', never
+    'JSON attests params that do not exist'."""
+    try:
+        os.remove(best_eval_path(log_dir))
+    except FileNotFoundError:
+        pass
 
 
 def load_trainer_meta(log_dir: str) -> dict:

@@ -368,14 +368,16 @@ def run_on_device(config) -> dict:
     from d4pg_tpu.replay import noise_scale_schedule
     from d4pg_tpu.runtime.checkpoint import (
         CheckpointManager,
+        invalidate_best_eval,
         load_trainer_meta,
+        save_best_eval,
         save_trainer_meta,
     )
     from d4pg_tpu.runtime.evaluator import evaluate
     from d4pg_tpu.runtime.metrics import MetricsLogger, interval_crossed
     from d4pg_tpu.runtime.trainer import _reconcile_config, _rss_gb
 
-    env = make_env(config.env, config.max_episode_steps)
+    env = make_env(config.env, config.max_episode_steps, config.action_repeat)
     if hasattr(env, "last_goal_obs"):
         raise ValueError(
             "--on-device needs a pure-JAX env (pendulum, pixel_pendulum, "
@@ -496,24 +498,29 @@ def run_on_device(config) -> dict:
             )
             if best_eval is None or scalars["eval_return_mean"] > best_eval:
                 best_eval = scalars["eval_return_mean"]
-                # A resumed eval-only leg can re-cross the same grad_steps a
-                # previous leg already saved at; Orbax raises on an existing
-                # step, so only the score/JSON update happens in that case.
-                if best_ckpt.latest_step() != grad_steps:
-                    best_ckpt.save(grad_steps, carry[0])
+                # A resumed leg can re-cross the same grad_steps a previous
+                # leg already saved at (Orbax raises on an existing step) —
+                # with DIFFERENT params, so the old save must be deleted and
+                # replaced: skipping the save while updating the JSON left
+                # best_eval.json attesting a score the persisted params
+                # never achieved (ADVICE round-3). The JSON is invalidated
+                # BEFORE the delete: a crash inside the replacement window
+                # then reads as 'no best recorded', never as an attestation
+                # of params that no longer exist. prev > grad_steps needs
+                # the same treatment (a leg resumed from an OLDER main
+                # checkpoint): Orbax retention keeps the highest step, so
+                # saving a lower one would be garbage-collected immediately
+                # while the JSON attested it.
+                prev = best_ckpt.latest_step()
+                if prev is not None and prev >= grad_steps:
+                    invalidate_best_eval(config.log_dir)
+                    best_ckpt.delete(prev)
+                best_ckpt.save(grad_steps, carry[0])
                 # Orbax saves are async: wait before recording the score so
                 # a crash can never leave best_eval.json claiming params
-                # that were never persisted (same ordering as _save below);
-                # tmp+replace so a mid-write kill can't corrupt the JSON
-                # and block the next resume.
+                # that were never persisted (same ordering as _save below).
                 best_ckpt.wait()
-                tmp = f"{config.log_dir}/best_eval.json.tmp"
-                with open(tmp, "w") as f:
-                    json.dump(
-                        {"step": grad_steps, "eval_return_mean": best_eval,
-                         "env_steps": env_steps}, f,
-                    )
-                os.replace(tmp, f"{config.log_dir}/best_eval.json")
+                save_best_eval(config.log_dir, grad_steps, best_eval, env_steps)
             scalars["best_eval_return"] = best_eval
             dt = time.monotonic() - t0
             scalars.update(

@@ -21,6 +21,7 @@ device mesh.
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 import queue
 import threading
@@ -51,7 +52,9 @@ from d4pg_tpu.replay import (
 )
 from d4pg_tpu.runtime.checkpoint import (
     CheckpointManager,
+    best_eval_path,
     load_trainer_meta,
+    save_best_eval,
     save_trainer_meta,
 )
 from d4pg_tpu.runtime.evaluator import evaluate
@@ -88,6 +91,19 @@ def _rss_gb() -> float:
     if sys.platform == "darwin":  # bytes there, KB on Linux/BSD
         return peak / 1024**3
     return peak / 1024 / 1024
+
+
+def load_best_actor(log_dir: str, template):
+    """Restore ``checkpoints/best_actor.npz`` (written by the host trainer's
+    keep-best path) into the structure of ``template`` — a freshly-built
+    actor params pytree with the run's net shapes. Leaves were saved in
+    tree_flatten order under zero-padded keys, so sorted(files) restores
+    that order exactly."""
+    path = os.path.join(log_dir, "checkpoints", "best_actor.npz")
+    with np.load(path) as z:
+        leaves = [z[k] for k in sorted(z.files)]
+    treedef = jax.tree_util.tree_structure(template)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
 def _env_dims(env) -> tuple[int, int]:
@@ -150,7 +166,9 @@ def _reconcile_config(config: TrainConfig, env) -> TrainConfig:
 
 class Trainer:
     def __init__(self, config: TrainConfig):
-        self.env = make_env(config.env, config.max_episode_steps)
+        self.env = make_env(
+            config.env, config.max_episode_steps, config.action_repeat
+        )
         if hasattr(self.env, "max_episode_steps") is False and config.max_episode_steps:
             self.env.max_episode_steps = config.max_episode_steps
         config = _reconcile_config(config, self.env)
@@ -276,6 +294,14 @@ class Trainer:
         self.grad_steps = 0
         self.env_steps = 0
         self.ewma_return: Optional[float] = None
+        # Keep-best: highest eval_return_mean seen so far; the scored actor
+        # params are persisted to checkpoints/best_actor.npz so a run that
+        # later collapses (round-2 Walker2d) still ships its champion.
+        # Survives --resume via best_eval.json (restored below, only when a
+        # trainer checkpoint actually restores — a leftover best_eval.json
+        # from an --on-device run in the same dir must not preload a score
+        # no best_actor.npz backs).
+        self._best_eval: Optional[float] = None
         # Set when the RSS watchdog ends a run early (checkpointed); lets
         # callers distinguish preemption from completion (train.py exits 75)
         self.preempted = False
@@ -288,6 +314,15 @@ class Trainer:
             # resumed run would re-explore at full scale
             self.env_steps = int(m.get("env_steps", 0))
             self.ewma_return = m.get("ewma_return")
+            best_json = best_eval_path(config.log_dir)
+            if os.path.exists(
+                os.path.join(config.log_dir, "checkpoints", "best_actor.npz")
+            ) and os.path.exists(best_json):
+                try:
+                    with open(best_json) as f:
+                        self._best_eval = float(json.load(f)["eval_return_mean"])
+                except (OSError, ValueError, KeyError):
+                    pass  # corrupt best file: start fresh, never crash
             snap = self._replay_snapshot_path()
             if config.snapshot_replay and os.path.exists(snap):
                 n = self.buffer.restore(snap)
@@ -525,6 +560,7 @@ class Trainer:
             cfg.max_episode_steps,
             seed=cfg.seed,
             start_method=cfg.pool_start_method,
+            action_repeat=cfg.action_repeat,
         )
         self.has_pool = True
         self.writers = [
@@ -1238,6 +1274,7 @@ class Trainer:
                 cfg.max_episode_steps,
                 seed=cfg.seed + 977_777,
                 start_method=cfg.pool_start_method,
+                action_repeat=cfg.action_repeat,
             )
         obs = self._eval_pool.reset_all()
         alive = np.ones(n, bool)
@@ -1314,7 +1351,9 @@ class Trainer:
                     continue
                 params, step, scalars = req
                 ev = self._host_eval(eval_params=params)
-                self._apply_eval(step, scalars, ev)
+                # params is the REAL copy scored by this eval — exactly what
+                # keep-best must persist (the live params have moved on)
+                self._apply_eval(step, scalars, ev, params=params)
                 with self._eval_req_lock:
                     if self._eval_req is None:
                         self._eval_idle.set()
@@ -1323,7 +1362,22 @@ class Trainer:
             self._eval_idle.set()  # never leave the end-of-train drain hanging
             raise
 
-    def _apply_eval(self, step: int, scalars: dict, ev: dict) -> None:
+    def _save_best(self, step: int, score: float, params) -> None:
+        """Persist the champion actor params + score. Write-ordering: params
+        first, JSON second — a crash can never leave best_eval.json claiming
+        params that were never persisted (same discipline as on_device)."""
+        ckpt_dir = os.path.join(self.config.log_dir, "checkpoints")
+        os.makedirs(ckpt_dir, exist_ok=True)
+        leaves = jax.tree_util.tree_leaves(jax.device_get(params))
+        tmp = os.path.join(ckpt_dir, "best_actor.npz.tmp")
+        with open(tmp, "wb") as f:
+            np.savez(
+                f, **{f"leaf_{i:04d}": np.asarray(l) for i, l in enumerate(leaves)}
+            )
+        os.replace(tmp, os.path.join(ckpt_dir, "best_actor.npz"))
+        save_best_eval(self.config.log_dir, step, score, self.env_steps)
+
+    def _apply_eval(self, step: int, scalars: dict, ev: dict, params=None) -> None:
         """EWMA + log + print for one completed eval, at the step it was
         REQUESTED (the params it scored). Runs on the evaluator thread in
         concurrent mode (requests are processed one at a time in request
@@ -1338,8 +1392,15 @@ class Trainer:
                 (1 - cfg.ewma_alpha) * self.ewma_return
                 + cfg.ewma_alpha * ev["eval_return_mean"]
             )
+        if params is not None and (
+            self._best_eval is None or ev["eval_return_mean"] > self._best_eval
+        ):
+            self._best_eval = ev["eval_return_mean"]
+            self._save_best(step, self._best_eval, params)
         scalars = dict(scalars)
         scalars.update(ev)
+        if self._best_eval is not None:
+            scalars["best_eval_return"] = self._best_eval
         scalars["avg_test_reward_ewma"] = self.ewma_return
         self.metrics.log(step, scalars)
         print(
@@ -1429,7 +1490,9 @@ class Trainer:
             eval_params = self._eval_params()
         else:
             if self._eval_env is None:
-                self._eval_env = make_env(cfg.env, cfg.max_episode_steps)
+                self._eval_env = make_env(
+                    cfg.env, cfg.max_episode_steps, cfg.action_repeat
+                )
             env = self._eval_env
         rets, succ = [], 0
         any_reported = False
@@ -1490,8 +1553,9 @@ class Trainer:
         # Same EWMA/log/print path as the concurrent evaluator, inline.
         # Logs against the GLOBAL step (survives --resume legs): per-leg
         # steps made multi-leg metrics.jsonl non-monotone, which zigzags
-        # any step-keyed plot.
-        self._apply_eval(self.grad_steps, scalars, ev)
+        # any step-keyed plot. Inline eval scored the LIVE params (learner
+        # thread, no dispatch in flight) so keep-best saves those.
+        self._apply_eval(self.grad_steps, scalars, ev, params=self.state.actor_params)
         return self._last_eval_row
 
     def close(self):

@@ -58,6 +58,44 @@ def test_state_mode_truncates_at_limit(state_env):
     assert trunc
 
 
+def test_action_repeat_sums_rewards_and_divides_horizon():
+    """Repeat semantics (DrQ convention): one agent step == N control steps
+    with the SAME action and the rewards summed; the agent-step horizon is
+    the native horizon divided by N, so episode returns keep their
+    [0, horizon] scale."""
+    from d4pg_tpu.envs import make_env
+
+    single = make_env("dmc:cartpole:swingup")
+    repeat = make_env("dmc:cartpole:swingup", action_repeat=4)
+    assert single.max_episode_steps == 1000  # suite native horizon
+    assert repeat.max_episode_steps == 250
+
+    single.reset(seed=7)
+    repeat.reset(seed=7)
+    actions = [np.array([a], np.float32) for a in (0.3, -0.8, 1.0)]
+    for a in actions:
+        r_sum = 0.0
+        for _ in range(4):
+            o1, r, _, _, _ = single.step(a)
+            r_sum += r
+        o4, r4, term, trunc, _ = repeat.step(a)
+        # identical physics trajectory → identical summed reward and obs
+        np.testing.assert_allclose(o4, o1, rtol=1e-6, atol=1e-6)
+        assert abs(r4 - r_sum) < 1e-9
+        assert not term and not trunc
+
+
+def test_action_repeat_rejected_for_non_dmc():
+    from d4pg_tpu.envs import make_env
+
+    with pytest.raises(ValueError, match="action-repeat"):
+        make_env("pendulum", action_repeat=2)
+    from d4pg_tpu.envs.gym_adapter import make_host_env
+
+    with pytest.raises(ValueError, match="action-repeat"):
+        make_host_env("Pendulum-v1", action_repeat=2)
+
+
 @pytest.mark.slow
 def test_pixel_mode_convention():
     """Pixels follow the repo convention: flattened [H, W, 2] floats in

@@ -43,6 +43,27 @@ def test_trainer_sync_mode_end_to_end(tmp_path):
     assert "grad_steps_per_sec" in rec
 
 
+def test_trainer_keep_best(tmp_path):
+    """Every eval crossing that beats the best-so-far persists the SCORED
+    actor params (best_actor.npz) + best_eval.json, and load_best_actor
+    restores them into a template pytree exactly."""
+    from d4pg_tpu.runtime.trainer import load_best_actor
+
+    t = Trainer(config_from_args(_tiny_args(tmp_path / "kb")))
+    t.train()
+    best_params = jax.device_get(t.state.actor_params)
+    t.close()
+    log = tmp_path / "kb"
+    meta = json.loads((log / "best_eval.json").read_text())
+    assert meta["step"] == 6 and np.isfinite(meta["eval_return_mean"])
+    restored = load_best_actor(str(log), best_params)
+    # single eval crossing at the final step → best == final params
+    jax.tree.map(np.testing.assert_allclose, restored, best_params)
+    # best_eval_return rides the metrics rows
+    rec = json.loads(open(log / "metrics.jsonl").read().splitlines()[-1])
+    assert rec["best_eval_return"] == meta["eval_return_mean"]
+
+
 @pytest.mark.slow
 def test_trainer_uniform_replay_mode(tmp_path):
     t = Trainer(config_from_args(_tiny_args(tmp_path / "u", ["--no-p-replay"])))

@@ -37,6 +37,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--bsize", "--batch-size", dest="batch_size", type=int, default=256)
     p.add_argument("--gamma", type=float, default=0.99)
     p.add_argument("--max-steps", dest="max_episode_steps", type=int, default=None)
+    p.add_argument("--action-repeat", type=int, default=1,
+                   help="dm_control only: apply each action for N control "
+                        "steps, summing rewards (DrQ convention; 4 for "
+                        "pixel swingup)")
     p.add_argument("--warmup", dest="warmup_steps", type=int, default=1_000)
     p.add_argument("--p-replay", "--prioritized", dest="prioritized",
                    action=argparse.BooleanOptionalAction, default=True)
@@ -191,6 +195,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
     cfg = TrainConfig(
         env=args.env,
         max_episode_steps=args.max_episode_steps,
+        action_repeat=args.action_repeat,
         num_envs=args.num_envs,
         her=args.her,
         her_k=args.her_k,
