@@ -1,0 +1,90 @@
+"""MFU sweep: where does the framework's compute utilization land when the
+shapes allow it? (VERDICT round-3 next #4)
+
+The flagship bench's single-digit MFU is a property of the WORKLOAD (3x256
+MLPs, batch 256: arithmetic intensity ~60 FLOP/B, far under the ~240 FLOP/B
+ridge of a v5e) — this script provides the contrast points that make that
+claim checkable rather than asserted:
+
+1. batch sweep 256 -> 4096 on the flagship MLP config — MFU and HBM
+   utilization per point (bigger batch raises intensity: the params/
+   optimizer traffic amortizes over more rows);
+2. the conv (pixel) critic config at 48x48x2 — convolutions carry far more
+   FLOPs per byte than the tiny MLPs;
+3. a "wide" MLP variant (1024-wide hiddens, batch 4096) — MXU-saturating
+   matmul shapes with the same train-step machinery.
+
+Every point runs through ``bench.bench_tpu`` itself — the SAME pinned
+protocol as the flagship line (fused K-step scan with device-side random
+pool gather, donated state, value-transfer sync), parameterized rather
+than copied, so the two can never drift apart.
+
+Run on the real chip:  python benchmarks/mfu_sweep.py
+Prints one JSON line per point and writes benchmarks/mfu_sweep_results.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import bench_tpu  # noqa: E402
+
+
+def bench_point(label: str, **kwargs) -> dict:
+    out = bench_tpu("bfloat16", **kwargs)
+    row = {
+        "bench": "mfu_sweep",
+        "config": label,
+        "batch": kwargs.get("batch", 256),
+        "compute_dtype": "bfloat16",
+        "steps_per_sec": round(out["steps_per_sec"], 1),
+    }
+    for k in ("flops_per_grad_step", "bytes_per_grad_step"):
+        if k in out:
+            row[k] = round(out[k])
+    if "flops_per_grad_step" in out and out.get("bytes_per_grad_step"):
+        row["intensity_flop_per_byte"] = round(
+            out["flops_per_grad_step"] / out["bytes_per_grad_step"], 1
+        )
+    for k, nd in (
+        ("achieved_tflops", 3),
+        ("mfu", 5),
+        ("achieved_gbps", 1),
+        ("hbm_util", 4),
+    ):
+        if k in out:
+            row[k] = round(out[k], nd)
+    return row
+
+
+def main() -> None:
+    rows = []
+    # 1. batch scaling on the flagship MLP
+    for batch in (256, 512, 1024, 2048, 4096):
+        rows.append(bench_point("mlp256", batch=batch, k_steps=256, measure=8))
+        print(json.dumps(rows[-1]), flush=True)
+    # 2. conv critic (pixel workload): fewer fused steps — each is ~100x
+    #    the MLP's FLOPs; smaller pool so pixel rows fit HBM comfortably
+    rows.append(
+        bench_point("conv48", batch=256, pixel=True, k_steps=32, measure=4,
+                    pool_rows=8_192)
+    )
+    print(json.dumps(rows[-1]), flush=True)
+    # 3. MXU-shaped MLP: 1024-wide, batch 4096
+    rows.append(
+        bench_point("mlp1024", batch=4096, hidden=1024, k_steps=64, measure=4)
+    )
+    print(json.dumps(rows[-1]), flush=True)
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "mfu_sweep_results.json")
+    with open(out, "w") as f:
+        json.dump(rows, f, indent=1)
+    print(f"[mfu_sweep] wrote {out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -134,6 +134,12 @@ class TrainConfig:
 
     # distribution
     dp: Optional[int] = None           # None → single device
+    # Hogwild-staleness DP (SURVEY §2.2): each replica runs the K
+    # steps_per_dispatch window on its own diverging param copy (no
+    # per-step gradient sync), then one param/optimizer pmean resyncs —
+    # 1 AllReduce per K steps instead of K, the reference's async-worker
+    # trade with the staleness bounded by K.
+    dp_hogwild: bool = False
     tp: int = 1
 
     # algorithm

@@ -118,6 +118,10 @@ ENV_VALUE_RANGES = {
     "pointmass_goal": (-50.0, 0.0),
     "HalfCheetah-v4": (0.0, 1000.0),
     "HalfCheetah-v5": (0.0, 1000.0),
+    "Hopper-v4": (0.0, 500.0),
+    "Hopper-v5": (0.0, 500.0),
+    "Walker2d-v4": (0.0, 500.0),
+    "Walker2d-v5": (0.0, 500.0),
     "Humanoid-v4": (0.0, 1000.0),
     "Humanoid-v5": (0.0, 1000.0),
 }

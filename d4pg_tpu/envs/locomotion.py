@@ -278,6 +278,13 @@ class _SpatialLocomotion:
         m = jnp.asarray(self.model.mass)
         return jnp.sum(m * coms[:, 0]) / jnp.sum(m)
 
+    def _forward_x(self, q: jax.Array) -> jax.Array:
+        """x-position whose finite difference defines the forward-velocity
+        reward. Whole-model mass-weighted COM by default (Humanoid-v5
+        semantics); Ant overrides with the torso body (Ant-v5 tracks
+        get_body_com("torso"), not the model COM — ADVICE round-3)."""
+        return self._com_x(q)
+
     def reset(self, key: jax.Array) -> Tuple[EnvState, jax.Array]:
         key, kq, kv = jax.random.split(key, 3)
         s = self.reset_noise_scale
@@ -303,7 +310,7 @@ class _SpatialLocomotion:
         q2, v2 = step_spatial(
             self.model, q, v, ctrl, self.n_substeps, self.substep_dt
         )
-        x_velocity = (self._com_x(q2) - self._com_x(q)) / self.control_dt
+        x_velocity = (self._forward_x(q2) - self._forward_x(q)) / self.control_dt
         # Finiteness guard: a penalty-contact blow-up (rare — one in ~3M
         # steps observed) must terminate the episode AND keep NaN or
         # blow-up-scale values out of the replay ring — one poisoned
@@ -369,7 +376,8 @@ class Ant(_SpatialLocomotion):
     capsule geoms) extracts and matches MuJoCo's mass matrix/bias with NO
     engine changes (tests/test_spatial.py). obs[27] = qpos[2:] ++ qvel
     (proprioceptive core; gym's 78-dim cfrc_ext block omitted as for
-    Humanoid). Reward = 1.0·healthy + ẋ_com − 0.5·Σctrl²; terminates
+    Humanoid). Reward = 1.0·healthy + ẋ_torso − 0.5·Σctrl² (Ant-v5
+    tracks the TORSO body's x, not the whole-model COM); terminates
     when torso z leaves (0.2, 1.0). Reset noise: qpos uniform ±0.1,
     qvel 0.1·N(0,1), as gym."""
 
@@ -385,3 +393,12 @@ class Ant(_SpatialLocomotion):
     reset_noise_scale = 0.1
     uniform_vel_noise = False
     healthy_z = (0.2, 1.0)
+
+    def _forward_x(self, q: jax.Array) -> jax.Array:
+        from d4pg_tpu.envs.spatial import body_coms
+
+        # Body 0 is the free-joint root (torso) in the extracted model;
+        # its COM is the sphere center == the frame origin gymnasium's
+        # get_body_com("torso") reports.
+        coms, _ = body_coms(self.model, q)
+        return coms[0, 0]

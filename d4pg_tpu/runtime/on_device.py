@@ -368,6 +368,7 @@ def run_on_device(config) -> dict:
     from d4pg_tpu.replay import noise_scale_schedule
     from d4pg_tpu.runtime.checkpoint import (
         CheckpointManager,
+        best_eval_path,
         invalidate_best_eval,
         load_trainer_meta,
         save_best_eval,
@@ -440,10 +441,18 @@ def run_on_device(config) -> dict:
         env_steps = int(meta.get("env_steps", 0))
         ewma = meta.get("ewma_return")
         # Without this a resumed leg's first (worse) eval would clobber the
-        # best-params snapshot from the previous leg.
-        if os.path.exists(f"{config.log_dir}/best_eval.json"):
-            with open(f"{config.log_dir}/best_eval.json") as f:
-                best_eval = json.load(f)["eval_return_mean"]
+        # best-params snapshot from the previous leg. Only preloaded when a
+        # checkpoints_best snapshot actually backs it — a leftover
+        # best_eval.json from a HOST-trainer run in the same dir (which
+        # writes best_actor.npz, never checkpoints_best/) must not preload
+        # a score this driver never persisted; corrupt JSON starts fresh.
+        best_json = best_eval_path(config.log_dir)
+        if best_ckpt.latest_step() is not None and os.path.exists(best_json):
+            try:
+                with open(best_json) as f:
+                    best_eval = float(json.load(f)["eval_return_mean"])
+            except (OSError, ValueError, KeyError):
+                pass
     grad_steps = int(jax.device_get(state.step))
     # Distinct key stream per resumed leg — replaying PRNGKey(seed) would
     # repeat the original run's exact exploration/eval sequence every leg.

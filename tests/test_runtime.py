@@ -93,6 +93,58 @@ def test_trainer_bf16_transfer_staging(tmp_path):
     assert np.isfinite(out["critic_loss"])
 
 
+@pytest.mark.slow
+def test_bf16_staging_composes_with_dp(tmp_path):
+    """--transfer-dtype bfloat16 --dp 8 (the BASELINE scale-out shape:
+    link-starved host + multi-chip DP): rows cross the wire as bf16, the
+    restore-to-f32 runs before the shard_map'd step, training stays
+    finite. Both the K=1 and the fused K>1 dispatch paths."""
+    import ml_dtypes
+
+    for sub, extra in (
+        ("dp1", []),
+        ("dpk", ["--steps-per-dispatch", "2"]),
+    ):
+        t = Trainer(
+            config_from_args(
+                _tiny_args(
+                    tmp_path / sub,
+                    ["--env", "Pendulum-v1", "--transfer-dtype", "bfloat16",
+                     "--dp", "8", "--bsize", "16", *extra],
+                )
+            )
+        )
+        assert t._stage("obs", np.ones((4, 3), np.float32)).dtype == ml_dtypes.bfloat16
+        out = t.train()
+        t.close()
+        assert np.isfinite(out["critic_loss"])
+
+
+@pytest.mark.slow
+def test_hogwild_dp_trains_from_cli(tmp_path):
+    """--dp-hogwild --dp 8 --steps-per-dispatch 2 end to end through the
+    Trainer; and the two flag-validation errors."""
+    t = Trainer(
+        config_from_args(
+            _tiny_args(
+                tmp_path / "hw",
+                ["--env", "Pendulum-v1", "--dp", "8", "--dp-hogwild",
+                 "--steps-per-dispatch", "2", "--bsize", "16"],
+            )
+        )
+    )
+    out = t.train()
+    t.close()
+    assert np.isfinite(out["critic_loss"])
+    with pytest.raises(ValueError, match="steps-per-dispatch"):
+        Trainer(config_from_args(_tiny_args(
+            tmp_path / "hw1", ["--env", "Pendulum-v1", "--dp", "8",
+                               "--dp-hogwild", "--bsize", "16"])))
+    with pytest.raises(ValueError, match="requires --dp"):
+        Trainer(config_from_args(_tiny_args(
+            tmp_path / "hw2", ["--env", "Pendulum-v1", "--dp-hogwild"])))
+
+
 def test_uint8_wire_transfer_staging(tmp_path):
     """--transfer-dtype uint8 (pixel link rung): sampled rows leave the
     quantized replay as raw bytes; flat envs are rejected."""

@@ -174,6 +174,28 @@ class TestAntEnv:
         cfg = apply_env_preset(TrainConfig(env="ant"))
         assert cfg.agent.obs_dim == 27 and cfg.agent.action_dim == 8
 
+    def test_forward_reward_tracks_torso_not_model_com(self):
+        """Ant-v5 tracks get_body_com("torso") for the forward reward;
+        the whole-model mass-weighted COM is a different number whenever
+        the legs are asymmetric (ADVICE round-3). Torso COM == its world
+        x from forward kinematics; Humanoid keeps the model COM."""
+        from d4pg_tpu.envs.locomotion import Ant
+        from d4pg_tpu.envs.spatial import body_coms
+
+        env = Ant()
+        q = jnp.asarray(env.model.qpos0, jnp.float32)
+        # asymmetric leg pose (one front leg folded): the model COM shifts
+        # in x while the torso stays put
+        q = q.at[7:15].set(
+            jnp.array([0.9, 1.2, 0.0, 0.1, 0.0, -0.1, 0.0, 0.1])
+        )
+        torso_x = float(body_coms(env.model, q)[0][0, 0])
+        assert abs(float(env._forward_x(q)) - torso_x) < 1e-6
+        assert abs(float(env._com_x(q)) - torso_x) > 1e-3
+        hum = Humanoid()
+        qh = jnp.asarray(hum.model.qpos0, jnp.float32)
+        assert abs(float(hum._forward_x(qh)) - float(hum._com_x(qh))) < 1e-9
+
 
 class TestHumanoidEnv:
     def test_reset_and_step_shapes_jit_vmap(self):

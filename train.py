@@ -80,6 +80,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "is a ~100 ms link round-trip on remote chips)")
     p.add_argument("--dp", type=int, default=None,
                    help="data-parallel device count (None = single device)")
+    p.add_argument("--dp-hogwild", action="store_true",
+                   help="async-DP staleness emulation: each replica runs "
+                        "the K-step dispatch window on its own diverging "
+                        "param copy, then one param pmean resyncs (the "
+                        "reference's Hogwild trade, staleness bounded by "
+                        "K = --steps-per-dispatch)")
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--twin-critic", action="store_true",
                    help="clipped double-Q (TD3-style) distributional twin "
@@ -224,6 +230,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         profile_dir=args.profile_dir,
         max_rss_gb=args.max_rss_gb,
         dp=args.dp,
+        dp_hogwild=args.dp_hogwild,
         tp=args.tp,
         agent=agent,
         seed=args.seed,
