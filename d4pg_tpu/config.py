@@ -81,6 +81,11 @@ class TrainConfig:
     # --rmsize 1000000 is distinguishable from the default and never
     # silently downgraded by a preset.
     replay_capacity: Optional[int] = None
+    # On-device HBM ring row dtype for FLAT observations: "bfloat16" halves
+    # the per-sample gather bytes (the bandwidth-bound part of the fused
+    # step per the bench roofline). Pixel envs always store uint8 rows
+    # regardless. "auto" == float32 today.
+    ring_dtype: str = "auto"
     prioritized: bool = True           # reference --p_replay
     n_step: int = 3                    # reference --n_steps
     tree_backend: str = "auto"

@@ -80,16 +80,26 @@ def device_replay_init(
 def _encode_obs(x: jax.Array, obs_dtype, scale: float = 255.0) -> jax.Array:
     """Same contract as the host ``ReplayBuffer._encode_obs``
     (``replay/uniform.py``): store ``clip(rint(x·scale), 0, 255)`` —
-    ``scale`` is 255 for [0,1]-float envs, 1.0 for byte-image envs."""
+    ``scale`` is 255 for [0,1]-float envs, 1.0 for byte-image envs.
+    ``bfloat16`` stores flat observations at half the HBM bytes — the ring
+    GATHER is the flagship workload's bandwidth bottleneck (bench.py
+    roofline), so halving row bytes is a direct throughput lever; 8 bits
+    of mantissa cost ~1e-2 relative obs noise, the same magnitude as the
+    exploration noise already injected on purpose."""
     if obs_dtype == jnp.uint8:
         return jnp.clip(jnp.round(x * scale), 0.0, 255.0).astype(jnp.uint8)
+    if obs_dtype == jnp.bfloat16:
+        return x.astype(jnp.bfloat16)
     return x
 
 
 def _decode_obs(x: jax.Array, obs_dtype) -> jax.Array:
-    """Decoded batches are always [0,1] floats (host convention)."""
+    """Decoded batches are always floats in the env's scale (host
+    convention: [0,1] for uint8-quantized pixel rings)."""
     if obs_dtype == jnp.uint8:
         return x.astype(jnp.float32) / 255.0
+    if obs_dtype == jnp.bfloat16:
+        return x.astype(jnp.float32)
     return x
 
 
@@ -133,6 +143,7 @@ def make_on_device_trainer(
     axis_name: str = "dp",
     obs_uint8: bool = False,
     obs_scale: float = 255.0,
+    obs_bf16: bool = False,
 ):
     """Build (init_fn, warmup_fn, iterate_fn) for the fully-jitted loop.
 
@@ -191,7 +202,11 @@ def make_on_device_trainer(
             + (f" — both are per-device ÷{D})" if D > 1 else ")")
         )
     noise_init, noise_sample, noise_reset = make_noise(config)
-    obs_dtype = jnp.uint8 if obs_uint8 else jnp.float32
+    if obs_uint8 and obs_bf16:
+        raise ValueError("obs_uint8 and obs_bf16 are mutually exclusive")
+    obs_dtype = (
+        jnp.uint8 if obs_uint8 else jnp.bfloat16 if obs_bf16 else jnp.float32
+    )
 
     def _decode_batches(b: dict) -> dict:
         b["obs"] = _decode_obs(b["obs"], obs_dtype)
@@ -416,6 +431,12 @@ def run_on_device(config) -> dict:
         # guard rejects anything else; decoded batches are always [0,1]).
         obs_uint8=bool(agent_cfg.pixel_shape),
         obs_scale=getattr(env, "obs_scale", None) or 255.0,
+        # Flat-obs rings optionally store bf16 rows (--ring-dtype
+        # bfloat16): half the gather bytes on the workload the roofline
+        # shows is bandwidth-bound, for ~1e-2 relative obs noise.
+        obs_bf16=(
+            config.ring_dtype == "bfloat16" and not agent_cfg.pixel_shape
+        ),
     )
 
     key = jax.random.PRNGKey(config.seed)

@@ -131,6 +131,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lr-critic", type=float, default=1e-4)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--tree-backend", choices=["auto", "numpy", "native"], default="auto")
+    p.add_argument("--ring-dtype", choices=["auto", "float32", "bfloat16"],
+                   default="auto",
+                   help="--on-device HBM ring row dtype for flat obs; "
+                        "bfloat16 halves the per-sample gather bytes "
+                        "(pixel rings always store uint8)")
     p.add_argument("--transfer-dtype", choices=["float32", "bfloat16", "uint8"],
                    default="float32",
                    help="host->device batch wire format for observations; "
@@ -220,6 +225,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         n_step=args.n_step,
         tree_backend=args.tree_backend,
         transfer_dtype=args.transfer_dtype,
+        ring_dtype=args.ring_dtype,
         eval_interval=args.eval_interval,
         eval_episodes=args.eval_episodes,
         concurrent_eval=args.concurrent_eval,
