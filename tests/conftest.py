@@ -2,6 +2,18 @@
 
 Multi-device DP/psum paths are tested without TPU hardware via
 ``--xla_force_host_platform_device_count=8`` (SURVEY.md §4).
+
+FAST-TIER BUDGET (round-4 audit): ``pytest -m "not slow"`` must stay
+under ~3 minutes on a 1-core host. JAX CPU compiles dominate test time,
+so anything that compiles a physics step (planar/spatial dynamics — the
+mass-matrix Hessian alone is tens of seconds), builds a full Trainer, or
+traces a DP/TP shard_map belongs in ``slow`` unless it is THE smoke test
+for its subsystem (one end-to-end Trainer test stays fast on purpose).
+Measured 2026-08 (1-core host, a TPU training run sharing the core):
+~18 min before the audit, 280 s after — the residual floor is JAX import
++ one small jit per test file; expect ≤2-3 min on an idle host. When
+adding a test, check its wall time with ``--durations=0`` before leaving
+it unmarked.
 """
 
 import os

@@ -274,6 +274,7 @@ class TestTrainerPool:
         finally:
             t.close()
 
+    @pytest.mark.slow  # compile-heavy (conftest fast-tier budget)
     def test_async_requires_pool(self, tmp_path):
         from d4pg_tpu.runtime.trainer import Trainer
 

@@ -163,6 +163,7 @@ def test_act_explores_and_eval_is_deterministic():
     np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
 
 
+@pytest.mark.slow  # compile-heavy (conftest fast-tier budget)
 def test_bfloat16_compute_path():
     config = D4PGConfig(
         obs_dim=3, action_dim=1, hidden_sizes=(32, 32), compute_dtype="bfloat16"

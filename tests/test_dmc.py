@@ -195,6 +195,7 @@ def test_pixel_mode_trains_with_conv_encoder(tmp_path):
     assert "DMC_PIXEL_TRAIN_OK" in p.stdout, p.stdout + p.stderr
 
 
+@pytest.mark.slow  # compile-heavy (conftest fast-tier budget)
 def test_pixel_env_refuses_pooled_collection(tmp_path):
     """Concurrent cross-process EGL rendering deadlocks on this image's GL
     stack (module docstring) — the trainer must refuse pooled/async

@@ -137,6 +137,7 @@ def test_match_partition_rules_stacked_twin_axis():
     assert specs["params"]["out"]["kernel"] == P(None, "tp", None)
 
 
+@pytest.mark.slow  # compile-heavy (conftest fast-tier budget)
 def test_auto_parallel_twin_critic_tp():
     """GSPMD dp×tp with twin critics: trains, stays finite, and the stacked
     kernels shard their fan-out (not the twin axis) over tp."""

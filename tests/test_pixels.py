@@ -159,6 +159,7 @@ def test_random_shift_augmentation():
     assert np.unique(outs.round(6), axis=0).shape[0] > 1
 
 
+@pytest.mark.slow  # compile-heavy (conftest fast-tier budget)
 def test_train_step_augment_keys_advance():
     """Pixel configs thread the PRNG through the state so every train step
     augments differently; flat configs leave the key untouched."""

@@ -51,6 +51,7 @@ def _random_state(rng):
     return q, qd
 
 
+@pytest.mark.slow  # compile-heavy (conftest fast-tier budget)
 def test_mass_matrix_matches_mujoco(model, mj):
     m, d = mj
     rng = np.random.default_rng(0)
@@ -65,6 +66,7 @@ def test_mass_matrix_matches_mujoco(model, mj):
         np.testing.assert_allclose(M_ours, M_mj, atol=2e-4, rtol=2e-4)
 
 
+@pytest.mark.slow  # compile-heavy (conftest fast-tier budget)
 def test_bias_force_matches_mujoco_rne(model, mj):
     """Coriolis + centrifugal + gravity == mj_rne(flg_acc=0)."""
     m, d = mj
@@ -91,6 +93,7 @@ def test_fk_coms_match_mujoco(model, mj):
     )
 
 
+@pytest.mark.slow  # compile-heavy (conftest fast-tier budget)
 def test_passive_drop_settles_like_mujoco(model, mj):
     """Contact model check: from qpos0 the cheetah must come to rest on its
     feet at (approximately) the height/pitch real MuJoCo finds."""
@@ -123,6 +126,7 @@ def test_passive_drop_settles_like_mujoco(model, mj):
     assert gaps.min() > -0.015
 
 
+@pytest.mark.slow  # compile-heavy (conftest fast-tier budget)
 def test_bang_bang_torques_stay_finite(model):
     """Penalty contacts + semi-implicit Euler must not explode under
     full-gear bang-bang actuation (the stress case for penalty methods)."""
@@ -146,6 +150,7 @@ def test_bang_bang_torques_stay_finite(model):
 
 
 @pytest.mark.parametrize("asset", ["hopper.xml", "walker2d.xml"])
+@pytest.mark.slow  # compile-heavy (conftest fast-tier budget)
 def test_hopper_walker_dynamics_match_mujoco(asset):
     """The same Lagrangian machinery is exact for every planar MJCF: mass
     matrix + bias vs MuJoCo on the other two gym planar models (these use
@@ -198,6 +203,7 @@ class TestHopperWalkerEnvs:
         _, _, _, term2, _ = step(fallen, jnp.zeros(3))
         assert float(term2) == 1.0
 
+    @pytest.mark.slow  # compile-heavy (conftest fast-tier budget)
     def test_walker_shapes_and_healthy_termination(self):
         from d4pg_tpu.envs.locomotion import Walker2d
 
@@ -221,6 +227,7 @@ class TestHopperWalkerEnvs:
 
 
 class TestHalfCheetahEnv:
+    @pytest.mark.slow  # compile-heavy (conftest fast-tier budget)
     def test_reset_and_step_shapes_jit_vmap(self):
         env = HalfCheetah()
         keys = jax.random.split(jax.random.PRNGKey(0), 3)
@@ -233,6 +240,7 @@ class TestHalfCheetahEnv:
         # reset noise: different keys → different initial states
         assert not np.allclose(np.asarray(obs[0]), np.asarray(obs[1]))
 
+    @pytest.mark.slow  # compile-heavy (conftest fast-tier budget)
     def test_reward_is_forward_velocity_minus_ctrl_cost(self):
         env = HalfCheetah()
         state, _ = env.reset(jax.random.PRNGKey(0))
@@ -250,6 +258,7 @@ class TestHalfCheetahEnv:
         np.testing.assert_allclose(np.asarray(obs[:8]), np.asarray(q[1:]))
         np.testing.assert_allclose(np.asarray(obs[8:]), np.asarray(qd))
 
+    @pytest.mark.slow  # compile-heavy (conftest fast-tier budget)
     def test_truncates_at_max_episode_steps(self):
         env = HalfCheetah(max_episode_steps=3)
         state, _ = env.reset(jax.random.PRNGKey(0))

@@ -52,6 +52,7 @@ def _random_state(m, rng):
     return q, v
 
 
+@pytest.mark.slow  # compile-heavy (conftest fast-tier budget)
 def test_mass_matrix_matches_mujoco(model, mj):
     m, d = mj
     rng = np.random.default_rng(0)
@@ -65,6 +66,7 @@ def test_mass_matrix_matches_mujoco(model, mj):
         np.testing.assert_allclose(M_ours, M_mj, atol=2e-4, rtol=2e-4)
 
 
+@pytest.mark.slow  # compile-heavy (conftest fast-tier budget)
 def test_bias_force_matches_mujoco_rne(model, mj):
     """Newton–Euler-through-autodiff == mj_rne(flg_acc=0): coriolis +
     centrifugal + gyroscopic + gravity, in MuJoCo's qvel conventions
@@ -95,6 +97,7 @@ def test_fk_coms_match_mujoco(model, mj):
     np.testing.assert_allclose(np.asarray(coms), d.xipos[1:], atol=1e-5)
 
 
+@pytest.mark.slow  # compile-heavy (conftest fast-tier budget)
 def test_passive_drop_stays_finite_and_settles(model):
     """Contact model check: the passive humanoid falls from the XML pose and
     comes to rest ON the ground (no sinking through, no explosion)."""
@@ -123,6 +126,7 @@ def test_passive_drop_stays_finite_and_settles(model):
     assert gaps.min() > -0.02
 
 
+@pytest.mark.slow  # compile-heavy (conftest fast-tier budget)
 def test_ant_dynamics_match_mujoco():
     """Engine generality: ant.xml (free joint + 8 hinges, sphere + capsule
     geoms) extracts and matches MuJoCo with NO engine changes."""
@@ -150,6 +154,7 @@ def test_ant_dynamics_match_mujoco():
 
 
 class TestAntEnv:
+    @pytest.mark.slow  # compile-heavy (conftest fast-tier budget)
     def test_shapes_reward_and_termination(self):
         from d4pg_tpu.envs.locomotion import Ant
 
@@ -198,6 +203,7 @@ class TestAntEnv:
 
 
 class TestHumanoidEnv:
+    @pytest.mark.slow  # compile-heavy (conftest fast-tier budget)
     def test_reset_and_step_shapes_jit_vmap(self):
         env = Humanoid()
         keys = jax.random.split(jax.random.PRNGKey(0), 3)
@@ -210,6 +216,7 @@ class TestHumanoidEnv:
         assert bool(jnp.all(term == 0.0))
         assert not np.allclose(np.asarray(obs[0]), np.asarray(obs[1]))
 
+    @pytest.mark.slow  # compile-heavy (conftest fast-tier budget)
     def test_reward_healthy_bonus_and_termination(self):
         env = Humanoid()
         state, _ = env.reset(jax.random.PRNGKey(0))
@@ -232,6 +239,7 @@ class TestHumanoidEnv:
         # root quaternion stays unit under reset noise
         np.testing.assert_allclose(float(jnp.linalg.norm(q[3:7])), 1.0, atol=1e-6)
 
+    @pytest.mark.slow  # compile-heavy (conftest fast-tier budget)
     def test_ctrl_scaled_by_ctrlrange(self):
         """Actions are canonical (−1,1); the MJCF ctrlrange is ±0.4, so the
         ctrl cost of a full-scale action is 0.1 · 17 · 0.4² = 0.272."""
@@ -247,6 +255,7 @@ class TestHumanoidEnv:
         expect = 1.25 * x_vel - 0.1 * 17 * 0.16 + 5.0
         np.testing.assert_allclose(float(r), expect, rtol=1e-4)
 
+    @pytest.mark.slow  # compile-heavy (conftest fast-tier budget)
     def test_nan_state_terminates_and_obs_stays_finite(self):
         """A physics blow-up (NaN/overspeed state) must read as a terminal
         step with finite obs/reward — one poisoned transition in the replay
@@ -270,6 +279,7 @@ class TestHumanoidEnv:
         _, _, r3, _, _ = jax.jit(env.step)(near, jnp.zeros(17))
         assert abs(float(r3)) <= 1e3
 
+    @pytest.mark.slow  # compile-heavy (conftest fast-tier budget)
     def test_planar_envs_share_the_guard(self):
         """HalfCheetah's _is_healthy is constant-True — a NaN state must
         still terminate (and emit sanitized obs/reward), or the poisoned
