@@ -82,6 +82,13 @@ class GymAdapter:
             self.observation_dim = int(np.prod(obs_space.shape))
         self.action_dim = int(np.prod(space.shape))
         self.last_goal_obs: Any = None
+        # Categorical-support hint consumed by _reconcile_config's
+        # getattr(env, "v_min"/"v_max") fallback — without it the table
+        # below was dead weight and gym ids outside ENV_PRESETS silently
+        # trained on the Pendulum default support (round-4 fix). An
+        # explicit --v-min/--v-max still wins.
+        if env_id in ENV_VALUE_RANGES:
+            self.v_min, self.v_max = ENV_VALUE_RANGES[env_id]
 
     def _flatten(self, obs) -> np.ndarray:
         if self.is_goal_env:
@@ -113,9 +120,10 @@ class GymAdapter:
 # Value-range presets per env (replaces the reference's configure_env_params,
 # main.py:84-99, which hardcodes Pendulum and comments the rest out).
 ENV_VALUE_RANGES = {
+    # GYM ids only: short pure-JAX names (pendulum, halfcheetah, …) never
+    # reach GymAdapter — their supports live in config.ENV_PRESETS, which
+    # _reconcile_config checks first.
     "Pendulum-v1": (-300.0, 0.0),
-    "pendulum": (-300.0, 0.0),
-    "pointmass_goal": (-50.0, 0.0),
     "HalfCheetah-v4": (0.0, 1000.0),
     "HalfCheetah-v5": (0.0, 1000.0),
     "Hopper-v4": (0.0, 500.0),

@@ -107,3 +107,19 @@ def test_gym_adapter_pendulum():
     obs, r, term, trunc, info = env.step(np.array([0.5]))
     assert isinstance(r, float)
     env.close()
+
+
+def test_gym_adapter_advertises_value_range():
+    """ENV_VALUE_RANGES feeds _reconcile_config via the adapter's
+    v_min/v_max attributes — gym ids in the table must not silently train
+    on the Pendulum default support (round-4 fix: the table was dead)."""
+    pytest.importorskip("gymnasium")
+    from d4pg_tpu.envs.gym_adapter import ENV_VALUE_RANGES, GymAdapter
+
+    env = GymAdapter("Pendulum-v1")
+    assert (env.v_min, env.v_max) == ENV_VALUE_RANGES["Pendulum-v1"]
+    env.close()
+    # ids outside the table advertise nothing (reconcile keeps defaults)
+    env2 = GymAdapter("MountainCarContinuous-v0")
+    assert not hasattr(env2, "v_min")
+    env2.close()
