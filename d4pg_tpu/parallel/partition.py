@@ -77,13 +77,28 @@ def match_partition_rules(rules: Sequence[tuple[str, P]], tree, mesh: Mesh | Non
         for pattern, spec in rules:
             if re.search(pattern, name):
                 # Rules are written against a param's own [in, out] (or
-                # [out]) shape. A leaf with ONE extra leading dim is a
-                # stacked variant of the same param (twin critics stack two
-                # critics on axis 0, agent/state.py): replicate the stack
-                # axis and apply the rule to the trailing dims — otherwise
-                # the specs would silently shard the wrong dimensions.
-                if len(spec) and np.ndim(leaf) == len(spec) + 1:
+                # [out]) shape. A leaf with ONE extra leading dim of
+                # EXACTLY 2 is a stacked variant of the same param (twin
+                # critics stack two critics on axis 0, agent/state.py):
+                # replicate the stack axis and apply the rule to the
+                # trailing dims — otherwise the specs would silently shard
+                # the wrong dimensions. The shape[0]==2 gate keeps future
+                # higher-rank params (e.g. a conv kernel matching a
+                # dense-written rule) out of this branch — they fall to the
+                # _spec_fits replication fallback instead of silently
+                # gaining a replicated leading axis (ADVICE round-3).
+                if (
+                    len(spec)
+                    and np.ndim(leaf) == len(spec) + 1
+                    and shape[0] == 2
+                ):
                     spec = P(None, *spec)
+                if len(spec) not in (0, np.ndim(leaf)):
+                    # Rank still disagrees after the twin-stack gate (a
+                    # higher-rank param matching a dense-written rule):
+                    # replicate rather than let a short spec silently
+                    # shard whichever leading dims it happens to prefix.
+                    spec = P()
                 specs.append(spec if _spec_fits(spec, shape, mesh) else P())
                 break
         else:

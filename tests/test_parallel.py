@@ -137,6 +137,24 @@ def test_match_partition_rules_stacked_twin_axis():
     assert specs["params"]["out"]["kernel"] == P(None, "tp", None)
 
 
+def test_match_partition_rules_gates_non_twin_leading_dims():
+    """The stacked-axis prepend fires ONLY for twin stacks (leading dim
+    exactly 2): a rank-3 leaf with another leading size matching a
+    dense-written rule must fall back to replication, not silently gain a
+    replicated leading axis (ADVICE round-3)."""
+    tree = {
+        "params": {
+            # conv-like [width=5, in, out] leaf under a name a dense rule
+            # matches: not a twin stack
+            "hidden_0": {"kernel": np.zeros((5, 4, 8))},
+        }
+    }
+    from d4pg_tpu.parallel import DEFAULT_RULES
+
+    specs = match_partition_rules(DEFAULT_RULES, tree)
+    assert specs["params"]["hidden_0"]["kernel"] == P()
+
+
 @pytest.mark.slow  # compile-heavy (conftest fast-tier budget)
 def test_auto_parallel_twin_critic_tp():
     """GSPMD dp×tp with twin critics: trains, stays finite, and the stacked
