@@ -2,9 +2,11 @@
 # Supervised REAL-MuJoCo training legs — the halfcheetah_tpu_r2 recipe
 # (8-actor async pool, CPU-jitted acting, K=32 fused dispatch, async PER
 # write-back, exit-75 RSS self-preemption) pointed at any gymnasium env.
-# Twin critics default on: the round-3 study showed single-critic D4PG
-# plateaus at the documented DDPG-family ceiling on contact-critical
-# tasks (Hopper/Walker2d), the regime clipped double-Q was built for.
+# Single critic by default: the round-4 Hopper comparison showed clipped
+# double-Q's pessimism suppresses the optimistic Q that discovers hop/
+# gait cycles on real contacts (twin best 1,030 vs single 3,558 —
+# runs/hopper_mujoco_tpu/NOTES.md). Pass --twin-critic via EXTRA args
+# for the ablation arm.
 # Usage: bash runs/mujoco_supervisor.sh ENV DIR [TOTAL_STEPS] [EXTRA...]
 #   e.g. bash runs/mujoco_supervisor.sh Hopper-v5 runs/hopper_mujoco_tpu
 ENV_ID=${1:?usage: mujoco_supervisor.sh ENV DIR [TOTAL] [extra flags...]}
@@ -18,8 +20,8 @@ while :; do
   if [ "$REM" -le 0 ]; then echo "supervisor: done at step $STEP"; break; fi
   echo "supervisor: leg from step $STEP, $REM to go"
   python train.py --env "$ENV_ID" --num-envs 8 --async-collect \
-    --async-writeback --steps-per-dispatch 32 --n-step 3 --twin-critic \
-    --noise-decay-steps 1000000 --noise-scale-final 0.1 \
+    --async-writeback --steps-per-dispatch 32 --n-step 3 \
+    --noise-decay-steps 1000000 --noise-scale-final 0.15 \
     --total-steps "$REM" --eval-interval 10000 \
     --eval-episodes 5 --checkpoint-interval 100000 --snapshot-replay \
     --resume --max-rss-gb 80 --log-dir "$DIR" "$@"
