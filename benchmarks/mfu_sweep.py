@@ -54,7 +54,7 @@ def bench_point(label: str, **kwargs) -> dict:
         ("achieved_tflops", 3),
         ("mfu", 5),
         ("achieved_gbps", 1),
-        ("hbm_util", 4),
+        ("xla_bytes_util", 4),
     ):
         if k in out:
             row[k] = round(out[k], nd)

@@ -399,17 +399,28 @@ def train_step(
         actor_opt_state=actor_opt_state,
         critic_opt_state=critic_opt_state,
     )
-    metrics = _sync(
-        {
-            # Per-critic scale: the twin loss SUMS both critics (right for
-            # the gradient), but the logged metric must stay comparable to
-            # single-critic runs.
-            "critic_loss": critic_loss / 2 if config.twin_critic else critic_loss,
-            "actor_loss": actor_loss,
-            "priority_mean": jnp.mean(priorities),
-            "q_mean": -actor_loss,
-        }
-    )
+    step_metrics = {
+        # Per-critic scale: the twin loss SUMS both critics (right for
+        # the gradient), but the logged metric must stay comparable to
+        # single-critic runs.
+        "critic_loss": critic_loss / 2 if config.twin_critic else critic_loss,
+        "actor_loss": actor_loss,
+        "priority_mean": jnp.mean(priorities),
+        "q_mean": -actor_loss,
+    }
+    if config.dist.kind == "categorical":
+        # Support-saturation monitor: fraction of the categorical support
+        # [v_min, v_max] the mean Q occupies. The Humanoid v1500 study
+        # (runs/humanoid_ondevice_v1500) found q_mean pinned at v_max
+        # costing ~15% of final return — and nothing in the curves showed
+        # it. Values creeping toward 1.0 mean the support is clipping the
+        # value distribution; widen v_max. Categorical head only: the
+        # scalar and MoG heads are unbounded, so the ratio would be an
+        # alarm with no referent there.
+        step_metrics["q_support_frac"] = (-actor_loss - config.dist.v_min) / (
+            config.dist.v_max - config.dist.v_min
+        )
+    metrics = _sync(step_metrics)
     return new_state, metrics, priorities
 
 

@@ -176,13 +176,17 @@ ENV_PRESETS = {
     "walker2d": dict(v_min=0.0, v_max=500.0, obs_dim=17, action_dim=6, max_episode_steps=1000),
     # On-device 3D Humanoid (envs/spatial.py engine) — 45-dim proprioceptive
     # obs (see envs/locomotion.py:Humanoid docstring for the layout rationale).
-    "humanoid": dict(v_min=0.0, v_max=1000.0, obs_dim=45, action_dim=17, max_episode_steps=1000),
+    # v_max 1500 (not 1000): the round-4 v1500 study measured q_mean
+    # saturating against v_max=1000 and +15% final return from widening
+    # (runs/humanoid_ondevice_v1500/NOTES.md) — applied to the gym Humanoid
+    # ids below for the same reason (VERDICT round-4 weak #1).
+    "humanoid": dict(v_min=0.0, v_max=1500.0, obs_dim=45, action_dim=17, max_episode_steps=1000),
     "ant": dict(v_min=0.0, v_max=1000.0, obs_dim=27, action_dim=8, max_episode_steps=1000),
     "Pendulum-v1": dict(v_min=-300.0, v_max=0.0, obs_dim=3, action_dim=1, max_episode_steps=200),
     "HalfCheetah-v4": dict(v_min=0.0, v_max=1000.0, obs_dim=17, action_dim=6, max_episode_steps=1000),
     "HalfCheetah-v5": dict(v_min=0.0, v_max=1000.0, obs_dim=17, action_dim=6, max_episode_steps=1000),
-    "Humanoid-v4": dict(v_min=0.0, v_max=1000.0, obs_dim=376, action_dim=17, max_episode_steps=1000),
-    "Humanoid-v5": dict(v_min=0.0, v_max=1000.0, obs_dim=348, action_dim=17, max_episode_steps=1000),
+    "Humanoid-v4": dict(v_min=0.0, v_max=1500.0, obs_dim=376, action_dim=17, max_episode_steps=1000),
+    "Humanoid-v5": dict(v_min=0.0, v_max=1500.0, obs_dim=348, action_dim=17, max_episode_steps=1000),
 }
 
 

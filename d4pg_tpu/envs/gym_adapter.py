@@ -50,7 +50,22 @@ class GymAdapter:
             raise ImportError(
                 "gymnasium is not installed; use the pure-JAX envs in d4pg_tpu.envs"
             )
-        env = _gym.make(env_id)
+        try:
+            env = _gym.make(env_id)
+        except _gym.error.NameNotFound as not_found:
+            # The goal-dict robotics family (FetchReach/FetchPush/…) the
+            # reference's loop is built around (main.py:144-148,161-184)
+            # ships in gymnasium_robotics, which registers its ids only on
+            # import. Register lazily and retry — only on miss, so the
+            # common path pays nothing; if the package isn't installed the
+            # original NameNotFound (with gymnasium's did-you-mean hint)
+            # propagates, not a misleading missing-package error.
+            try:
+                import gymnasium_robotics
+            except ImportError:
+                raise not_found
+            _gym.register_envs(gymnasium_robotics)
+            env = _gym.make(env_id)
         if max_episode_steps is not None:
             # reference overrides _max_episode_steps (main.py:69)
             env = _gym.wrappers.TimeLimit(env.unwrapped, max_episode_steps)
@@ -120,18 +135,22 @@ class GymAdapter:
 # Value-range presets per env (replaces the reference's configure_env_params,
 # main.py:84-99, which hardcodes Pendulum and comments the rest out).
 ENV_VALUE_RANGES = {
-    # GYM ids only: short pure-JAX names (pendulum, halfcheetah, …) never
-    # reach GymAdapter — their supports live in config.ENV_PRESETS, which
-    # _reconcile_config checks first.
-    "Pendulum-v1": (-300.0, 0.0),
-    "HalfCheetah-v4": (0.0, 1000.0),
-    "HalfCheetah-v5": (0.0, 1000.0),
+    # ONLY ids absent from config.ENV_PRESETS belong here:
+    # _reconcile_config checks ENV_PRESETS first, so an entry duplicated
+    # in both tables is dead weight in this one — a future edit here would
+    # silently not take effect (ADVICE round-4). Pendulum-v1,
+    # HalfCheetah-v4/v5 and Humanoid-v4/v5 live in ENV_PRESETS.
     "Hopper-v4": (0.0, 500.0),
     "Hopper-v5": (0.0, 500.0),
     "Walker2d-v4": (0.0, 500.0),
     "Walker2d-v5": (0.0, 500.0),
-    "Humanoid-v4": (0.0, 1000.0),
-    "Humanoid-v5": (0.0, 1000.0),
+    # Sparse goal-dict robotics: reward is −1 per non-success step over a
+    # 50-step limit, so returns live in [−50, 0] (same shape as the
+    # pointmass_goal preset the HER path was built against).
+    "FetchReach-v4": (-50.0, 0.0),
+    "FetchPush-v4": (-50.0, 0.0),
+    "FetchSlide-v4": (-50.0, 0.0),
+    "FetchPickAndPlace-v4": (-50.0, 0.0),
 }
 
 

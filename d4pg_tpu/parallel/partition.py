@@ -173,7 +173,10 @@ def auto_parallel_train_step(
         in_shardings=(state_shardings, batch_shardings),
         out_shardings=(
             state_shardings,
-            {k: metric_sharding for k in ("critic_loss", "actor_loss", "priority_mean", "q_mean")},
+            # Prefix pytree: one replicated sharding covers the whole metrics
+            # dict, whatever keys train_step emits — enumerating them here
+            # broke the jit the day q_support_frac was added.
+            metric_sharding,
             batch_sharding,
         ),
         donate_argnums=(0,) if donate else (),

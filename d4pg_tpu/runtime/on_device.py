@@ -542,9 +542,17 @@ def run_on_device(config) -> dict:
                 # saving a lower one would be garbage-collected immediately
                 # while the JSON attested it.
                 prev = best_ckpt.latest_step()
-                if prev is not None and prev >= grad_steps:
+                if prev is not None:
+                    # Invalidate in BOTH branches: even when prev <
+                    # grad_steps (no explicit delete), Orbax max_to_keep=1
+                    # garbage-collects the prev step during save(), so a
+                    # crash between that GC and save_best_eval would leave
+                    # the JSON attesting deleted params with a stale lower
+                    # score — and a later mediocre eval could then overwrite
+                    # the true champion (ADVICE round-4).
                     invalidate_best_eval(config.log_dir)
-                    best_ckpt.delete(prev)
+                    if prev >= grad_steps:
+                        best_ckpt.delete(prev)
                 best_ckpt.save(grad_steps, carry[0])
                 # Orbax saves are async: wait before recording the score so
                 # a crash can never leave best_eval.json claiming params

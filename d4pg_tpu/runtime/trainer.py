@@ -387,7 +387,7 @@ class Trainer:
         # published param copies so eval crossings cost the learner zero
         # grad steps (reference evaluator process, main.py:103-134).
         self._eval_thread: Optional[threading.Thread] = None
-        self._eval_req = None            # latest pending (params, step, scalars)
+        self._eval_req = None  # latest pending (params, step, scalars, env_steps)
         self._eval_req_lock = threading.Lock()
         self._eval_pending = threading.Event()
         self._eval_idle = threading.Event()
@@ -1365,11 +1365,13 @@ class Trainer:
                     self._eval_pending.clear()
                 if req is None:
                     continue
-                params, step, scalars = req
+                params, step, scalars, env_steps = req
                 ev = self._host_eval(eval_params=params)
                 # params is the REAL copy scored by this eval — exactly what
                 # keep-best must persist (the live params have moved on)
-                self._apply_eval(step, scalars, ev, params=params)
+                self._apply_eval(
+                    step, scalars, ev, params=params, env_steps=env_steps
+                )
                 with self._eval_req_lock:
                     if self._eval_req is None:
                         self._eval_idle.set()
@@ -1378,7 +1380,7 @@ class Trainer:
             self._eval_idle.set()  # never leave the end-of-train drain hanging
             raise
 
-    def _save_best(self, step: int, score: float, params) -> None:
+    def _save_best(self, step: int, score: float, params, env_steps: int) -> None:
         """Persist the champion actor params + score. Write-ordering: params
         first, JSON second — a crash can never leave best_eval.json claiming
         params that were never persisted (same discipline as on_device)."""
@@ -1391,9 +1393,17 @@ class Trainer:
                 f, **{f"leaf_{i:04d}": np.asarray(l) for i, l in enumerate(leaves)}
             )
         os.replace(tmp, os.path.join(ckpt_dir, "best_actor.npz"))
-        save_best_eval(self.config.log_dir, step, score, self.env_steps)
+        # env_steps is the value CAPTURED when the eval was enqueued, not
+        # self.env_steps — in concurrent-eval mode this runs on the
+        # evaluator thread while the collector mutates the live counter, so
+        # reading it here recorded a count from after the scored params
+        # (ADVICE round-4; metadata-only but the JSON should attest the
+        # snapshot it scored).
+        save_best_eval(self.config.log_dir, step, score, env_steps)
 
-    def _apply_eval(self, step: int, scalars: dict, ev: dict, params=None) -> None:
+    def _apply_eval(
+        self, step: int, scalars: dict, ev: dict, params=None, env_steps=None
+    ) -> None:
         """EWMA + log + print for one completed eval, at the step it was
         REQUESTED (the params it scored). Runs on the evaluator thread in
         concurrent mode (requests are processed one at a time in request
@@ -1412,7 +1422,12 @@ class Trainer:
             self._best_eval is None or ev["eval_return_mean"] > self._best_eval
         ):
             self._best_eval = ev["eval_return_mean"]
-            self._save_best(step, self._best_eval, params)
+            self._save_best(
+                step,
+                self._best_eval,
+                params,
+                self.env_steps if env_steps is None else env_steps,
+            )
         scalars = dict(scalars)
         scalars.update(ev)
         if self._best_eval is not None:
@@ -1446,10 +1461,12 @@ class Trainer:
         with self._eval_req_lock:
             replaced = self._eval_req
             self._eval_idle.clear()
-            self._eval_req = (params, self.grad_steps, scalars)
+            # env_steps captured HERE, on the learner thread at enqueue —
+            # the evaluator thread must not read the live counter later.
+            self._eval_req = (params, self.grad_steps, scalars, self.env_steps)
             self._eval_pending.set()
         if replaced is not None:
-            _, r_step, r_scalars = replaced
+            _, r_step, r_scalars, _ = replaced
             self.metrics.log(r_step, r_scalars)
 
     def _drain_eval(self, timeout: float = 600.0) -> None:

@@ -44,6 +44,17 @@ def test_train_step_runs_and_updates(kind):
     assert np.all(np.asarray(priorities) >= 0) or kind == "mixture_gaussian"
     for v in metrics.values():
         assert np.isfinite(float(v))
+    # Saturation monitor: q_support_frac is (q_mean - v_min)/(v_max - v_min)
+    # — the runtime tripwire for a clipped value distribution (the Humanoid
+    # v1500 post-mortem, VERDICT round-4 weak #1). Categorical head only:
+    # scalar/MoG heads are unbounded, so the ratio would be alarm noise.
+    if kind == "categorical":
+        expect = (float(metrics["q_mean"]) - config.dist.v_min) / (
+            config.dist.v_max - config.dist.v_min
+        )
+        assert float(metrics["q_support_frac"]) == pytest.approx(expect, rel=1e-5)
+    else:
+        assert "q_support_frac" not in metrics
     # params actually moved
     moved = jax.tree_util.tree_map(
         lambda a, b: float(jnp.abs(a - b).max()), state.critic_params, state2.critic_params
