@@ -21,6 +21,15 @@ the round-3 log). Run ``dmc_pixels:`` training with ``--num-envs 1`` so
 collection and eval each own ONE context inside the trainer process;
 state-feature ``dmc:`` envs never render and pool fine.
 
+UPDATE (measured, round 5): the single-env throughput wall that made the
+above hurt — 5-7.7 agent-steps/s in the round-4 pixels runs — was NOT
+EGL context overhead but llvmpipe (software GL) spending ~50-80 ms per
+render on the default shadow pass + MSAA resolve, independent of
+resolution. Pixel mode now renders with shadows/MSAA/reflections off
+(~2-5 ms, 16-27×; see ``__init__``), so single-env in-process collection
+sustains 100+ agent-steps/s and ``--num-envs 1`` is no longer a
+meaningful constraint on pixels throughput.
+
 dm_control tasks never terminate; episodes end by time limit only, reported
 as truncation (matching gym semantics where TimeLimit truncates).
 """
@@ -98,9 +107,26 @@ class DMControlAdapter:
         spec = self.env.action_spec()
         self._normalize = NormalizeAction(spec.minimum, spec.maximum)
         self.action_dim = int(np.prod(spec.shape))
+        self._render_kwargs = {}
         if pixels:
             self.pixel_shape = (size, size, 2)
             self.observation_dim = size * size * 2
+            # MEASURED on this image (round 5): the GL stack is llvmpipe
+            # (software), and MuJoCo's default shadow pass + MSAA resolve
+            # cost ~50-80 ms per 48×48 render — resolution-independent,
+            # pure fixed overhead, and the entire "single-env collection
+            # wall" of the round-4 pixels runs (5-7.7 steps/s). Killing
+            # shadows + multisampling + reflections drops a render to
+            # ~2-5 ms (16-27×). A 48×48 grayscale RL observation carries
+            # no useful shadow signal; published DrQ renders flat too.
+            vis = self.env.physics.model.vis
+            vis.quality.shadowsize = 0
+            vis.quality.offsamples = 0
+            self._render_kwargs = dict(
+                render_flag_overrides=dict(
+                    shadow=False, reflection=False, skybox=False, haze=False
+                )
+            )
         else:
             self.observation_dim = int(
                 sum(
@@ -114,7 +140,10 @@ class DMControlAdapter:
     # ------------------------------------------------------------------ obs
     def _render_gray(self) -> np.ndarray:
         rgb = self.env.physics.render(
-            height=self.size, width=self.size, camera_id=self.camera_id
+            height=self.size,
+            width=self.size,
+            camera_id=self.camera_id,
+            **self._render_kwargs,
         )
         return (rgb.astype(np.float32) / 255.0).mean(axis=-1)
 

@@ -1,0 +1,38 @@
+#!/bin/bash
+# Goal-dict robotics (gymnasium_robotics Fetch family) training legs —
+# sparse reward + HER, the env family the reference's active loop is
+# hardcoded around (reference main.py:144-148,161-184: obs['observation']
+# indexing, done from info['is_success'], env.compute_reward relabeling).
+# HER convention: n-step 1 (relabeled returns are recomputed per goal, so
+# n-step bootstrapping over relabeled rewards needs per-step recompute —
+# the reference relabels single transitions too, main.py:161-184).
+# Usage: bash runs/fetch_supervisor.sh ENV DIR [TOTAL_STEPS] [EXTRA...]
+#   e.g. bash runs/fetch_supervisor.sh FetchReach-v4 runs/fetchreach_her_tpu 30000
+#        bash runs/fetch_supervisor.sh FetchPush-v4 runs/fetchpush_her_tpu 300000
+#        bash runs/fetch_supervisor.sh FetchPush-v4 runs/fetchpush_noher_tpu 300000 --no-her
+ENV_ID=${1:?usage: fetch_supervisor.sh ENV DIR [TOTAL] [extra flags...]}
+DIR=${2:?usage: fetch_supervisor.sh ENV DIR [TOTAL] [extra flags...]}
+TOTAL=${3:-30000}
+shift 3 2>/dev/null || shift 2
+HER_FLAG="--her"
+EXTRA=()
+for a in "$@"; do
+  if [ "$a" = "--no-her" ]; then HER_FLAG=""; else EXTRA+=("$a"); fi
+done
+while :; do
+  STEP=$(ls "$DIR/checkpoints" 2>/dev/null | grep -E '^[0-9]+$' | sort -n | tail -1)
+  STEP=${STEP:-0}
+  REM=$((TOTAL - STEP))
+  if [ "$REM" -le 0 ]; then echo "supervisor: done at step $STEP"; break; fi
+  echo "supervisor: leg from step $STEP, $REM to go"
+  python train.py --env "$ENV_ID" $HER_FLAG --n-step 1 --num-envs 8 \
+    --async-collect --total-steps "$REM" --warmup 1000 \
+    --lr-actor 1e-3 --lr-critic 1e-3 \
+    --eval-interval 2000 --eval-episodes 20 \
+    --checkpoint-interval 10000 --snapshot-replay --resume \
+    --max-rss-gb 80 --log-dir "$DIR" "${EXTRA[@]}"
+  RC=$?
+  if [ "$RC" -ne 75 ] && [ "$RC" -ne 0 ]; then
+    echo "supervisor: leg failed rc=$RC"; exit "$RC"
+  fi
+done
