@@ -121,6 +121,17 @@ class GymAdapter:
         obs, reward, terminated, truncated, info = self.env.step(
             self._normalize.to_env(np.asarray(action))
         )
+        if self.is_goal_env and not terminated:
+            # The reference takes done from info['is_success'] for goal envs
+            # (main.py:144-148): success TERMINATES the episode. This is
+            # load-bearing for the sparse -1/0 value structure — the Fetch
+            # envs themselves never terminate, and without success-cuts the
+            # infinite-horizon value of "stuck far from goal" is
+            # -1/(1-gamma) = -100, outside the [-horizon, 0] support the
+            # bounded-episode convention implies. It also matches the HER
+            # writer's done_on_success=True relabel convention
+            # (replay/her.py), which the original trajectory must share.
+            terminated = bool(info.get("is_success", False))
         return self._flatten(obs), float(reward), bool(terminated), bool(truncated), info
 
     def compute_reward(self, achieved_goal, desired_goal) -> float:
