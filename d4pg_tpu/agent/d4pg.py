@@ -185,6 +185,37 @@ def act_deterministic(config: D4PGConfig, actor_params: Any, obs: jax.Array) -> 
     return actor.apply(actor_params, obs)
 
 
+def noisy_explore(config: D4PGConfig, noise_sample, a, key, nstate, scale):
+    """Shared collection-action builder used by EVERY collection path
+    (host/pool/HER closures in runtime/trainer.py and the segment collector
+    in runtime/collect.py): additive noise + clip, then the ε-uniform
+    mixture. Key discipline: the mixture key is split off ONLY when
+    random_eps > 0, so eps=0 configs keep the exact pre-round-5 noise
+    stream — seed-for-seed reproducibility against recorded baselines."""
+    if config.random_eps:
+        key, ke = jax.random.split(key)
+    n, nstate = noise_sample(nstate, key, a.shape)
+    a = jnp.clip(a + scale * n, -1.0, 1.0)
+    if config.random_eps:
+        a = exploration_mixture(config, ke, a)
+    return a, nstate
+
+
+def exploration_mixture(config: D4PGConfig, key: jax.Array, a: jax.Array) -> jax.Array:
+    """ε-uniform action mixture for collection (HER-DDPG, Andrychowicz et
+    al. 2017 §4.4): with probability ``config.random_eps`` the WHOLE action
+    vector is replaced by a uniform draw from the box. Complements Gaussian
+    noise, which cannot escape a saturated tanh corner (clip pins most of
+    its mass there). Identity when random_eps == 0 (every non-goal config).
+    Broadcasting: ``a`` is [..., act_dim]; one Bernoulli per action vector."""
+    if not config.random_eps:
+        return a
+    ku, kb = jax.random.split(key)
+    u = jax.random.uniform(ku, a.shape, minval=-1.0, maxval=1.0)
+    take = jax.random.bernoulli(kb, config.random_eps, a.shape[:-1] + (1,))
+    return jnp.where(take, u, a)
+
+
 def _critic_value(config: D4PGConfig, support, head: jax.Array) -> jax.Array:
     """E[Z] under whichever head the critic is configured with."""
     kind = config.dist.kind
@@ -375,9 +406,24 @@ def train_step(
     def actor_loss_fn(actor_params):
         a = actor.apply(actor_params, batch["obs"])
         head = critic.apply(actor_critic_params, batch["obs"], a)
-        return -jnp.mean(_critic_value(config, support, head))
+        q_mean = jnp.mean(_critic_value(config, support, head))
+        loss = -q_mean
+        if config.action_l2:
+            # HER-DDPG action regularizer (Andrychowicz et al. 2017, §4.4:
+            # the "square of the preactivations" penalty): counters the
+            # tanh-corner collapse sparse goal tasks induce — the critic's
+            # dQ/da rarely flips sign early, so unregularized ascent
+            # saturates the actor (observed on FetchReach round 5: constant
+            # [-1,1,-1,-1] policy fleeing the goal). Penalizing post-tanh
+            # squares is equivalent in effect near the corners.
+            loss = loss + config.action_l2 * jnp.mean(jnp.square(a))
+        # aux carries the UNpenalized E[Q]: q_mean / q_support_frac metrics
+        # must stay comparable across action_l2 settings.
+        return loss, q_mean
 
-    actor_loss, actor_grads = jax.value_and_grad(actor_loss_fn)(state.actor_params)
+    (actor_loss, batch_q_mean), actor_grads = jax.value_and_grad(
+        actor_loss_fn, has_aux=True
+    )(state.actor_params)
     actor_grads = _sync(actor_grads)
     actor_updates, actor_opt_state = actor_opt.update(
         actor_grads, state.actor_opt_state
@@ -406,7 +452,9 @@ def train_step(
         "critic_loss": critic_loss / 2 if config.twin_critic else critic_loss,
         "actor_loss": actor_loss,
         "priority_mean": jnp.mean(priorities),
-        "q_mean": -actor_loss,
+        # From the loss aux, NOT -actor_loss: with action_l2 the loss
+        # carries the penalty term and would understate E[Q].
+        "q_mean": batch_q_mean,
     }
     if config.dist.kind == "categorical":
         # Support-saturation monitor: fraction of the categorical support
@@ -417,7 +465,7 @@ def train_step(
         # value distribution; widen v_max. Categorical head only: the
         # scalar and MoG heads are unbounded, so the ratio would be an
         # alarm with no referent there.
-        step_metrics["q_support_frac"] = (-actor_loss - config.dist.v_min) / (
+        step_metrics["q_support_frac"] = (batch_q_mean - config.dist.v_min) / (
             config.dist.v_max - config.dist.v_min
         )
     metrics = _sync(step_metrics)

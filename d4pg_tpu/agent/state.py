@@ -60,6 +60,13 @@ class D4PGConfig:
     # reference's effective behavior — its ε-decay never fires, quirk #10)
     noise_decay_steps: int = 0
     noise_scale_final: float = 0.1
+    # HER-DDPG additions (Andrychowicz et al. 2017, §4.4) — both default
+    # OFF so every non-goal config is byte-identical to before:
+    # with probability random_eps a collection action is replaced by a
+    # uniform draw from the action box (the anti-corner-collapse mixture),
+    # and action_l2 penalizes mean(a^2) in the actor loss.
+    random_eps: float = 0.0
+    action_l2: float = 0.0
     # PER
     prioritized: bool = True
     per_alpha: float = 0.6

@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from d4pg_tpu.agent import act_deterministic
-from d4pg_tpu.agent.d4pg import make_noise
+from d4pg_tpu.agent.d4pg import make_noise, noisy_explore
 from d4pg_tpu.agent.state import D4PGConfig
 from d4pg_tpu.envs.rollouts import rollout
 from d4pg_tpu.ops import nstep_returns
@@ -67,8 +67,7 @@ def make_segment_collector(
     def collect(actor_params, env_states, obs, noise_states, key, noise_scale):
         def policy(o, k, nstate):
             a = act_deterministic(config, actor_params, o[None])[0]
-            n, nstate = noise_sample(nstate, k, a.shape)
-            return jnp.clip(a + noise_scale * n, -1.0, 1.0), nstate
+            return noisy_explore(config, noise_sample, a, k, nstate, noise_scale)
 
         def one(env_state, o, nstate, k):
             return rollout(

@@ -37,7 +37,7 @@ from d4pg_tpu.agent import (
     create_train_state,
     jit_train_step,
 )
-from d4pg_tpu.agent.d4pg import fused_train_scan, make_noise
+from d4pg_tpu.agent.d4pg import fused_train_scan, make_noise, noisy_explore
 from d4pg_tpu.config import ENV_PRESETS, TrainConfig
 from d4pg_tpu.envs import make_env
 from d4pg_tpu.envs.pointmass_goal import PointMassGoal
@@ -530,8 +530,7 @@ class Trainer:
 
         def host_act(params, o, k, nstate, scale):
             a = act_deterministic(agent_cfg, params, o)[0]
-            n, nstate = noise_sample(nstate, k, a.shape)
-            return jnp.clip(a + scale * n, -1.0, 1.0), nstate
+            return noisy_explore(agent_cfg, noise_sample, a, k, nstate, scale)
 
         self._host_act = self._act_jit(host_act)
         self._host_noise = self._to_act_device(self._noise_init())
@@ -595,8 +594,7 @@ class Trainer:
             keys = jax.random.split(key, obs.shape[0])
 
             def one(ai, k, nst):
-                n, nst = noise_sample(nst, k, ai.shape)
-                return jnp.clip(ai + scale * n, -1.0, 1.0), nst
+                return noisy_explore(agent_cfg, noise_sample, ai, k, nst, scale)
 
             return jax.vmap(one)(a, keys, nstates)
 
@@ -885,8 +883,7 @@ class Trainer:
 
         def her_act(params, o, k, nstate, scale):
             a = act_deterministic(agent_cfg, params, o)[0]
-            n, nstate = noise_sample(nstate, k, a.shape)
-            return jnp.clip(a + scale * n, -1.0, 1.0), nstate
+            return noisy_explore(agent_cfg, noise_sample, a, k, nstate, scale)
 
         if her_on_host:
             self._her_act = self._act_jit(her_act)
@@ -907,8 +904,9 @@ class Trainer:
                 def body(carry, k):
                     state, obs, nstate = carry
                     a = act_deterministic(agent_cfg, params, obs[None])[0]
-                    n, nstate = noise_sample(nstate, k, a.shape)
-                    a = jnp.clip(a + scale * n, -1.0, 1.0)
+                    a, nstate = noisy_explore(
+                        agent_cfg, noise_sample, a, k, nstate, scale
+                    )
                     g0 = env.goal_obs(state)
                     state2, obs2, r, term, trunc = env.step(state, a)
                     g1 = env.goal_obs(state2)

@@ -25,9 +25,14 @@ while :; do
   REM=$((TOTAL - STEP))
   if [ "$REM" -le 0 ]; then echo "supervisor: done at step $STEP"; break; fi
   echo "supervisor: leg from step $STEP, $REM to go"
+  # --random-eps/--action-l2: the HER-DDPG exploration mixture + action
+  # regularizer (Andrychowicz et al. 2017 §4.4). Measured necessary round
+  # 5: without them FetchReach's actor collapses to a saturated tanh
+  # corner (constant [-1,1,-1,-1], success pinned ~5%).
   python train.py --env "$ENV_ID" $HER_FLAG --n-step 1 --num-envs 8 \
     --async-collect --total-steps "$REM" --warmup 1000 \
     --lr-actor 1e-3 --lr-critic 1e-3 \
+    --random-eps 0.3 --action-l2 1.0 \
     --eval-interval 2000 --eval-episodes 20 \
     --checkpoint-interval 10000 --snapshot-replay --resume \
     --max-rss-gb 80 --log-dir "$DIR" "${EXTRA[@]}"

@@ -1,5 +1,7 @@
 """Tests for the fused train step: shapes, learning signal, all critic heads."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -60,6 +62,49 @@ def test_train_step_runs_and_updates(kind):
         lambda a, b: float(jnp.abs(a - b).max()), state.critic_params, state2.critic_params
     )
     assert max(jax.tree_util.tree_leaves(moved)) > 0
+
+
+def test_exploration_mixture():
+    """HER-DDPG ε-uniform mixture (round 5): identity at eps=0, full
+    replacement at eps=1, whole-vector replacement (never per-dim)."""
+    from d4pg_tpu.agent.d4pg import exploration_mixture
+
+    base = D4PGConfig(obs_dim=3, action_dim=4)
+    a = jnp.full((16, 4), 0.5)
+    k = jax.random.PRNGKey(0)
+    assert exploration_mixture(base, k, a) is a  # eps=0: no-op, same object
+    cfg1 = dataclasses.replace(base, random_eps=1.0)
+    out = np.asarray(exploration_mixture(cfg1, k, a))
+    assert not np.any(out == 0.5) and np.all(np.abs(out) <= 1.0)
+    cfg03 = dataclasses.replace(base, random_eps=0.3)
+    out = np.asarray(exploration_mixture(cfg03, jax.random.PRNGKey(1), a))
+    replaced = ~np.all(out == 0.5, axis=-1)
+    kept = np.all(out == 0.5, axis=-1)
+    # whole vectors: each row is either fully original or fully resampled
+    # (uniform draws almost surely never hit exactly 0.5)
+    assert np.all(replaced | kept) and replaced.any() and kept.any()
+
+
+def test_action_l2_regularizes_and_keeps_q_mean_honest():
+    """action_l2 must change the actor update AND leave the q_mean metric
+    reporting the unpenalized E[Q] (the support-saturation monitor feeds
+    off it)."""
+    base = D4PGConfig(obs_dim=3, action_dim=1, hidden_sizes=(32, 32))
+    reg = dataclasses.replace(base, action_l2=1.0)
+    rng = np.random.default_rng(0)
+    batch = _batch(rng)
+    s0 = create_train_state(base, jax.random.PRNGKey(0))
+    s0r = create_train_state(reg, jax.random.PRNGKey(0))
+    st_b, m_b, _ = jit_train_step(base, donate=False)(s0, batch)
+    st_r, m_r, _ = jit_train_step(reg, donate=False)(s0r, batch)
+    # same init, same batch: penalty shifts the loss by ~mean(a^2) but the
+    # reported q_mean (aux) must match the unregularized one exactly
+    assert float(m_r["actor_loss"]) != pytest.approx(float(m_b["actor_loss"]))
+    assert float(m_r["q_mean"]) == pytest.approx(float(m_b["q_mean"]), rel=1e-5)
+    moved = jax.tree_util.tree_map(
+        lambda a, b: float(jnp.abs(a - b).max()), st_b.actor_params, st_r.actor_params
+    )
+    assert max(jax.tree_util.tree_leaves(moved)) > 0  # different updates
 
 
 @pytest.mark.parametrize("kind", ["categorical", "scalar", "mixture_gaussian"])

@@ -61,6 +61,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "--noise-scale-final (0 = constant, the reference's "
                         "effective behavior, SURVEY.md quirk #10)")
     p.add_argument("--noise-scale-final", type=float, default=0.1)
+    p.add_argument("--random-eps", type=float, default=0.0,
+                   help="HER-DDPG exploration mixture: probability of "
+                        "replacing a collection action with a uniform draw "
+                        "from the box (Andrychowicz et al. 2017 §4.4; "
+                        "breaks the tanh-corner collapse on sparse goal "
+                        "tasks). 0 = off")
+    p.add_argument("--action-l2", type=float, default=0.0,
+                   help="actor-loss coefficient on mean(a^2) (HER-DDPG "
+                        "action regularizer, same paper). 0 = off")
     # TPU-native flags
     p.add_argument("--num-envs", type=int, default=16,
                    help="vectorized on-device exploration envs, or host actor "
@@ -190,6 +199,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         noise_epsilon=args.noise_epsilon,
         noise_decay_steps=args.noise_decay_steps,
         noise_scale_final=args.noise_scale_final,
+        random_eps=args.random_eps,
+        action_l2=args.action_l2,
         ou_theta=args.ou_theta,
         ou_sigma=args.ou_sigma,
         ou_mu=args.ou_mu,
