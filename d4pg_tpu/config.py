@@ -33,6 +33,11 @@ class TrainConfig:
     num_envs: int = 16                 # vectorized on-device actors
     her: bool = False                  # hindsight relabeling (goal envs)
     her_k: int = 4
+    # Running observation normalization at the data boundary (HER-DDPG,
+    # ops/obs_norm.py): clip((x−μ)/σ, ±5) applied to training batches and
+    # acting/eval forwards, Welford stats updated per sampled batch.
+    # Host (gymnasium/dm_control state) envs only; default off.
+    obs_norm: bool = False
 
     # run shape (reference: epochs × 50 cycles × (16 episodes + 40 steps))
     total_steps: int = 100_000         # learner grad steps

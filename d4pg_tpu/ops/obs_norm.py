@@ -1,0 +1,90 @@
+"""Running observation normalization (HER-DDPG, Andrychowicz et al. 2017).
+
+clip((x − μ)/σ, ±clip_range) with Welford running statistics — the
+ingredient the HER paper pairs with sparse Fetch tasks beyond Reach (their
+§4.1 implementation details; OpenAI-baselines HER updates the normalizer
+from each sampled training batch, which is the convention here too: one
+choke point, and the statistics match the data the networks actually see).
+
+Host-side NumPy by design: normalization lives at the trainer's data
+boundary (batches before device_put, observations before acting/eval
+forwards), so no TrainState, train_step, or acting-path signature changes
+— and the jitted programs stay byte-identical when the feature is off.
+The reference has no counterpart (its normalize_env.py scales actions
+only); this is a capability flag, default off.
+
+Thread-safety note: the async collector thread reads statistics while the
+learner thread updates them. ``update`` publishes ONE ``_stats`` tuple
+``(mean_f32, std_f32)`` built after all math completes, and ``normalize``
+reads that tuple exactly once — so a reader always sees a matched
+(mean, std) pair from the same update, never a torn mix of two updates
+(CPython attribute assignment is atomic). Staleness of one update is the
+same class as published actor params and harmless for normalization.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class RunningObsNorm:
+    """Welford running mean/variance over observation vectors."""
+
+    def __init__(self, dim: int, clip_range: float = 5.0, eps: float = 1e-2):
+        self.dim = int(dim)
+        self.clip_range = float(clip_range)
+        # eps floors the std (paper: 1e-2) so near-constant dims don't
+        # explode the normalized scale before statistics accumulate.
+        self.eps = float(eps)
+        self.count = 0.0
+        self.mean = np.zeros(dim, np.float64)
+        self._m2 = np.zeros(dim, np.float64)
+        self.std = np.ones(dim, np.float64)
+        self._stats = (
+            self.mean.astype(np.float32),
+            self.std.astype(np.float32),
+        )
+
+    def update(self, x: np.ndarray) -> None:
+        """Fold a batch [N, dim] (or single [dim]) into the statistics."""
+        x = np.asarray(x, np.float64).reshape(-1, self.dim)
+        n = x.shape[0]
+        if n == 0:
+            return
+        b_mean = x.mean(axis=0)
+        b_m2 = ((x - b_mean) ** 2).sum(axis=0)
+        # Chan et al. parallel-Welford merge of (count, mean, M2) pairs.
+        total = self.count + n
+        delta = b_mean - self.mean
+        mean = self.mean + delta * (n / total)
+        m2 = self._m2 + b_m2 + delta**2 * (self.count * n / total)
+        std = np.sqrt(np.maximum(m2 / total, 0.0))
+        self.mean, self._m2, self.std, self.count = mean, m2, std, total
+        # Single atomic publication AFTER all math (see thread-safety note).
+        self._stats = (mean.astype(np.float32), std.astype(np.float32))
+
+    def normalize(self, x: np.ndarray) -> np.ndarray:
+        """clip((x − μ)/max(σ, eps), ±clip_range), float32."""
+        mean, std = self._stats  # one read: matched pair, never torn
+        x = np.asarray(x, np.float32)
+        out = (x - mean) / np.maximum(std, self.eps)
+        return np.clip(out, -self.clip_range, self.clip_range)
+
+    # ------------------------------------------------------------ persistence
+    def state_dict(self) -> dict:
+        return {
+            "count": float(self.count),
+            "mean": self.mean.tolist(),
+            "m2": self._m2.tolist(),
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        self.count = float(state["count"])
+        self.mean = np.asarray(state["mean"], np.float64)
+        self._m2 = np.asarray(state["m2"], np.float64)
+        self.std = (
+            np.sqrt(np.maximum(self._m2 / self.count, 0.0))
+            if self.count > 0
+            else np.ones(self.dim, np.float64)
+        )
+        self._stats = (self.mean.astype(np.float32), self.std.astype(np.float32))

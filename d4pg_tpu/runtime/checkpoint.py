@@ -62,15 +62,19 @@ def trainer_meta_path(log_dir: str) -> str:
     return os.path.join(log_dir, "checkpoints", "trainer_meta.json")
 
 
-def save_trainer_meta(log_dir: str, env_steps: int, ewma_return) -> None:
+def save_trainer_meta(log_dir: str, env_steps: int, ewma_return, extra=None) -> None:
     """Atomically persist the host-side counters the device TrainState does
     not carry (env_steps drives schedules; ewma keeps curves continuous).
     Shared by the host Trainer and the on-device driver so their resume
-    metadata stays mutually readable."""
+    metadata stays mutually readable. ``extra`` merges additional host
+    state (e.g. the obs-normalizer statistics) into the same file."""
     path = trainer_meta_path(log_dir)
     tmp = path + ".tmp"
+    meta = {"env_steps": env_steps, "ewma_return": ewma_return}
+    if extra:
+        meta.update(extra)
     with open(tmp, "w") as f:
-        json.dump({"env_steps": env_steps, "ewma_return": ewma_return}, f)
+        json.dump(meta, f)
     os.replace(tmp, path)
 
 

@@ -378,6 +378,15 @@ def run_on_device(config) -> dict:
     """
     import time
 
+    if getattr(config, "obs_norm", False):
+        # Guard at the entry point, not just the CLI: a programmatic
+        # TrainConfig(obs_norm=True) must not be silently ignored (the
+        # on-device path keeps observations inside jit).
+        raise ValueError(
+            "obs_norm is a host data-boundary feature; the on-device path "
+            "does not support it"
+        )
+
     from d4pg_tpu.agent import create_train_state
     from d4pg_tpu.envs import make_env
     from d4pg_tpu.replay import noise_scale_schedule

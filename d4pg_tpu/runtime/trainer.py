@@ -38,6 +38,7 @@ from d4pg_tpu.agent import (
     jit_train_step,
 )
 from d4pg_tpu.agent.d4pg import fused_train_scan, make_noise, noisy_explore
+from d4pg_tpu.ops.obs_norm import RunningObsNorm
 from d4pg_tpu.config import ENV_PRESETS, TrainConfig
 from d4pg_tpu.envs import make_env
 from d4pg_tpu.envs.pointmass_goal import PointMassGoal
@@ -174,6 +175,15 @@ class Trainer:
         config = _reconcile_config(config, self.env)
         self.config = config
         self.is_jax_env = not hasattr(self.env, "last_goal_obs")
+        self.obs_norm = None
+        if config.obs_norm:
+            if self.is_jax_env or config.agent.pixel_shape:
+                raise ValueError(
+                    "--obs-norm supports host state-feature envs only "
+                    "(pure-JAX envs act inside jit; pixel obs are uint8 "
+                    "frames the conv encoder already scales)"
+                )
+            self.obs_norm = RunningObsNorm(config.agent.obs_dim)
         agent_cfg = config.agent
 
         # replay — pixel observations are stored uint8-quantized (4× less
@@ -330,6 +340,23 @@ class Trainer:
             # resumed run would re-explore at full scale
             self.env_steps = int(m.get("env_steps", 0))
             self.ewma_return = m.get("ewma_return")
+            # Flag/meta mismatch is a hard error in BOTH directions: a
+            # net trained on normalized obs resumed without the flag (or
+            # with from-scratch stats) sees inputs 10-100x off its trained
+            # scale and silently collapses.
+            if self.obs_norm is not None:
+                if "obs_norm" not in m:
+                    raise ValueError(
+                        "--obs-norm resume: checkpoint has no saved "
+                        "normalizer statistics (was the run trained "
+                        "without --obs-norm?)"
+                    )
+                self.obs_norm.load_state_dict(m["obs_norm"])
+            elif "obs_norm" in m:
+                raise ValueError(
+                    "checkpoint was trained WITH --obs-norm; resuming "
+                    "without it would feed the nets un-normalized inputs"
+                )
             best_json = best_eval_path(config.log_dir)
             if os.path.exists(
                 os.path.join(config.log_dir, "checkpoints", "best_actor.npz")
@@ -545,7 +572,7 @@ class Trainer:
             self._host_key, k = jax.random.split(self._host_key)
             a_dev, self._host_noise = self._host_act(
                 params,
-                np.asarray(self._host_obs)[None],
+                self._ingest_obs(np.asarray(self._host_obs))[None],
                 k,
                 self._host_noise,
                 scale,
@@ -625,7 +652,7 @@ class Trainer:
             self._collect_key, k = jax.random.split(self._collect_key)
             a_dev, self._pool_noise = self._pool_act(
                 params,
-                np.asarray(self._pool_obs),
+                self._ingest_obs(np.asarray(self._pool_obs)),
                 k,
                 self._pool_noise,
                 scale,
@@ -986,7 +1013,7 @@ class Trainer:
             g0 = env.last_goal_obs
             self._her_key, ak = jax.random.split(self._her_key)
             a_dev, self._her_noise = self._her_act(
-                params, np.asarray(obs)[None], ak,
+                params, self._ingest_obs(np.asarray(obs))[None], ak,
                 self._her_noise, scale,
             )
             a = np.asarray(a_dev)
@@ -1051,7 +1078,29 @@ class Trainer:
             else:
                 batch = dict(self.buffer.sample(self.config.batch_size, self._rng))
                 batch["weights"] = np.ones(self.config.batch_size, np.float32)
+        if self.obs_norm is not None:
+            # Normalize ONLY — statistics are ingested at collection time
+            # (_ingest_obs), once per observed env step. Folding sampled
+            # batches instead would double-count PER-favored transitions
+            # and keep the stats drifting with priorities even over a
+            # static buffer.
+            batch = dict(batch)
+            batch["obs"] = self.obs_norm.normalize(batch["obs"])
+            batch["next_obs"] = self.obs_norm.normalize(batch["next_obs"])
         return batch
+
+    def _norm_obs(self, x: np.ndarray) -> np.ndarray:
+        """Read-only normalizer view for eval forwards (identity when off)."""
+        return x if self.obs_norm is None else self.obs_norm.normalize(x)
+
+    def _ingest_obs(self, x: np.ndarray) -> np.ndarray:
+        """Collection-side view: fold the observed obs into the running
+        statistics (once per env step — the distribution the stats should
+        track), then return the normalized copy the policy acts on."""
+        if self.obs_norm is None:
+            return x
+        self.obs_norm.update(x)
+        return self.obs_norm.normalize(x)
 
     def train(self, total_steps: Optional[int] = None) -> dict:
         """Run the full loop; returns final metrics."""
@@ -1251,7 +1300,16 @@ class Trainer:
         # Host-side counters the device TrainState doesn't carry: env_steps
         # drives the noise-decay schedule, so without it every --resume
         # would restart exploration at full scale.
-        save_trainer_meta(self.config.log_dir, self.env_steps, self.ewma_return)
+        save_trainer_meta(
+            self.config.log_dir,
+            self.env_steps,
+            self.ewma_return,
+            extra=(
+                {"obs_norm": self.obs_norm.state_dict()}
+                if self.obs_norm is not None
+                else None
+            ),
+        )
         if self.config.snapshot_replay:
             # Apply in-flight async priority updates first, else the snapshot
             # freezes priorities the flusher was about to overwrite.
@@ -1299,7 +1357,7 @@ class Trainer:
         if eval_params is None:
             eval_params = self._eval_params()
         for _ in range(cfg.max_episode_steps or 1000):
-            a = np.asarray(eval_act(eval_params, np.asarray(obs)))
+            a = np.asarray(eval_act(eval_params, self._norm_obs(np.asarray(obs))))
             obs2, r, term, trunc, pol_obs, s, s_rep = self._eval_pool.step(a)
             rets += r * alive
             # final-step semantics, matching the single-env path: the
@@ -1532,7 +1590,9 @@ class Trainer:
             obs = env.reset()
             ep_ret, term, trunc = 0.0, False, False
             for _ in range(cfg.max_episode_steps or 1000):
-                a = np.asarray(eval_act(eval_params, np.asarray(obs)[None])[0])
+                a = np.asarray(
+                    eval_act(eval_params, self._norm_obs(np.asarray(obs))[None])[0]
+                )
                 obs, r, term, trunc, info = env.step(a)
                 ep_ret += r
                 if term or trunc:

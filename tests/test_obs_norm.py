@@ -1,0 +1,102 @@
+"""Running observation normalization (ops/obs_norm.py) — HER-DDPG's
+clip((x−μ)/σ, ±5) at the trainer's data boundary (round 5; the reference
+has no counterpart, its normalize_env.py scales actions only)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from d4pg_tpu.ops.obs_norm import RunningObsNorm
+
+
+def test_welford_matches_numpy_in_any_batch_split():
+    rng = np.random.default_rng(0)
+    data = rng.normal(3.0, 2.5, size=(1000, 7)) * np.linspace(0.1, 10, 7)
+    norm = RunningObsNorm(7)
+    # uneven incremental batches must reach the same moments as one pass
+    for chunk in np.array_split(data, [13, 100, 101, 500, 999]):
+        norm.update(chunk)
+    assert norm.count == 1000
+    np.testing.assert_allclose(norm.mean, data.mean(axis=0), rtol=1e-10)
+    np.testing.assert_allclose(norm.std, data.std(axis=0), rtol=1e-10)
+
+
+def test_normalize_clips_and_floors_std():
+    norm = RunningObsNorm(2, clip_range=5.0, eps=1e-2)
+    # dim 0 varies, dim 1 is constant (std 0 → eps floor, no div-by-zero)
+    norm.update(np.array([[0.0, 4.0], [2.0, 4.0], [4.0, 4.0]]))
+    out = norm.normalize(np.array([1000.0, 4.0]))
+    assert out[0] == 5.0  # clipped
+    assert out[1] == 0.0  # (4-4)/eps = 0
+    assert out.dtype == np.float32
+
+
+def test_state_roundtrip():
+    rng = np.random.default_rng(1)
+    norm = RunningObsNorm(4)
+    norm.update(rng.normal(size=(57, 4)))
+    fresh = RunningObsNorm(4)
+    fresh.load_state_dict(norm.state_dict())
+    np.testing.assert_allclose(fresh.mean, norm.mean)
+    np.testing.assert_allclose(fresh.std, norm.std)
+    assert fresh.count == norm.count
+    x = rng.normal(size=(3, 4))
+    np.testing.assert_array_equal(fresh.normalize(x), norm.normalize(x))
+
+
+def test_trainer_obs_norm_end_to_end(tmp_path):
+    """Pendulum-v1 through the host single-env path with --obs-norm: stats
+    accumulate from sampled batches, acting/eval consume normalized obs,
+    and the meta file persists the statistics for resume."""
+    pytest.importorskip("gymnasium")
+    from train import build_parser, config_from_args
+    from d4pg_tpu.runtime import Trainer
+
+    args = build_parser().parse_args(
+        [
+            "--env", "Pendulum-v1",
+            "--obs-norm",
+            "--num-envs", "1",
+            "--total-steps", "30",
+            "--warmup", "40",
+            "--eval-interval", "30",
+            "--eval-episodes", "1",
+            "--max-steps", "50",
+            "--checkpoint-interval", "30",
+            "--bsize", "16",
+            "--no-concurrent-eval",
+            "--log-dir", str(tmp_path / "run"),
+        ]
+    )
+    cfg = config_from_args(args)
+    cfg = dataclasses.replace(
+        cfg, agent=dataclasses.replace(cfg.agent, hidden_sizes=(32, 32))
+    )
+    trainer = Trainer(cfg)
+    trainer.warmup()
+    # stats ingest at COLLECTION time: warmup already observed env steps
+    assert trainer.obs_norm is not None
+    assert trainer.obs_norm.count == trainer.env_steps > 0
+    trainer.train(total_steps=30)
+    trainer.close()
+    # one stats fold per observed env step, never per sampled batch
+    # (PER resampling must not double-count — review round 5)
+    assert trainer.obs_norm.count == trainer.env_steps
+    import json, os
+
+    meta = json.load(
+        open(os.path.join(cfg.log_dir, "checkpoints", "trainer_meta.json"))
+    )
+    assert meta["obs_norm"]["count"] == trainer.env_steps
+
+
+def test_on_device_rejects_obs_norm():
+    """The guard lives in run_on_device itself, so programmatic configs
+    (not just the CLI) are covered."""
+    from d4pg_tpu.config import TrainConfig, apply_env_preset
+    from d4pg_tpu.runtime.on_device import run_on_device
+
+    cfg = apply_env_preset(TrainConfig(env="pendulum", obs_norm=True))
+    with pytest.raises(ValueError, match="obs_norm"):
+        run_on_device(cfg)

@@ -70,6 +70,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--action-l2", type=float, default=0.0,
                    help="actor-loss coefficient on mean(a^2) (HER-DDPG "
                         "action regularizer, same paper). 0 = off")
+    p.add_argument("--obs-norm", action="store_true",
+                   help="running observation normalization at the data "
+                        "boundary: clip((x-mean)/std, +-5), Welford stats "
+                        "per sampled batch (HER-DDPG convention; host "
+                        "state-feature envs only)")
     # TPU-native flags
     p.add_argument("--num-envs", type=int, default=16,
                    help="vectorized on-device exploration envs, or host actor "
@@ -221,6 +226,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         num_envs=args.num_envs,
         her=args.her,
         her_k=args.her_k,
+        obs_norm=args.obs_norm,
         async_collect=args.async_collect,
         publish_interval=args.publish_interval,
         total_steps=args.total_steps,
@@ -301,6 +307,12 @@ def main(argv=None) -> None:
                 "--transfer-dtype is a HOST-path link optimization; "
                 "--on-device envs never transfer batches (the flag would "
                 "be silently ignored)"
+            )
+        if args.obs_norm:
+            raise SystemExit(
+                "--obs-norm is a host data-boundary feature; the on-device "
+                "path keeps observations inside jit (the flag would be "
+                "silently ignored)"
             )
         from d4pg_tpu.runtime.on_device import run_on_device
 
