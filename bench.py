@@ -32,14 +32,45 @@ PINNED PROTOCOL (the ratio is only comparable under these conditions):
   loop cannot run standalone — its replay writes are gated on HER
   (SURVEY.md quirk #14) so the buffer stays empty and ``train()`` crashes.
   Always carry this caveat next to the headline ratio.
+
+The line also carries the round-6 roofline-attack comparisons, all under
+the same pinned protocol: fused Pallas projection+loss vs the XLA oracle
+(steps/s + XLA-accounted bytes per grad step, both dtypes) and the host
+replay→device pipeline with the double-buffered prefetch off/on.
+
+When the default backend fails to initialize (wedged tunnel), the output
+is ONE parseable ``{"error": "tpu_unreachable"}`` JSON line, never a raw
+traceback; the chip-independent regression guard is
+``benchmarks/fused_microbench.py`` (committed artifact
+``benchmarks/cpu_microbench.json``).
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
+
+
+def _probe_default_backend() -> str | None:
+    """Default-backend platform name, probed in a subprocess; None on failure.
+
+    A wedged TPU tunnel has been observed to raise (BENCH_r05: backend
+    setup error), hang ``jax.devices()`` outright (MULTICHIP_r05 rc=124),
+    or fail fast so jax silently falls back to the CPU backend (round 6 —
+    which would grind the full TPU protocol on one CPU core until the
+    driver's timeout). The shared subprocess probe
+    (``d4pg_tpu.utils.backend_probe``) shields this process from the first
+    two; the caller detects the third from the returned platform name.
+    Either way the driver gets ONE parseable
+    ``{"error": "tpu_unreachable"}`` line, never a traceback/timeout kill.
+    """
+    from d4pg_tpu.utils.backend_probe import probe_default_backend
+
+    platform, _ = probe_default_backend()
+    return platform
 
 
 BATCH = 256
@@ -104,6 +135,7 @@ def bench_tpu(
     warmup: int = WARMUP_DISPATCHES,
     measure: int = MEASURE_DISPATCHES,
     pool_rows: int = 65_536,
+    projection_backend: str = "xla",
 ) -> dict:
     """Learner throughput the TPU-native way: K train steps fused into one
     XLA program via ``lax.scan`` (as the on-device trainer runs them,
@@ -139,6 +171,7 @@ def bench_tpu(
         pixel_shape=pixel_shape,
         dist=DistConfig(kind="categorical", num_atoms=ATOMS, v_min=V_MIN, v_max=V_MAX),
         compute_dtype=compute_dtype,
+        projection_backend=projection_backend,
     )
     state = create_train_state(config, jax.random.PRNGKey(0))
     rng = np.random.default_rng(0)
@@ -245,6 +278,85 @@ def bench_tpu(
     return out
 
 
+def bench_host_pipeline(
+    prefetch: bool,
+    *,
+    steps: int = 300,
+    batch: int = BATCH,
+    compute_dtype: str = "bfloat16",
+    rows: int = 65_536,
+) -> float:
+    """Grad-steps/s of the HOST replay→device pipeline, prefetch on/off.
+
+    Measures exactly the loop the host trainer runs per K=1 dispatch —
+    PER stratified sample (C++ sum tree when built), ``device_put``,
+    jitted train step, priority write-back with the one-step lag — with
+    ``prefetch=True`` adding the double buffer: batch N+1 is sampled and
+    its H2D copy started while step N runs (``runtime/trainer.py``'s
+    ``_sample_staged`` discipline, replicated here without env deps so the
+    bench runs on any host). The delta between the two numbers IS the
+    host-sampling + transfer share of the critical path.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from d4pg_tpu.agent import D4PGConfig, create_train_state, jit_train_step
+    from d4pg_tpu.models.critic import DistConfig
+    from d4pg_tpu.replay.per import PrioritizedReplayBuffer
+    from d4pg_tpu.replay.uniform import Transition
+
+    config = D4PGConfig(
+        obs_dim=OBS_DIM,
+        action_dim=ACT_DIM,
+        hidden_sizes=(HIDDEN, HIDDEN, HIDDEN),
+        dist=DistConfig(kind="categorical", num_atoms=ATOMS, v_min=V_MIN, v_max=V_MAX),
+        compute_dtype=compute_dtype,
+    )
+    state = create_train_state(config, jax.random.PRNGKey(0))
+    step_fn = jit_train_step(config)
+    rng = np.random.default_rng(0)
+    buf = PrioritizedReplayBuffer(rows, OBS_DIM, ACT_DIM)
+    buf.add_batch(
+        Transition(
+            rng.normal(size=(rows, OBS_DIM)).astype(np.float32),
+            rng.uniform(-1, 1, size=(rows, ACT_DIM)).astype(np.float32),
+            rng.uniform(-1, 0, size=rows).astype(np.float32),
+            rng.normal(size=(rows, OBS_DIM)).astype(np.float32),
+            np.full(rows, 0.99, np.float32),
+        )
+    )
+
+    def sample_staged(step):
+        b = buf.sample(batch, rng, step=step)
+        indices = b.pop("indices")
+        return indices, {k: jnp.asarray(v) for k, v in b.items()}
+
+    def run(n, i0, state, staged, pending):
+        for i in range(i0, i0 + n):
+            if staged is None:
+                staged = sample_staged(i)
+            indices, dev_batch = staged
+            state, _, priorities = step_fn(state, dev_batch)
+            # prefetch: batch i+1 sampled + H2D started under step i's
+            # (async-dispatched) device compute
+            staged = sample_staged(i + 1) if prefetch else None
+            if pending is not None:
+                idx, pri = pending
+                buf.update_priorities(idx, np.asarray(pri))
+            if hasattr(priorities, "copy_to_host_async"):
+                priorities.copy_to_host_async()
+            pending = (indices, priorities)
+        return state, staged, pending
+
+    state, staged, pending = run(5, 0, state, staged=None, pending=None)
+    jax.block_until_ready(state.step)
+    t0 = time.perf_counter()
+    state, staged, pending = run(steps, 5, state, staged, pending)
+    jax.block_until_ready(state.step)
+    dt = time.perf_counter() - t0
+    return steps / dt
+
+
 def bench_torch_cpu_baseline() -> float:
     """Reference-style D4PG step: CPU torch nets + host NumPy projection."""
     import torch
@@ -338,20 +450,63 @@ def bench_torch_cpu_baseline() -> float:
 
 
 def main() -> None:
+    # Hermetic gate: the driver must get ONE parseable JSON line even when
+    # the TPU tunnel is wedged (raises, hangs, or silently downgrades to
+    # the CPU backend — all three observed). Probe in a subprocess before
+    # any jax call here; an accelerator-less default backend only counts
+    # when the user explicitly asked for it via JAX_PLATFORMS=cpu.
+    platform = _probe_default_backend()
+    explicit_cpu = os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
+    if platform is None or (platform == "cpu" and not explicit_cpu):
+        detail = (
+            "default JAX backend failed to initialize (subprocess probe)"
+            if platform is None
+            else "accelerator plugin failed to initialize; jax fell back "
+            "to the cpu backend"
+        )
+        print(
+            json.dumps(
+                {
+                    "error": "tpu_unreachable",
+                    "metric": "learner_grad_steps_per_sec",
+                    "value": None,
+                    "detail": detail
+                    + " — set JAX_PLATFORMS=cpu for a deliberate CPU run; "
+                    "benchmarks/fused_microbench.py is the chip-independent "
+                    "regression smoke",
+                }
+            )
+        )
+        return
     tpu = bench_tpu()
     # bf16 flagship line (same program, bf16 matmuls): the repo's own
     # measurement says bf16 is 0-30% faster at these shapes, and the MFU
     # denominator is the bf16 peak — so the f32-only number was
     # conservative twice over (VERDICT round-3 weak #4).
     bf16 = bench_tpu(compute_dtype="bfloat16")
+    # Fused Pallas projection+loss kernel (projection_backend=pallas_fused):
+    # same protocol, both dtypes — the byte-reduction claim is committed as
+    # fused-vs-unfused steps/s AND XLA-accounted bytes from the same runs.
+    fused_f32 = bench_tpu(projection_backend="pallas_fused")
+    fused_bf16 = bench_tpu(
+        compute_dtype="bfloat16", projection_backend="pallas_fused"
+    )
+    # Host replay→device pipeline with and without the double buffer.
+    pipe_off = bench_host_pipeline(prefetch=False)
+    pipe_on = bench_host_pipeline(prefetch=True)
     baseline = bench_torch_cpu_baseline()
     # The headline AND its utilization/roofline numbers come from the SAME
     # (winning) run — pairing a bf16 throughput with f32-program bytes/flops
-    # would make value × flops ≠ achieved_tflops.
-    winner, headline_dtype = (
-        (bf16, "bfloat16")
-        if bf16["steps_per_sec"] > tpu["steps_per_sec"]
-        else (tpu, "float32")
+    # would make value × flops ≠ achieved_tflops. The fused-kernel variants
+    # compete for the headline on equal protocol footing.
+    candidates = [
+        (tpu, "float32", "xla"),
+        (bf16, "bfloat16", "xla"),
+        (fused_f32, "float32", "pallas_fused"),
+        (fused_bf16, "bfloat16", "pallas_fused"),
+    ]
+    winner, headline_dtype, headline_projection = max(
+        candidates, key=lambda c: c[0]["steps_per_sec"]
     )
     line = {
         "metric": "learner_grad_steps_per_sec",
@@ -360,9 +515,27 @@ def main() -> None:
         "vs_baseline": round(winner["steps_per_sec"] / baseline, 2),
         "baseline_steps_per_sec": round(baseline, 2),
         "headline_dtype": headline_dtype,
+        "headline_projection": headline_projection,
         "f32_steps_per_sec": round(tpu["steps_per_sec"], 2),
         "bf16_steps_per_sec": round(bf16["steps_per_sec"], 2),
+        # Fused-vs-unfused block: steps/s plus XLA-accounted bytes from the
+        # SAME runs, so the kernel's byte cut is a committed artifact.
+        "fused_f32_steps_per_sec": round(fused_f32["steps_per_sec"], 2),
+        "fused_bf16_steps_per_sec": round(fused_bf16["steps_per_sec"], 2),
+        # Host replay→device pipeline, double buffer off/on: the delta is
+        # the host-sampling + H2D share of the critical path.
+        "prefetch_off_steps_per_sec": round(pipe_off, 2),
+        "prefetch_on_steps_per_sec": round(pipe_on, 2),
+        "prefetch_speedup": round(pipe_on / pipe_off, 3),
     }
+    if "bytes_per_grad_step" in bf16 and "bytes_per_grad_step" in fused_bf16:
+        line["unfused_bytes_per_grad_step"] = round(bf16["bytes_per_grad_step"])
+        line["fused_bytes_per_grad_step"] = round(
+            fused_bf16["bytes_per_grad_step"]
+        )
+        line["fused_bytes_ratio"] = round(
+            fused_bf16["bytes_per_grad_step"] / bf16["bytes_per_grad_step"], 4
+        )
     # MFU block (when XLA cost analysis + a known chip peak are available).
     # Single-digit MFU is EXPECTED here and stated as such: the flagship
     # model is 3×256 MLPs at batch 256 — the per-step matmuls are far below
@@ -380,6 +553,10 @@ def main() -> None:
         line["f32_mfu"] = round(tpu["mfu"], 5)
     if "mfu" in bf16:
         line["bf16_mfu"] = round(bf16["mfu"], 5)
+    if "mfu" in fused_bf16:
+        line["fused_bf16_mfu"] = round(fused_bf16["mfu"], 5)
+    if "xla_bytes_util" in fused_bf16:
+        line["fused_xla_bytes_util"] = round(fused_bf16["xla_bytes_util"], 4)
     # Roofline block: the falsifiable form of "the gather, not the MXU, is
     # the bottleneck" — achieved HBM GB/s vs the chip's peak, same run as
     # the headline.

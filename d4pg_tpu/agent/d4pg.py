@@ -262,6 +262,30 @@ def train_step(
     actor, critic = build_networks(config)
     actor_opt, critic_opt = make_optimizers(config)
     support = support_of(config)
+
+    # ---- bf16 hot-path dtype policy ----
+    # Master weights, Adam moments, Polyak targets and every loss reduction
+    # stay float32 (the nets cast their head back to f32, so losses/metrics
+    # accumulate in f32 regardless of compute dtype). Under bfloat16 the
+    # TARGET networks — forward-only, never differentiated — are cast to
+    # bf16 ONCE here, so all target-path matmuls read 2-byte params from
+    # HBM instead of converting f32 reads per layer; the flax modules see
+    # params already in their compute dtype and skip the promotion. The
+    # ONLINE params are left f32 and cast per-op inside the loss closures:
+    # value_and_grad must differentiate w.r.t. the f32 masters.
+    tgt_actor_params = state.target_actor_params
+    tgt_critic_params = state.target_critic_params
+    if _dtype(config) == jnp.bfloat16:
+        def _to_bf16(tree):
+            return jax.tree_util.tree_map(
+                lambda x: x.astype(jnp.bfloat16)
+                if x.dtype == jnp.float32
+                else x,
+                tree,
+            )
+
+        tgt_actor_params = _to_bf16(tgt_actor_params)
+        tgt_critic_params = _to_bf16(tgt_critic_params)
     weights = batch.get("weights")
     if weights is None:
         weights = jnp.ones_like(batch["reward"])
@@ -285,7 +309,7 @@ def train_step(
         )
 
     # ---- target: y = Φ(r + γ_eff · Z_target(s', μ_target(s'))) ----
-    next_action = actor.apply(state.target_actor_params, batch["next_obs"])
+    next_action = actor.apply(tgt_actor_params, batch["next_obs"])
     if config.twin_critic:
         # Clipped double-Q, distributionally: back up whichever target
         # critic's WHOLE distribution has the smaller mean, per sample —
@@ -293,19 +317,51 @@ def train_step(
         # elementwise min of probs would not be a distribution).
         heads = jax.vmap(
             lambda p: critic.apply(p, batch["next_obs"], next_action)
-        )(state.target_critic_params)
+        )(tgt_critic_params)
         vals = jax.vmap(lambda h: _critic_value(config, support, h))(heads)
         target_head = jnp.where(
             (vals[0] <= vals[1])[..., None], heads[0], heads[1]
         )
     else:
         target_head = critic.apply(
-            state.target_critic_params, batch["next_obs"], next_action
+            tgt_critic_params, batch["next_obs"], next_action
         )
 
     if config.dist.kind == "categorical":
+        # Atom-layout audit: every per-atom op below (softmax, projection,
+        # CE, E[Z]) reduces/broadcasts over the LAST axis of a [B, A]
+        # tensor — atoms live in the 128-lane dimension, so the critic-head
+        # "gathers" are contiguous lane reads, never a strided HBM walk.
+        # Keep it that way: any new head-side op must put atoms last.
         target_probs = jax.nn.softmax(target_head, axis=-1)
-        if config.projection_backend == "pallas":
+        if config.projection_backend == "pallas_fused":
+            # Projection + log-softmax CE + IS/priority signals in ONE
+            # Pallas kernel: the projected target distribution is never
+            # materialized in HBM (fwd or bwd — the VJP recomputes Φ in
+            # VMEM). The XLA branch below stays the reference oracle.
+            from d4pg_tpu.ops.pallas_projection import fused_categorical_loss
+
+            fused_target_probs = jax.lax.stop_gradient(target_probs)
+            interpret = jax.default_backend() != "tpu"  # CPU tests
+
+            def critic_loss_fn(critic_params):
+                pred = critic.apply(critic_params, batch["obs"], batch["action"])
+                ce, overlap = fused_categorical_loss(
+                    support,
+                    pred,
+                    fused_target_probs,
+                    batch["reward"],
+                    batch["discount"],
+                    interpret,
+                )
+                # f32 weighted reduction on [B] vectors — byte-trivial.
+                loss = jnp.mean(weights * ce)
+                per_sample = (
+                    overlap if config.priority_kind == "overlap" else ce
+                )
+                return loss, per_sample
+
+        elif config.projection_backend == "pallas":
             from d4pg_tpu.ops.pallas_projection import categorical_projection_pallas
 
             proj = categorical_projection_pallas(
@@ -319,19 +375,20 @@ def train_step(
             proj = categorical_projection(
                 support, target_probs, batch["reward"], batch["discount"]
             )
-        proj = jax.lax.stop_gradient(proj)
+        if config.projection_backend != "pallas_fused":
+            proj = jax.lax.stop_gradient(proj)
 
-        def critic_loss_fn(critic_params):
-            pred = critic.apply(critic_params, batch["obs"], batch["action"])
-            loss, per_sample_ce = categorical_td_loss(pred, proj, weights)
-            if config.priority_kind == "overlap":
-                # Reference-compatible surrogate |−Σ m·p| (ddpg.py:220-222).
-                per_sample = jnp.abs(
-                    -jnp.sum(proj * jax.nn.softmax(pred, axis=-1), axis=-1)
-                )
-            else:
-                per_sample = per_sample_ce
-            return loss, per_sample
+            def critic_loss_fn(critic_params):
+                pred = critic.apply(critic_params, batch["obs"], batch["action"])
+                loss, per_sample_ce = categorical_td_loss(pred, proj, weights)
+                if config.priority_kind == "overlap":
+                    # Reference-compatible surrogate |−Σ m·p| (ddpg.py:220-222).
+                    per_sample = jnp.abs(
+                        -jnp.sum(proj * jax.nn.softmax(pred, axis=-1), axis=-1)
+                    )
+                else:
+                    per_sample = per_sample_ce
+                return loss, per_sample
     elif config.dist.kind == "scalar":
         # Plain DDPG TD(0)/TD(n) target (BASELINE.json config 1).
         y = batch["reward"] + batch["discount"] * target_head[..., 0]

@@ -76,10 +76,21 @@ class D4PGConfig:
     # priority signal: "ce" (true distributional TD) or "overlap"
     # (reference-compatible surrogate, ddpg.py:220-222)
     priority_kind: str = "ce"
-    # compute dtype for network matmuls ("float32" | "bfloat16")
+    # compute dtype for network matmuls ("float32" | "bfloat16"). The
+    # bf16 policy is: fp32 master weights / Adam moments / Polyak targets
+    # and fp32 loss accumulation always; bf16 activations through the
+    # actor/critic trunks; target-network params cast to bf16 once per
+    # train step (forward-only — halves target-path param bytes, the
+    # HBM-bound part of the step per bench.py's roofline).
     compute_dtype: str = "float32"
-    # categorical projection implementation: "xla" (one-hot matmul) or
-    # "pallas" (hand-written TPU kernel, d4pg_tpu/ops/pallas_projection.py)
+    # categorical projection implementation, an oracle ladder:
+    #   "xla"          — one-hot matmul reference (ops/categorical.py);
+    #   "pallas"       — hand-written projection kernel, XLA loss
+    #                    (d4pg_tpu/ops/pallas_projection.py);
+    #   "pallas_fused" — ONE kernel for projection + log-softmax CE +
+    #                    priority signals; the projected distribution never
+    #                    touches HBM (fwd or bwd). Each rung is validated
+    #                    against the one above it in tests.
     projection_backend: str = "xla"
     # Twin critics with a clipped-min target (TD3's fix for the DDPG-family
     # overestimation spiral, applied distributionally: the Bellman backup

@@ -35,7 +35,9 @@ class TrainConfig:
     her_k: int = 4
     # Running observation normalization at the data boundary (HER-DDPG,
     # ops/obs_norm.py): clip((x−μ)/σ, ±5) applied to training batches and
-    # acting/eval forwards, Welford stats updated per sampled batch.
+    # acting/eval forwards; Welford stats folded once per OBSERVED env step
+    # at collection time (updating per sampled batch would double-count
+    # PER-favored transitions — see Trainer._ingest_obs).
     # Host (gymnasium/dm_control state) envs only; default off.
     obs_norm: bool = False
 
@@ -50,6 +52,15 @@ class TrainConfig:
     # stale within the K-step window (written back after the dispatch), the
     # same staleness class the reference accepts from Hogwild asynchrony.
     steps_per_dispatch: int = 1
+    # Double-buffered replay→device input pipeline: dispatch N is fed from a
+    # batch that was host-sampled — and whose device_put was started — while
+    # dispatch N−1 ran on the device, so host sampling and the H2D transfer
+    # disappear from the critical path (the input-side symmetric of the
+    # async priority write-back). Cost: the staged batch reflects priorities
+    # and replay contents as of one dispatch earlier — the same staleness
+    # class as steps_per_dispatch>1, and strictly less than async_collect's.
+    # Default off so existing runs are batch-for-batch identical.
+    prefetch: bool = False
 
     # async actor/learner decoupling (host actor pool only): collection runs
     # in a background thread against periodically published actor params

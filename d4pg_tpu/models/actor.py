@@ -7,6 +7,11 @@ layers (``models.py:36-37`` — two linear maps with no ReLU collapse to one;
 SURVEY.md quirk #9) by applying ReLU between every hidden layer.
 
 Compute dtype is configurable (bfloat16 for TPU MXU); params stay float32.
+``param_dtype`` is pinned to f32 explicitly on every layer: the bf16 hot
+path keeps fp32 MASTER weights (Adam moments, Polyak targets, checkpoint
+format all f32) and casts to bf16 only at the compute boundary — the
+train step additionally pre-casts the forward-only target-net param trees
+once per step (``agent/d4pg.py:train_step``).
 """
 
 from __future__ import annotations
@@ -46,6 +51,7 @@ class Actor(nn.Module):
                 kernel_init=fanin_uniform(),
                 bias_init=fanin_uniform(),
                 dtype=self.dtype,
+                param_dtype=jnp.float32,
                 name=f"hidden_{i}",
             )(x)
             x = nn.relu(x)
@@ -54,6 +60,7 @@ class Actor(nn.Module):
             kernel_init=nn.initializers.uniform(scale=self.final_init_scale),
             bias_init=nn.initializers.uniform(scale=self.final_init_scale),
             dtype=self.dtype,
+            param_dtype=jnp.float32,
             name="out",
         )(x)
         return jnp.tanh(x).astype(jnp.float32)

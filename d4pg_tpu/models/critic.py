@@ -75,6 +75,7 @@ class Critic(nn.Module):
             kernel_init=fanin_uniform(),
             bias_init=fanin_uniform(),
             dtype=self.dtype,
+            param_dtype=jnp.float32,  # fp32 master weights (see Actor)
             name="hidden_0",
         )(x)
         x = nn.relu(x)
@@ -86,6 +87,7 @@ class Critic(nn.Module):
                 kernel_init=fanin_uniform(),
                 bias_init=fanin_uniform(),
                 dtype=self.dtype,
+                param_dtype=jnp.float32,
                 name=f"hidden_{i}",
             )(x)
             x = nn.relu(x)
@@ -113,6 +115,7 @@ class Critic(nn.Module):
                 kernel_init=nn.initializers.uniform(scale=self.final_init_scale),
                 bias_init=mog_bias,
                 dtype=self.dtype,
+                param_dtype=jnp.float32,
                 name="out",
             )(x)
         else:
@@ -121,8 +124,12 @@ class Critic(nn.Module):
                 kernel_init=nn.initializers.uniform(scale=self.final_init_scale),
                 bias_init=nn.initializers.uniform(scale=self.final_init_scale),
                 dtype=self.dtype,
+                param_dtype=jnp.float32,
                 name="out",
             )(x)
+        # Head always returns f32 (and atoms in the LAST axis — lane-
+        # contiguous for every downstream per-atom reduction): losses and
+        # metrics accumulate in f32 under the bf16 hot path.
         return out.astype(jnp.float32)
 
 

@@ -35,9 +35,10 @@ class PixelEncoder(nn.Module):
         x = pixels.astype(self.dtype) / self.input_scale
         for i, feat in enumerate(self.features):
             stride = 2 if i == 0 else 1
-            x = nn.Conv(feat, (3, 3), strides=(stride, stride), dtype=self.dtype)(x)
+            x = nn.Conv(feat, (3, 3), strides=(stride, stride),
+                        dtype=self.dtype, param_dtype=jnp.float32)(x)
             x = nn.relu(x)
         x = x.reshape(*x.shape[:-3], -1)
-        x = nn.Dense(self.embed_dim, dtype=self.dtype)(x)
-        x = nn.LayerNorm(dtype=self.dtype)(x)
+        x = nn.Dense(self.embed_dim, dtype=self.dtype, param_dtype=jnp.float32)(x)
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
         return jnp.tanh(x).astype(jnp.float32)

@@ -1,0 +1,29 @@
+"""jax version compatibility for the parallel layer.
+
+``shard_map`` graduated from ``jax.experimental`` to the top-level
+namespace; fleet hosts run both generations (the round-6 driver container
+ships jax 0.4.37 where ``jax.shard_map`` does not exist yet, while the
+round-1..5 verify hosts ran a newer jax where it does). One import site,
+resolved once.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # jax < 0.4.40: experimental home, and the
+    # replication-check kwarg is still spelled check_rep there
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    @functools.wraps(_experimental_shard_map)
+    def shard_map(*args, **kwargs):  # type: ignore[no-redef]
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _experimental_shard_map(*args, **kwargs)
+
+
+__all__ = ["shard_map"]

@@ -28,6 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from d4pg_tpu.agent.d4pg import fused_train_scan, train_step
 from d4pg_tpu.agent.state import D4PGConfig
+from d4pg_tpu.parallel.compat import shard_map
 
 
 def make_dp_train_step(config: D4PGConfig, mesh: Mesh, donate: bool = True):
@@ -42,7 +43,7 @@ def make_dp_train_step(config: D4PGConfig, mesh: Mesh, donate: bool = True):
     round-3 weak #3).
     """
     fn = partial(train_step, config, axis_name="dp")
-    mapped = jax.shard_map(
+    mapped = shard_map(
         fn,
         mesh=mesh,
         in_specs=(P(), P("dp")),
@@ -60,7 +61,7 @@ def make_dp_fused_train_step(config: D4PGConfig, mesh: Mesh, donate: bool = True
     is a single XLA program per device."""
     fn = partial(fused_train_scan, config, axis_name="dp")
     batch_spec = P(None, "dp")  # [K, B] — shard the batch axis, not the scan axis
-    mapped = jax.shard_map(
+    mapped = shard_map(
         fn,
         mesh=mesh,
         in_specs=(P(), batch_spec),
@@ -103,7 +104,7 @@ def make_hogwild_dp_train_step(config: D4PGConfig, mesh: Mesh, donate: bool = Tr
         return state, metrics, priorities
 
     batch_spec = P(None, "dp")
-    mapped = jax.shard_map(
+    mapped = shard_map(
         hogwild,
         mesh=mesh,
         in_specs=(P(), batch_spec),

@@ -95,11 +95,13 @@ class PrioritizedReplayBuffer(ReplayBuffer):
     def beta(self, step: int) -> float:
         return linear_schedule(step, self.beta_steps, self.beta0, 1.0)
 
-    def sample(self, batch_size: int, rng: np.random.Generator, step: int = 0):
-        """Stratified proportional sample.
+    def _draw(self, batch_size: int, rng: np.random.Generator, step: int):
+        """One locked stratified draw: (idx, IS weights, generation stamps).
 
-        Returns a batch dict with extra keys ``indices`` (for priority
-        write-back) and ``weights`` (IS weights, max-normalized).
+        Caller must NOT hold the lock. One tree descent per level for the
+        whole index vector (NumPy or C++ backend) — ``batch_size`` here may
+        be K·B for a multi-batch draw; the descent is the same O(log n)
+        vector passes either way.
         """
         with self._lock:
             total = self._sum.sum()
@@ -122,10 +124,45 @@ class PrioritizedReplayBuffer(ReplayBuffer):
             # in between, the stale stamp makes update_priorities drop that
             # entry (conservative) rather than mis-stamp the new transition.
             gen = self._gen[idx].copy()
+        return idx, weights.astype(np.float32), gen
+
+    def sample(self, batch_size: int, rng: np.random.Generator, step: int = 0):
+        """Stratified proportional sample.
+
+        Returns a batch dict with extra keys ``indices`` (for priority
+        write-back) and ``weights`` (IS weights, max-normalized).
+        """
+        idx, weights, gen = self._draw(batch_size, rng, step)
         batch = dict(self.gather(idx))
         batch["indices"] = SampledIndices(idx, gen)
-        batch["weights"] = weights.astype(np.float32)
+        batch["weights"] = weights
         return batch
+
+    def sample_many(
+        self, batch_size: int, k: int, rng: np.random.Generator, step: int = 0
+    ) -> list[dict]:
+        """K stratified batches from ONE locked K·B-wide tree descent + ONE
+        ring gather — the host half of the fused-dispatch / prefetch path
+        (k separate :meth:`sample` calls pay k lock round-trips and k
+        gathers; this is one of each, using the same batched descent the
+        C++ sum tree vectorizes). The K·B equal-mass segments are dealt
+        round-robin (batch i takes draws i, i+k, i+2k, …), so every batch
+        holds B draws evenly spread across the WHOLE priority mass — a
+        strictly finer stratification than B segments, never a contiguous
+        1/K slice of it. All K batches share one ``step`` (one β) and one
+        generation capture — the semantics of sampling K batches
+        back-to-back.
+        """
+        idx, weights, gen = self._draw(batch_size * k, rng, step)
+        flat = self.gather(idx)
+        out = []
+        for i in range(k):
+            sl = slice(i, None, k)
+            b = {key: v[sl] for key, v in flat.items()}
+            b["indices"] = SampledIndices(idx[sl], gen[sl])
+            b["weights"] = weights[sl]
+            out.append(b)
+        return out
 
     def _snapshot_arrays(self) -> dict:
         data = super()._snapshot_arrays()

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 from d4pg_tpu.agent import TrainState
 from d4pg_tpu.agent.d4pg import fused_train_scan, gather_batches, make_noise
 from d4pg_tpu.agent.state import D4PGConfig
+from d4pg_tpu.parallel.compat import shard_map
 from d4pg_tpu.runtime.collect import make_segment_collector
 
 
@@ -337,19 +338,19 @@ def make_on_device_trainer(
     )
     carry_spec = (rep, shd, shd, shd, replay_spec, rep)
     init_fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             init_body, mesh=mesh, in_specs=(rep, rep), out_specs=carry_spec,
             check_vma=False,
         )
     )
     warmup_fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             warmup_body, mesh=mesh, in_specs=(carry_spec, rep),
             out_specs=carry_spec, check_vma=False,
         )
     )
     iterate_fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             iterate_body, mesh=mesh, in_specs=(carry_spec, rep),
             out_specs=(carry_spec, rep), check_vma=False,
         )

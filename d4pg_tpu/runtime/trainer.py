@@ -8,7 +8,11 @@ Replaces the reference's ``Worker.work`` nested loops + process forking
   bulk-insert into the host buffer, the learner consumes batches with a
   one-step pipeline lag so the next batch is being sampled/transferred
   while the TPU executes the current step, and PER priorities write back
-  when the step's results materialize.
+  when the step's results materialize. With ``config.prefetch`` the input
+  side is explicitly double-buffered: dispatch N runs on a batch whose
+  host sampling AND host→device copy happened under dispatch N−1's device
+  compute (``_sample_staged``), mirroring the output-side async priority
+  write-back.
 - **host mode** (gymnasium adapters, incl. goal-dict envs with HER):
   per-step host env loop feeding the same writers — the reference's actor
   loop, minus processes.
@@ -1089,6 +1093,55 @@ class Trainer:
             batch["next_obs"] = self.obs_norm.normalize(batch["next_obs"])
         return batch
 
+    def _sample_k(self, K: int) -> list:
+        """K batches for one fused dispatch. PER path: ONE locked K·B-wide
+        tree descent + one ring gather (``replay/per.py:sample_many``,
+        round-robin stratified) instead of K lock round-trips + K gathers;
+        uniform replay falls back to K plain samples."""
+        cfg = self.config
+        if cfg.prioritized and hasattr(self.buffer, "sample_many"):
+            with self._buffer_lock:
+                samples = self.buffer.sample_many(
+                    cfg.batch_size, K, self._rng, step=self.grad_steps
+                )
+            if self.obs_norm is not None:
+                for s in samples:  # normalize ONLY (see _sample)
+                    s["obs"] = self.obs_norm.normalize(s["obs"])
+                    s["next_obs"] = self.obs_norm.normalize(s["next_obs"])
+            return samples
+        return [self._sample() for _ in range(K)]
+
+    def _sample_staged(self, K: int):
+        """Sample one dispatch's worth of batches, stage the wire format,
+        and START the host→device transfer (``jnp.asarray``/device_put is
+        asynchronous). Returns ``(indices, dev_batch)``.
+
+        This is the unit the double buffer revolves around: with
+        ``config.prefetch`` the trainer calls it immediately AFTER
+        dispatching step N, so batch N+1's sampling and H2D copy run under
+        step N's device compute — the input-side symmetric of the async
+        priority write-back.
+
+        K>1: the K host-sampled batches stack to one [K, B] ``lax.scan``
+        dispatch, paying per-call latency (the dominant cost on remote
+        TPUs) once per K grad steps."""
+        if K == 1:
+            with annotate("host/sample"):
+                batch = self._sample()
+            indices = batch.pop("indices", None)
+            dev_batch = {
+                k: jnp.asarray(self._stage(k, v)) for k, v in batch.items()
+            }
+        else:
+            with annotate("host/sample"):
+                samples = self._sample_k(K)
+            indices = [s.pop("indices", None) for s in samples]
+            dev_batch = {
+                k: jnp.asarray(self._stage(k, np.stack([s[k] for s in samples])))
+                for k in samples[0]
+            }
+        return indices, dev_batch
+
     def _norm_obs(self, x: np.ndarray) -> np.ndarray:
         """Read-only normalizer view for eval forwards (identity when off)."""
         return x if self.obs_norm is None else self.obs_norm.normalize(x)
@@ -1117,6 +1170,7 @@ class Trainer:
         env_steps_start = self.env_steps  # per-leg delta for throughput
         grad_steps_done = 0
         pending = None  # (indices, priorities future) — one-step pipeline lag
+        staged = None   # (indices, dev_batch) — the prefetch double buffer
         last = {}
         collect_budget = 0.0
         tracing = False
@@ -1178,35 +1232,36 @@ class Trainer:
                             self._host_collect_steps(n)
                             collect_budget -= n
 
-                if K == 1:
-                    with annotate("host/sample"):
-                        batch = self._sample()
-                    indices = batch.pop("indices", None)
-                    dev_batch = {
-                        k: jnp.asarray(self._stage(k, v)) for k, v in batch.items()
-                    }
-                    # dispatch is async: the TPU runs while we write back the
-                    # PREVIOUS step's priorities and sample the next batch
-                    with annotate("host/dispatch"):
+                # Double buffer: under --prefetch this dispatch consumes the
+                # batch staged while the PREVIOUS dispatch ran (its H2D copy
+                # is already done or in flight); first iteration primes it.
+                if staged is not None:
+                    indices, dev_batch = staged
+                    staged = None
+                else:
+                    indices, dev_batch = self._sample_staged(K)
+                # dispatch is async: the TPU runs while we prefetch the next
+                # batch and write back the PREVIOUS step's priorities
+                with annotate("host/dispatch"):
+                    if K == 1:
                         self.state, metrics, priorities = self._train_step(
                             self.state, dev_batch
                         )
-                else:
-                    # K host-sampled batches → one lax.scan dispatch; the
-                    # per-call latency (the dominant cost on remote TPUs) is
-                    # paid once per K grad steps
-                    with annotate("host/sample"):
-                        samples = [self._sample() for _ in range(K)]
-                    indices = [s.pop("indices", None) for s in samples]
-                    dev_batch = {
-                        k: jnp.asarray(self._stage(k, np.stack([s[k] for s in samples])))
-                        for k in samples[0]
-                    }
-                    with annotate("host/dispatch"):
+                    else:
                         self.state, metrics_k, priorities = self._fused_step(
                             self.state, dev_batch
                         )
-                    metrics = jax.tree.map(lambda x: x.mean(), metrics_k)
+                        metrics = jax.tree.map(lambda x: x.mean(), metrics_k)
+                if cfg.prefetch and grad_steps_done + K < total:
+                    # Sample batch N+1 and start its device_put NOW, under
+                    # step N's device compute. The staged batch sees replay
+                    # contents/priorities as of this instant — one dispatch
+                    # staler than unprefetched sampling, the same staleness
+                    # class as steps_per_dispatch; generation stamps are
+                    # captured at THIS sample, so recycled-slot write-backs
+                    # still drop correctly.
+                    with annotate("host/prefetch"):
+                        staged = self._sample_staged(K)
                 if self.config.prioritized:
                     if self._wb_thread is not None:
                         with annotate("host/priority_writeback"):

@@ -1,0 +1,52 @@
+"""Hermetic default-backend probe shared by the driver entry points.
+
+A wedged TPU tunnel has been observed to raise (BENCH_r05: backend setup
+error), hang ``jax.devices()`` outright (MULTICHIP_r05 rc=124), or fail
+fast so jax silently falls back to the CPU backend (round 6). Probing in
+a short-timeout subprocess shields the calling process from all three:
+it never initializes the default backend itself unless the caller decides
+the probe result warrants it.
+
+Deliberately dependency-free at import time (no jax import): ``bench.py``
+and ``__graft_entry__.py`` call this before any jax backend work.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import subprocess
+import sys
+
+
+@functools.lru_cache(maxsize=None)
+def probe_default_backend(timeout: float | None = None) -> tuple[str | None, int]:
+    """(platform_name, device_count) of the default jax backend, probed in
+    a subprocess; ``(None, 0)`` when init fails, errors, or times out.
+
+    Memoized: within one process the backend either comes up or it
+    doesn't — drivers that need both the platform and the count (or probe
+    from two call sites, as the no-arg ``__graft_entry__`` main does) pay
+    the subprocess (and, on a wedged tunnel, the full timeout) once.
+    """
+    timeout = timeout or float(os.environ.get("GRAFT_PROBE_TIMEOUT", "90"))
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax; print(jax.default_backend()); print(len(jax.devices()))",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except (subprocess.TimeoutExpired, OSError):
+        return None, 0
+    if proc.returncode != 0:
+        return None, 0
+    lines = [ln.strip() for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    try:
+        return lines[-2], int(lines[-1])
+    except (IndexError, ValueError):
+        return None, 0

@@ -45,10 +45,32 @@ def test_state_roundtrip():
     np.testing.assert_array_equal(fresh.normalize(x), norm.normalize(x))
 
 
+def test_state_dict_reads_single_publication():
+    """Torn-read fix (advisor round 5): state_dict must read (count, mean,
+    m2) from the SAME single-tuple publication normalize uses — never from
+    attributes a concurrent update() may have half-written. Simulated by
+    tearing the attributes after the last publication."""
+    rng = np.random.default_rng(2)
+    norm = RunningObsNorm(3)
+    norm.update(rng.normal(size=(40, 3)))
+    published = norm.state_dict()
+    # a mid-update thread switch: attribute written, publication not yet
+    norm.mean = norm.mean + 100.0
+    norm.count = norm.count + 7
+    sd = norm.state_dict()
+    assert sd["count"] == published["count"]
+    np.testing.assert_allclose(sd["mean"], published["mean"])
+    np.testing.assert_allclose(sd["m2"], published["m2"])
+    # the next publication (completed update) is picked up again
+    norm.update(rng.normal(size=(5, 3)))
+    assert norm.state_dict()["count"] == norm._stats[0]
+
+
 def test_trainer_obs_norm_end_to_end(tmp_path):
     """Pendulum-v1 through the host single-env path with --obs-norm: stats
-    accumulate from sampled batches, acting/eval consume normalized obs,
-    and the meta file persists the statistics for resume."""
+    fold once per observed env step at collection time, training batches
+    and acting/eval consume normalized obs, and the meta file persists the
+    statistics for resume."""
     pytest.importorskip("gymnasium")
     from train import build_parser, config_from_args
     from d4pg_tpu.runtime import Trainer

@@ -1,12 +1,22 @@
 """Pallas projection kernel vs the XLA reference implementation (interpret
-mode on CPU; the real-TPU comparison runs in bench/verify)."""
+mode on CPU; the real-TPU comparison runs in bench/verify).
 
+The fused projection+loss kernel is held to the same oracle: values,
+priority signals AND gradients must match ``categorical_projection`` +
+``categorical_td_loss`` across n-step discounts, mixed-sign/one-sided
+supports, edge atoms (rewards clipped at both support ends) and
+non-tile-aligned batches."""
+
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from d4pg_tpu.ops import categorical_projection, make_support
-from d4pg_tpu.ops.pallas_projection import categorical_projection_pallas
+from d4pg_tpu.ops import categorical_projection, categorical_td_loss, make_support
+from d4pg_tpu.ops.pallas_projection import (
+    categorical_projection_pallas,
+    fused_categorical_loss,
+)
 
 
 @pytest.mark.parametrize("batch", [32, 128, 200])
@@ -41,3 +51,142 @@ def test_pallas_terminal_and_clip():
     np.testing.assert_allclose(np.asarray(out[0]), [0, 0, 0, 0, 1], atol=1e-6)
     np.testing.assert_allclose(np.asarray(out[1]), [1, 0, 0, 0, 0], atol=1e-6)
     np.testing.assert_allclose(np.asarray(out[2]), [0, 0, 1, 0, 0], atol=1e-6)
+
+
+def _random_case(rng, batch, atoms, v_min, v_max):
+    logits = jnp.asarray(rng.normal(size=(batch, atoms)), jnp.float32)
+    tlog = rng.normal(size=(batch, atoms))
+    target_probs = jnp.asarray(
+        np.exp(tlog) / np.exp(tlog).sum(-1, keepdims=True), jnp.float32
+    )
+    # Rewards deliberately overshoot BOTH support ends so the clip/edge-atom
+    # branch (full mass onto atom 0 or A-1) is exercised every run.
+    rewards = jnp.asarray(
+        rng.uniform(v_min - abs(v_min), v_max + abs(v_max), size=batch), jnp.float32
+    )
+    # γⁿ spread: terminal (0), long n-step windows, and ~1 discounts.
+    discounts = jnp.asarray(
+        rng.choice([0.0, 0.99**5, 0.95, 0.98**3, 1.0], size=batch), jnp.float32
+    )
+    weights = jnp.asarray(rng.uniform(0.1, 1.0, size=batch), jnp.float32)
+    return logits, target_probs, rewards, discounts, weights
+
+
+@pytest.mark.parametrize(
+    "batch,atoms,v_min,v_max",
+    [
+        (32, 51, -10.0, 10.0),    # mixed-sign support
+        (128, 51, 0.0, 1000.0),   # one-sided positive (flagship HalfCheetah)
+        (200, 21, -300.0, 0.0),   # one-sided negative, non-tile batch
+        (7, 11, -1.0, 1.0),       # tiny batch ≪ tile
+    ],
+)
+def test_fused_loss_matches_oracle(batch, atoms, v_min, v_max):
+    rng = np.random.default_rng(3)
+    support = make_support(v_min, v_max, atoms)
+    logits, target_probs, rewards, discounts, weights = _random_case(
+        rng, batch, atoms, v_min, v_max
+    )
+
+    proj = jax.lax.stop_gradient(
+        categorical_projection(support, target_probs, rewards, discounts)
+    )
+
+    def oracle(q):
+        loss, ce = categorical_td_loss(q, proj, weights)
+        return loss, ce
+
+    (o_loss, o_ce), o_grad = jax.value_and_grad(oracle, has_aux=True)(logits)
+    o_overlap = jnp.abs(-jnp.sum(proj * jax.nn.softmax(logits, -1), -1))
+
+    def fused(q):
+        ce, ov = fused_categorical_loss(
+            support, q, target_probs, rewards, discounts, interpret=True
+        )
+        return jnp.mean(weights * ce), (ce, ov)
+
+    (f_loss, (f_ce, f_ov)), f_grad = jax.value_and_grad(fused, has_aux=True)(logits)
+
+    np.testing.assert_allclose(float(f_loss), float(o_loss), rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(f_ce), np.asarray(o_ce), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(f_ov), np.asarray(o_overlap), atol=1e-5)
+    # The gradient is the roofline-critical half: the fused backward kernel
+    # RECOMPUTES the projection in VMEM — it must equal the autodiff of the
+    # materialized-oracle loss.
+    np.testing.assert_allclose(np.asarray(f_grad), np.asarray(o_grad), atol=1e-6)
+
+
+def test_fused_loss_terminal_edge_atoms():
+    """discount 0 + out-of-range rewards: all target mass on an edge atom;
+    CE must reduce to −log_softmax at that atom exactly."""
+    support = make_support(-1.0, 1.0, 5)
+    probs = jnp.ones((3, 5)) / 5.0
+    logits = jnp.asarray(
+        np.arange(15, dtype=np.float32).reshape(3, 5) / 5.0
+    )
+    rewards = jnp.asarray([100.0, -100.0, 0.0])
+    discounts = jnp.zeros(3)
+    ce, ov = fused_categorical_loss(
+        support, logits, probs, rewards, discounts, interpret=True
+    )
+    logp = np.asarray(jax.nn.log_softmax(logits, -1))
+    sm = np.asarray(jax.nn.softmax(logits, -1))
+    for b, atom in [(0, 4), (1, 0), (2, 2)]:
+        np.testing.assert_allclose(float(ce[b]), -logp[b, atom], atol=1e-6)
+        np.testing.assert_allclose(float(ov[b]), sm[b, atom], atol=1e-6)
+
+
+def test_fused_loss_overlap_gradient_matches_oracle():
+    """The overlap output's VJP (a future overlap-based loss term must get
+    the exact gradient, not a silently dropped cotangent): grad of
+    mean(ov) through the fused kernel vs autodiff of the materialized
+    oracle expression."""
+    rng = np.random.default_rng(7)
+    support = make_support(-10.0, 10.0, 31)
+    logits, target_probs, rewards, discounts, _ = _random_case(
+        rng, 48, 31, -10.0, 10.0
+    )
+    proj = jax.lax.stop_gradient(
+        categorical_projection(support, target_probs, rewards, discounts)
+    )
+
+    def oracle(q):
+        return jnp.mean(jnp.abs(-jnp.sum(proj * jax.nn.softmax(q, -1), -1)))
+
+    def fused(q):
+        _, ov = fused_categorical_loss(
+            support, q, target_probs, rewards, discounts, interpret=True
+        )
+        return jnp.mean(ov)
+
+    o_grad = jax.grad(oracle)(logits)
+    f_grad = jax.grad(fused)(logits)
+    np.testing.assert_allclose(np.asarray(f_grad), np.asarray(o_grad), atol=1e-6)
+
+
+def test_fused_loss_under_vmap_matches_oracle():
+    """Twin-critic shape: vmap over a stacked leading axis of predictions
+    (the custom_vjp + pallas_call pair must batch correctly)."""
+    rng = np.random.default_rng(11)
+    support = make_support(-10.0, 10.0, 31)
+    B, A = 40, 31
+    logits2 = jnp.asarray(rng.normal(size=(2, B, A)), jnp.float32)
+    _, target_probs, rewards, discounts, weights = _random_case(
+        rng, B, A, -10.0, 10.0
+    )
+    proj = categorical_projection(support, target_probs, rewards, discounts)
+
+    def fused_one(q):
+        ce, _ = fused_categorical_loss(
+            support, q, target_probs, rewards, discounts, interpret=True
+        )
+        return jnp.mean(weights * ce)
+
+    def oracle_one(q):
+        loss, _ = categorical_td_loss(q, jax.lax.stop_gradient(proj), weights)
+        return loss
+
+    f_losses, f_grads = jax.vmap(jax.value_and_grad(fused_one))(logits2)
+    o_losses, o_grads = jax.vmap(jax.value_and_grad(oracle_one))(logits2)
+    np.testing.assert_allclose(np.asarray(f_losses), np.asarray(o_losses), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(f_grads), np.asarray(o_grads), atol=1e-6)

@@ -108,8 +108,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--critic-head", choices=["categorical", "scalar", "mixture_gaussian"],
                    default="categorical")
     p.add_argument("--compute-dtype", choices=["float32", "bfloat16"], default="float32")
-    p.add_argument("--projection", choices=["xla", "pallas"], default="xla",
-                   help="categorical projection backend (pallas = custom TPU kernel)")
+    p.add_argument("--projection", choices=["xla", "pallas", "pallas_fused"],
+                   default="xla",
+                   help="categorical projection backend: pallas = custom TPU "
+                        "projection kernel; pallas_fused = projection + "
+                        "log-softmax CE + priorities in ONE kernel (the "
+                        "projected distribution never touches HBM)")
     p.add_argument("--total-steps", type=int, default=100_000,
                    help="learner grad steps to run")
     p.add_argument("--env-steps-per-train-step", type=float, default=1.0,
@@ -129,6 +133,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="grad steps fused into one device dispatch (K>1 "
                         "amortizes dispatch latency; PER priorities update "
                         "once per dispatch)")
+    p.add_argument("--prefetch", action="store_true",
+                   help="double-buffered replay->device pipeline: batch N+1 "
+                        "is host-sampled and its device_put started while "
+                        "the device runs step N, so sampling + H2D transfer "
+                        "leave the critical path (one dispatch of priority/"
+                        "freshness staleness, same class as "
+                        "--steps-per-dispatch)")
     p.add_argument("--eval-interval", type=int, default=2_000)
     p.add_argument("--eval-episodes", type=int, default=10)
     p.add_argument("--concurrent-eval", dest="concurrent_eval",
@@ -233,6 +244,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         warmup_steps=args.warmup_steps,
         batch_size=args.batch_size,
         steps_per_dispatch=args.steps_per_dispatch,
+        prefetch=args.prefetch,
         env_steps_per_train_step=args.env_steps_per_train_step,
         pool_start_method=args.pool_start_method,
         actor_device=args.actor_device,
