@@ -11,7 +11,7 @@ from d4pg_tpu.replay.schedules import linear_schedule, noise_scale_schedule
 from d4pg_tpu.replay.segment_tree import MinTree, SumTree
 from d4pg_tpu.replay.uniform import ReplayBuffer, Transition
 from d4pg_tpu.replay.per import PrioritizedReplayBuffer
-from d4pg_tpu.replay.nstep_writer import NStepWriter
+from d4pg_tpu.replay.nstep_writer import BatchedNStepWriter, NStepWriter
 from d4pg_tpu.replay.her import HindsightWriter
 
 __all__ = [
@@ -22,6 +22,7 @@ __all__ = [
     "ReplayBuffer",
     "Transition",
     "PrioritizedReplayBuffer",
+    "BatchedNStepWriter",
     "NStepWriter",
     "HindsightWriter",
 ]

@@ -65,17 +65,20 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         self.beta0 = beta0
         self.beta_steps = beta_steps
         self.eps = eps
+        self._use_native = False
         if tree_backend == "native":
             from d4pg_tpu.replay.native import NativeSumTree, NativeMinTree
 
             self._sum = NativeSumTree(self.capacity)
             self._min = NativeMinTree(self.capacity)
+            self._use_native = True
         elif tree_backend == "auto":
             try:
                 from d4pg_tpu.replay.native import NativeSumTree, NativeMinTree
 
                 self._sum = NativeSumTree(self.capacity)
                 self._min = NativeMinTree(self.capacity)
+                self._use_native = True
             except Exception:
                 self._sum = SumTree(self.capacity)
                 self._min = MinTree(self.capacity)
@@ -83,6 +86,11 @@ class PrioritizedReplayBuffer(ReplayBuffer):
             self._sum = SumTree(self.capacity)
             self._min = MinTree(self.capacity)
         self._max_priority = 1.0
+        # sample_block staging: preallocated per-draw-size buffer sets,
+        # rotated round-robin so the arrays a dispatch's device_put reads
+        # stay stable while the next sample_block of the same size fills a
+        # different slot (see _staging_slot).
+        self._staging: dict = {}
 
     def add_batch(self, t: Transition) -> np.ndarray:
         idx = super().add_batch(t)
@@ -164,6 +172,126 @@ class PrioritizedReplayBuffer(ReplayBuffer):
             out.append(b)
         return out
 
+    # How many preallocated staging buffer sets sample_block rotates
+    # through per draw size. 2 covers the prefetch double buffer (batch N+1
+    # staged while N's in flight); the third is slack for an H2D transfer
+    # that outlives a full dispatch on a slow link.
+    STAGING_SLOTS = 3
+
+    def _native_obs_mode(self) -> int:
+        from d4pg_tpu.replay import native as _native
+
+        if not self._quantized:
+            return _native.OBS_F32
+        return (
+            _native.OBS_U8_DECODE
+            if self._decode_on_sample
+            else _native.OBS_U8_RAW
+        )
+
+    def _staging_slot(self, n: int) -> dict:
+        """Next staging buffer set for an n-row draw (allocated once per
+        size, then reused round-robin — the zero-alloc half of the native
+        data plane: ``jax.device_put`` always reads stable, caller-owned
+        memory that no GC or resize can move)."""
+        entry = self._staging.get(n)
+        if entry is None:
+            obs_dtype = (
+                self.obs_dtype
+                if self._quantized and not self._decode_on_sample
+                else np.float32
+            )
+            obs_dim = self.obs.shape[1]
+            act_dim = self.action.shape[1]
+
+            def mk():
+                slot = {
+                    "idx": np.empty(n, np.int64),
+                    "gen": np.empty(n, np.int64),
+                    "weights": np.empty(n, np.float32),
+                    "obs": np.empty((n, obs_dim), obs_dtype),
+                    "action": np.empty((n, act_dim), np.float32),
+                    "reward": np.empty(n, np.float32),
+                    "next_obs": np.empty((n, obs_dim), obs_dtype),
+                    "discount": np.empty(n, np.float32),
+                }
+                if self._use_native:
+                    from d4pg_tpu.replay import native as _native
+
+                    # ctypes pointers marshaled once per slot, not per call
+                    slot["_call"] = _native.SampleGatherCall(
+                        self._sum, self._min, self.obs, self.action,
+                        self.reward, self.next_obs, self.discount,
+                        self._gen, self._native_obs_mode(), slot,
+                    )
+                return slot
+
+            entry = {"slots": [mk() for _ in range(self.STAGING_SLOTS)], "next": 0}
+            self._staging[n] = entry
+        slot = entry["slots"][entry["next"]]
+        entry["next"] = (entry["next"] + 1) % len(entry["slots"])
+        return slot
+
+    def sample_block(
+        self, batch_size: int, k: int, rng: np.random.Generator, step: int = 0
+    ) -> dict:
+        """K stratified batches as contiguous [K, B, ...] blocks from ONE
+        backend call — the host half of a fused dispatch, with zero
+        steady-state allocation.
+
+        Native backend: a single C call does the K·B prefix-sum descents,
+        IS weights, generation capture, AND the row gather of every field
+        straight into the preallocated staging slot — no per-field fancy
+        indexing, no ``np.stack``, lock held only for that call. NumPy
+        backend: the seeded oracle — same draws (identical RNG consumption:
+        one ``uniform`` of size K·B over the stratified bounds), same dealt
+        layout, built through :meth:`_draw` + :meth:`gather`.
+
+        Batch i of the block equals ``sample_many``'s batch i exactly
+        (round-robin dealing: draw j lands at block[j % k, j // k]). The
+        field arrays are views of a reused staging slot — valid until
+        ``STAGING_SLOTS - 1`` further same-size calls; ``indices`` holds
+        fresh copies safe to retain for async priority write-back.
+
+        Unlike :meth:`sample`, concurrent ``sample_block`` calls must be
+        externally serialized (the trainer holds its buffer lock): the
+        staging-slot rotation is what makes the hot path zero-alloc, and
+        it hands out one slot per call, not per thread.
+        """
+        n = batch_size * k
+        st = self._staging_slot(n)
+        if self._use_native:
+            with self._lock:
+                total = self._sum.sum()
+                # Same stratified-draw recipe as _draw, byte-for-byte: the
+                # RNG stream is a determinism contract (tests pin it).
+                bounds = np.linspace(0.0, total, n + 1)
+                prefixes = rng.uniform(bounds[:-1], bounds[1:])
+                prefixes = np.minimum(prefixes, np.nextafter(total, 0.0))
+                st["_call"](prefixes, k, self._size, self.beta(step))
+        else:
+            idx, weights, gen = self._draw(n, rng, step)
+            # Deal draw j to block row (j % k)*B + j//k: order[r] enumerates
+            # the draw that lands at flattened block position r.
+            order = np.arange(n).reshape(batch_size, k).T.reshape(-1)
+            idx = idx[order]
+            st["idx"][:] = idx
+            st["gen"][:] = gen[order]
+            st["weights"][:] = weights[order]
+            flat = self.gather(idx)
+            for key, v in flat.items():
+                st[key][...] = v
+        block = lambda a: a.reshape((k, batch_size) + a.shape[1:])
+        out = {
+            key: block(st[key])
+            for key in ("obs", "action", "reward", "next_obs", "discount")
+        }
+        out["weights"] = block(st["weights"])
+        out["indices"] = SampledIndices(
+            block(st["idx"]).copy(), block(st["gen"]).copy()
+        )
+        return out
+
     def _snapshot_arrays(self) -> dict:
         data = super()._snapshot_arrays()
         n = self._size
@@ -207,20 +335,47 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         ``indices`` may be a raw index array or the :class:`SampledIndices`
         that :meth:`sample` returned; with the latter, entries whose slot was
         recycled since sampling (write generation changed) are dropped.
+        Arrays of any shape are accepted (a fused dispatch writes back
+        [K, B] blocks); they are flattened elementwise.
+
+        Native backend: the |td|+ε pass runs OUTSIDE the lock and the
+        generation filter + ^α + both tree updates + max-priority reduce
+        are ONE C call — the lock scope is microseconds regardless of batch
+        width, so write-back coalescing never stalls concurrent samplers.
         """
         priorities = np.abs(np.asarray(priorities, np.float64)) + self.eps
         assert np.all(priorities > 0)
+        if isinstance(indices, SampledIndices):
+            idx, sample_gen = indices.idx, indices.gen
+        else:
+            idx, sample_gen = indices, None
+        idx = np.ascontiguousarray(np.asarray(idx, np.int64).ravel())
+        pri = np.ascontiguousarray(priorities.ravel())
+        assert idx.size == pri.size
+        if sample_gen is not None:
+            sample_gen = np.ascontiguousarray(
+                np.asarray(sample_gen, np.int64).ravel()
+            )
+        if self._use_native:
+            from d4pg_tpu.replay import native as _native
+
+            with self._lock:
+                mx = _native.update_priorities(
+                    self._sum, self._min, idx, pri, sample_gen, self._gen,
+                    self.alpha,
+                )
+                if mx > 0.0:  # 0.0 == every entry dropped as recycled
+                    self._max_priority = max(self._max_priority, mx)
+            return
         with self._lock:
-            if isinstance(indices, SampledIndices):
-                live = self._gen[indices.idx] == indices.gen
+            if sample_gen is not None:
+                live = self._gen[idx] == sample_gen
                 if not live.all():
-                    indices = indices.idx[live]
-                    priorities = priorities[live]
-                    if indices.size == 0:
+                    idx = idx[live]
+                    pri = pri[live]
+                    if idx.size == 0:
                         return
-                else:
-                    indices = indices.idx
-            pa = priorities**self.alpha
-            self._sum.set(indices, pa)
-            self._min.set(indices, pa)
-            self._max_priority = max(self._max_priority, float(priorities.max()))
+            pa = pri**self.alpha
+            self._sum.set(idx, pa)
+            self._min.set(idx, pa)
+            self._max_priority = max(self._max_priority, float(pri.max()))

@@ -130,6 +130,14 @@ class HostActorPool:
             self._conns.append(parent)
             self._procs.append(p)
         self._closed = False
+        # Zero-alloc reply staging: the stacked per-step output arrays are
+        # preallocated once (dims from the first step's replies) and
+        # DOUBLE-buffered — callers retain pol_obs across exactly one step
+        # (act on it, then step again), so alternating two buffer sets
+        # keeps the retained arrays stable with no np.stack allocation per
+        # pool step. Retention beyond one step would need a copy.
+        self._reply_slots = None
+        self._reply_next = 0
 
     def reset_all(self, seed: Optional[int] = None) -> np.ndarray:
         """Reset every env; returns stacked obs [N, obs_dim]."""
@@ -160,35 +168,49 @@ class HostActorPool:
         """
         return self._step_cmd(actions, "step_goal")
 
+    def _reply_slot(self, obs_dim: int):
+        if self._reply_slots is None:
+            N = self.num_actors
+
+            def mk():
+                return (
+                    np.empty((N, obs_dim), np.float32),  # obs2
+                    np.empty(N, np.float32),             # rewards
+                    np.empty(N, bool),                   # terminated
+                    np.empty(N, bool),                   # truncated
+                    np.empty((N, obs_dim), np.float32),  # policy obs
+                    np.empty(N, bool),                   # success
+                    np.empty(N, bool),                   # success reported
+                )
+
+            self._reply_slots = (mk(), mk())
+        slot = self._reply_slots[self._reply_next]
+        self._reply_next ^= 1
+        return slot
+
     def _step_cmd(self, actions: np.ndarray, cmd: str):
         with_goals = cmd == "step_goal"
         actions = np.asarray(actions)
         for i, c in enumerate(self._conns):
             c.send((cmd, actions[i]))
-        obs2, rews, terms, truncs, pol_obs, succ, succ_rep = [], [], [], [], [], [], []
+        replies = [c.recv() for c in self._conns]
+        obs2, rews, terms, truncs, pol_obs, succ, succ_rep = self._reply_slot(
+            np.size(replies[0][0])
+        )
         g_prev, g_next = [], []
-        for c in self._conns:
-            reply = c.recv()
+        for i, reply in enumerate(replies):
             o2, r, te, tr, on, s = reply[:6]
-            obs2.append(o2)
-            rews.append(r)
-            terms.append(te)
-            truncs.append(tr)
-            pol_obs.append(on)
-            succ.append(bool(s) if s is not None else False)
-            succ_rep.append(s is not None)
+            obs2[i] = o2
+            rews[i] = r
+            terms[i] = te
+            truncs[i] = tr
+            pol_obs[i] = on
+            succ[i] = bool(s) if s is not None else False
+            succ_rep[i] = s is not None
             if with_goals:
                 g_prev.append(reply[6])
                 g_next.append(reply[7])
-        out = (
-            np.stack(obs2).astype(np.float32),
-            np.asarray(rews, np.float32),
-            np.asarray(terms, bool),
-            np.asarray(truncs, bool),
-            np.stack(pol_obs).astype(np.float32),
-            np.asarray(succ, bool),
-            np.asarray(succ_rep, bool),
-        )
+        out = (obs2, rews, terms, truncs, pol_obs, succ, succ_rep)
         return out + (g_prev, g_next) if with_goals else out
 
     def close(self) -> None:

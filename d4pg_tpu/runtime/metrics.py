@@ -5,6 +5,13 @@ via ``SummaryWriter``, ``main.py:352-353``) plus the throughput counters the
 BASELINE targets (grad-steps/sec, env-steps/sec, replay occupancy, per-step
 losses). JSONL is the machine-readable log the reference's pickle dicts
 (``main.py:255-265``) wanted to be.
+
+Per-stage pipeline telemetry: ``log(..., timers=StageTimers)`` appends the
+cumulative host data-plane counters — ``stage_<name>_s`` seconds and
+``stage_<name>_calls`` for each of env_step / replay_insert / sample /
+h2d_stage / train_dispatch / priority_writeback — to every row, so a
+training run's metrics.jsonl carries the same breakdown
+``bench.py bench_host_pipeline`` measures (schema: docs/data_plane.md).
 """
 
 from __future__ import annotations
@@ -59,14 +66,20 @@ class MetricsLogger:
         # jsonl lines never interleave mid-record.
         self._log_lock = threading.Lock()
 
-    def log(self, step: int, scalars: Mapping[str, float]) -> None:
+    def log(self, step: int, scalars: Mapping[str, float], timers=None) -> None:
+        """``timers`` (a :class:`~d4pg_tpu.utils.profiling.StageTimers`)
+        appends the per-stage cumulative counters to the row without
+        polluting the caller's scalars dict (console prints stay clean)."""
+        merged = {k: float(v) for k, v in scalars.items()}
+        if timers is not None:
+            merged.update(timers.scalars())
         rec = {"step": int(step), "t": time.monotonic() - self._t0}
-        rec.update({k: float(v) for k, v in scalars.items()})
+        rec.update(merged)
         with self._log_lock:
             self._jsonl.write(json.dumps(rec) + "\n")
             self._jsonl.flush()
             if self._tb is not None:
-                for k, v in scalars.items():
+                for k, v in merged.items():
                     self._tb.add_scalar(k, float(v), int(step))
 
     def close(self) -> None:

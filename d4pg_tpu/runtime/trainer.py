@@ -48,6 +48,7 @@ from d4pg_tpu.envs import make_env
 from d4pg_tpu.envs.pointmass_goal import PointMassGoal
 from d4pg_tpu.models.critic import DistConfig
 from d4pg_tpu.replay import (
+    BatchedNStepWriter,
     HindsightWriter,
     NStepWriter,
     PrioritizedReplayBuffer,
@@ -55,6 +56,7 @@ from d4pg_tpu.replay import (
     Transition,
     noise_scale_schedule,
 )
+from d4pg_tpu.replay.per import SampledIndices
 from d4pg_tpu.runtime.checkpoint import (
     CheckpointManager,
     best_eval_path,
@@ -64,7 +66,7 @@ from d4pg_tpu.runtime.checkpoint import (
 )
 from d4pg_tpu.runtime.evaluator import evaluate
 from d4pg_tpu.runtime.metrics import MetricsLogger, interval_crossed
-from d4pg_tpu.utils.profiling import annotate
+from d4pg_tpu.utils.profiling import StageTimers, annotate
 
 
 _warned_no_procfs = False
@@ -320,6 +322,11 @@ class Trainer:
             )
 
         self.metrics = MetricsLogger(config.log_dir)
+        # Per-stage data-plane wall-time counters (env-step / replay-insert
+        # / sample / H2D-stage / train-dispatch / priority-write-back),
+        # shared by every thread and appended to each metrics.jsonl row —
+        # the per-stage view bench_host_pipeline summarizes.
+        self._timers = StageTimers()
         self.ckpt = CheckpointManager(f"{config.log_dir}/checkpoints")
         self.grad_steps = 0
         self.env_steps = 0
@@ -528,13 +535,15 @@ class Trainer:
     def _collect_once(self, noise_scale: Optional[float] = None) -> None:
         self.key, k = jax.random.split(self.key)
         scale = self._noise_scale() if noise_scale is None else noise_scale
-        self.env_states, self.obs, self.noise_states, flat, _traj = self._collect(
-            self.state.actor_params, self.env_states, self.obs,
-            self.noise_states, k, scale,
-        )
-        flat = jax.device_get(flat)
-        with self._buffer_lock:
-            self.buffer.add_batch(Transition(**flat))
+        with self._timers.stage("env_step"):
+            self.env_states, self.obs, self.noise_states, flat, _traj = self._collect(
+                self.state.actor_params, self.env_states, self.obs,
+                self.noise_states, k, scale,
+            )
+            flat = jax.device_get(flat)
+        with self._timers.stage("replay_insert"):
+            with self._buffer_lock:
+                self.buffer.add_batch(Transition(**flat))
         self.env_steps += self.config.num_envs * self.segment_len
 
     # ------------------------------------------------------------------ host
@@ -573,17 +582,19 @@ class Trainer:
         scale = self._noise_scale() if noise_scale is None else noise_scale
         params = self._acting_params()
         for _ in range(num_steps):
-            self._host_key, k = jax.random.split(self._host_key)
-            a_dev, self._host_noise = self._host_act(
-                params,
-                self._ingest_obs(np.asarray(self._host_obs))[None],
-                k,
-                self._host_noise,
-                scale,
-            )
-            a = np.asarray(a_dev)
-            obs2, r, term, trunc, info = self.env.step(a)
-            w.add(self._host_obs, a, r, obs2, terminated=term, truncated=trunc)
+            with self._timers.stage("env_step"):
+                self._host_key, k = jax.random.split(self._host_key)
+                a_dev, self._host_noise = self._host_act(
+                    params,
+                    self._ingest_obs(np.asarray(self._host_obs))[None],
+                    k,
+                    self._host_noise,
+                    scale,
+                )
+                a = np.asarray(a_dev)
+                obs2, r, term, trunc, info = self.env.step(a)
+            with self._timers.stage("replay_insert"):
+                w.add(self._host_obs, a, r, obs2, terminated=term, truncated=trunc)
             if term or trunc:
                 self._host_obs = self.env.reset()
                 self._host_noise = self._noise_reset(self._host_noise)
@@ -609,10 +620,13 @@ class Trainer:
             action_repeat=cfg.action_repeat,
         )
         self.has_pool = True
-        self.writers = [
-            NStepWriter(self.buffer, cfg.n_step, cfg.agent.gamma)
-            for _ in range(cfg.num_envs)
-        ]
+        # One N-wide writer: vectorized window append + ONE add_batch per
+        # pool step, instead of num_envs NStepWriter.add calls each paying
+        # a deque walk + single-row insert (HER pool mode keeps per-actor
+        # HindsightWriters — relabeling is episode-local by construction).
+        self.batched_writer = BatchedNStepWriter(
+            self.buffer, cfg.num_envs, cfg.n_step, cfg.agent.gamma
+        )
         self._pool_obs = self.pool.reset_all(seed=cfg.seed)
         self._pool_noise = self._to_act_device(
             jax.vmap(lambda _: self._noise_init())(jnp.arange(cfg.num_envs))
@@ -653,47 +667,48 @@ class Trainer:
         N = cfg.num_envs
         params = self._acting_params()
         for _ in range(max(1, -(-num_steps // N))):
-            self._collect_key, k = jax.random.split(self._collect_key)
-            a_dev, self._pool_noise = self._pool_act(
-                params,
-                self._ingest_obs(np.asarray(self._pool_obs)),
-                k,
-                self._pool_noise,
-                scale,
-            )
-            actions = np.asarray(a_dev)
-            if cfg.her:
-                (obs2, rews, terms, truncs, pol_obs, _succ, _rep,
-                 g_prev, g_next) = self.pool.step_goal(actions)
-                for i in range(N):
-                    self.her_writers[i].add(
-                        observation=g_prev[i][0],
-                        achieved_goal=g_prev[i][1],
-                        desired_goal=g_prev[i][2],
-                        action=actions[i],
-                        reward=float(rews[i]),
-                        next_observation=g_next[i][0],
-                        next_achieved_goal=g_next[i][1],
-                        terminated=bool(terms[i]),
-                    )
-                    if terms[i] or truncs[i]:
-                        with self._buffer_lock:
-                            self.her_writers[i].end_episode(
-                                truncated=not bool(terms[i])
-                            )
-            else:
-                obs2, rews, terms, truncs, pol_obs, _succ, _rep = self.pool.step(
-                    actions
+            with self._timers.stage("env_step"):
+                self._collect_key, k = jax.random.split(self._collect_key)
+                a_dev, self._pool_noise = self._pool_act(
+                    params,
+                    self._ingest_obs(np.asarray(self._pool_obs)),
+                    k,
+                    self._pool_noise,
+                    scale,
                 )
-                with self._buffer_lock:
+                actions = np.asarray(a_dev)
+                if cfg.her:
+                    (obs2, rews, terms, truncs, pol_obs, _succ, _rep,
+                     g_prev, g_next) = self.pool.step_goal(actions)
+                else:
+                    obs2, rews, terms, truncs, pol_obs, _succ, _rep = (
+                        self.pool.step(actions)
+                    )
+            if cfg.her:
+                with self._timers.stage("replay_insert"):
                     for i in range(N):
-                        self.writers[i].add(
-                            self._pool_obs[i],
-                            actions[i],
-                            float(rews[i]),
-                            obs2[i],
+                        self.her_writers[i].add(
+                            observation=g_prev[i][0],
+                            achieved_goal=g_prev[i][1],
+                            desired_goal=g_prev[i][2],
+                            action=actions[i],
+                            reward=float(rews[i]),
+                            next_observation=g_next[i][0],
+                            next_achieved_goal=g_next[i][1],
                             terminated=bool(terms[i]),
-                            truncated=bool(truncs[i]),
+                        )
+                        if terms[i] or truncs[i]:
+                            with self._buffer_lock:
+                                self.her_writers[i].end_episode(
+                                    truncated=not bool(terms[i])
+                                )
+            else:
+                # N-wide block emit: one vectorized writer call, one ring
+                # insert — no per-transition Python loop on the hot path.
+                with self._timers.stage("replay_insert"):
+                    with self._buffer_lock:
+                        self.batched_writer.add_batch(
+                            self._pool_obs, actions, rews, obs2, terms, truncs
                         )
             done = terms | truncs
             if done.any():
@@ -791,17 +806,19 @@ class Trainer:
                     else:
                         items.append(nxt)
                 if items:
-                    idx_all = [ix for idxs, _ in items for ix in idxs]
-                    # Host-side concatenation consumes the async D2H copies
-                    # _queue_writeback already started (a device-side concat
-                    # would re-transfer every block a second time).
-                    pri = np.concatenate(
-                        [np.asarray(p) for _, p in items], axis=0
-                    )
-                    with self._buffer_lock:
-                        for k, ix in enumerate(idx_all):
-                            if ix is not None:
-                                self.buffer.update_priorities(ix, pri[k])
+                    with self._timers.stage("priority_writeback"):
+                        idx_all = [ix for idxs, _ in items for ix in idxs]
+                        # Host-side concatenation consumes the async D2H
+                        # copies _queue_writeback already started (a
+                        # device-side concat would re-transfer every block a
+                        # second time).
+                        pri = np.concatenate(
+                            [np.asarray(p) for _, p in items], axis=0
+                        )
+                        with self._buffer_lock:
+                            for k, ix in enumerate(idx_all):
+                                if ix is not None:
+                                    self.buffer.update_priorities(ix, pri[k])
                 with self._wb_idle_lock:
                     if self._wb_queue.empty():
                         # idle == queue drained AND updates applied; producers
@@ -850,14 +867,18 @@ class Trainer:
             raise RuntimeError(
                 "priority write-back thread died"
             ) from self._wb_error
-        if not isinstance(indices, list):  # K=1 dispatch: [B] → [1, B]
-            indices = [indices]
-            priorities = priorities[None]
-        if hasattr(priorities, "copy_to_host_async"):
-            priorities.copy_to_host_async()
-        with self._wb_idle_lock:
-            self._wb_idle.clear()
-            self._wb_queue.put((indices, priorities))
+        with self._timers.stage("priority_writeback"):
+            if not isinstance(indices, list):
+                # K=1 dispatch ([B] idx/pri) or a [K, B] block sample whose
+                # single SampledIndices covers the whole dispatch: both wrap
+                # to a one-element group for the flusher.
+                indices = [indices]
+                priorities = priorities[None]
+            if hasattr(priorities, "copy_to_host_async"):
+                priorities.copy_to_host_async()
+            with self._wb_idle_lock:
+                self._wb_idle.clear()
+                self._wb_queue.put((indices, priorities))
 
     def _drain_writeback(self, timeout: float = 60.0) -> None:
         """Block until the flusher has applied everything queued so far —
@@ -1122,24 +1143,56 @@ class Trainer:
         step N's device compute — the input-side symmetric of the async
         priority write-back.
 
-        K>1: the K host-sampled batches stack to one [K, B] ``lax.scan``
+        K>1: the K host-sampled batches form one [K, B] ``lax.scan``
         dispatch, paying per-call latency (the dominant cost on remote
-        TPUs) once per K grad steps."""
+        TPUs) once per K grad steps.
+
+        PER path: :meth:`~d4pg_tpu.replay.PrioritizedReplayBuffer.sample_block`
+        delivers the [K, B] block straight from the backend's preallocated
+        staging buffers — with the native backend that is ONE C call
+        (descent + weights + generation capture + all-field gather) and no
+        ``np.stack``/per-field fancy indexing on the host; the NumPy
+        backend draws the identical seeded stream. Uniform replay keeps the
+        per-batch path."""
+        cfg = self.config
+        if cfg.prioritized and hasattr(self.buffer, "sample_block"):
+            with self._timers.stage("sample"):
+                with self._buffer_lock:
+                    block = self.buffer.sample_block(
+                        cfg.batch_size, K, self._rng, step=self.grad_steps
+                    )
+                indices = block.pop("indices")
+                if K == 1:  # [1, B] block → the flat [B] batch K=1 dispatches use
+                    indices = SampledIndices(indices.idx[0], indices.gen[0])
+                    block = {k: v[0] for k, v in block.items()}
+                if self.obs_norm is not None:
+                    # normalize ONLY — stats are folded at collection time
+                    # (_ingest_obs); see _sample. Returns fresh arrays, so
+                    # the staging buffers stay pristine for reuse.
+                    block["obs"] = self.obs_norm.normalize(block["obs"])
+                    block["next_obs"] = self.obs_norm.normalize(block["next_obs"])
+            with self._timers.stage("h2d_stage"):
+                dev_batch = {
+                    k: jnp.asarray(self._stage(k, v)) for k, v in block.items()
+                }
+            return indices, dev_batch
         if K == 1:
-            with annotate("host/sample"):
+            with self._timers.stage("sample"):
                 batch = self._sample()
             indices = batch.pop("indices", None)
-            dev_batch = {
-                k: jnp.asarray(self._stage(k, v)) for k, v in batch.items()
-            }
+            with self._timers.stage("h2d_stage"):
+                dev_batch = {
+                    k: jnp.asarray(self._stage(k, v)) for k, v in batch.items()
+                }
         else:
-            with annotate("host/sample"):
+            with self._timers.stage("sample"):
                 samples = self._sample_k(K)
             indices = [s.pop("indices", None) for s in samples]
-            dev_batch = {
-                k: jnp.asarray(self._stage(k, np.stack([s[k] for s in samples])))
-                for k in samples[0]
-            }
+            with self._timers.stage("h2d_stage"):
+                dev_batch = {
+                    k: jnp.asarray(self._stage(k, np.stack([s[k] for s in samples])))
+                    for k in samples[0]
+                }
         return indices, dev_batch
 
     def _norm_obs(self, x: np.ndarray) -> np.ndarray:
@@ -1242,7 +1295,7 @@ class Trainer:
                     indices, dev_batch = self._sample_staged(K)
                 # dispatch is async: the TPU runs while we prefetch the next
                 # batch and write back the PREVIOUS step's priorities
-                with annotate("host/dispatch"):
+                with self._timers.stage("train_dispatch"):
                     if K == 1:
                         self.state, metrics, priorities = self._train_step(
                             self.state, dev_batch
@@ -1264,12 +1317,10 @@ class Trainer:
                         staged = self._sample_staged(K)
                 if self.config.prioritized:
                     if self._wb_thread is not None:
-                        with annotate("host/priority_writeback"):
-                            self._queue_writeback(indices, priorities)
+                        self._queue_writeback(indices, priorities)
                     else:
                         if pending is not None:
-                            with annotate("host/priority_writeback"):
-                                self._write_back(pending)
+                            self._write_back(pending)
                         if hasattr(priorities, "copy_to_host_async"):
                             # Start the D2H transfer now; the one-dispatch
                             # pipeline lag then fetches an already-copied
@@ -1374,16 +1425,18 @@ class Trainer:
 
     def _write_back(self, pending) -> None:
         """Flush one dispatch's PER priorities: ([B] idx, [B] pri) for K=1,
-        (list of K [B] idx, [K, B] pri) for fused dispatches."""
+        a [K, B] SampledIndices + [K, B] pri for fused block dispatches
+        (or the legacy list-of-K form from the non-block sampler)."""
         idx, pri_dev = pending
-        pri = np.asarray(pri_dev)
-        with self._buffer_lock:
-            if isinstance(idx, list):
-                for k, ix in enumerate(idx):
-                    if ix is not None:
-                        self.buffer.update_priorities(ix, pri[k])
-            elif idx is not None:
-                self.buffer.update_priorities(idx, pri)
+        with self._timers.stage("priority_writeback"):
+            pri = np.asarray(pri_dev)
+            with self._buffer_lock:
+                if isinstance(idx, list):
+                    for k, ix in enumerate(idx):
+                        if ix is not None:
+                            self.buffer.update_priorities(ix, pri[k])
+                elif idx is not None:
+                    self.buffer.update_priorities(idx, pri)
 
     def _pool_eval(self, eval_params=None) -> dict:
         """All eval episodes in parallel through a dedicated actor pool —
@@ -1544,7 +1597,10 @@ class Trainer:
         if self._best_eval is not None:
             scalars["best_eval_return"] = self._best_eval
         scalars["avg_test_reward_ewma"] = self.ewma_return
-        self.metrics.log(step, scalars)
+        # timers= appends the cumulative per-stage data-plane counters to
+        # the jsonl row (kept out of `scalars` so the console line and the
+        # returned dict stay readable).
+        self.metrics.log(step, scalars, timers=self._timers)
         print(
             f"[step {step}] "
             + " ".join(f"{k}={v:.3f}" for k, v in scalars.items() if k != "replay_size")
@@ -1578,7 +1634,7 @@ class Trainer:
             self._eval_pending.set()
         if replaced is not None:
             _, r_step, r_scalars, _ = replaced
-            self.metrics.log(r_step, r_scalars)
+            self.metrics.log(r_step, r_scalars, timers=self._timers)
 
     def _drain_eval(self, timeout: float = 600.0) -> None:
         """Wait for in-flight + pending evals (end of train(): the final

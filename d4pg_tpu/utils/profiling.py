@@ -1,4 +1,4 @@
-"""Profiling: XLA trace capture + named annotations.
+"""Profiling: XLA trace capture, named annotations, per-stage counters.
 
 The reference's only timing is wall-clock deltas in train logs
 (``main.py:250,359``; SURVEY.md §5 'tracing/profiling'). Here:
@@ -6,7 +6,12 @@ The reference's only timing is wall-clock deltas in train logs
 - :func:`profile_trace` captures a TensorBoard-viewable XLA trace (HLO
   timelines, per-op device time) for a bounded window;
 - :func:`annotate` tags host-side phases (sample/dispatch/priority-writeback)
-  so host stalls show up next to device ops in the trace viewer.
+  so host stalls show up next to device ops in the trace viewer;
+- :class:`StageTimers` keeps cumulative wall-time counters per host
+  data-plane stage (env_step / replay_insert / sample / h2d_stage /
+  train_dispatch / priority_writeback) that flow into ``metrics.jsonl``
+  (via :class:`~d4pg_tpu.runtime.MetricsLogger`) and into
+  ``bench.py bench_host_pipeline`` — the schema is in docs/data_plane.md.
 
 Throughput counters (grad-steps/sec, env-steps/sec, replay occupancy) are
 emitted continuously by :class:`d4pg_tpu.runtime.MetricsLogger`.
@@ -15,6 +20,8 @@ emitted continuously by :class:`d4pg_tpu.runtime.MetricsLogger`.
 from __future__ import annotations
 
 import contextlib
+import threading
+import time
 
 import jax
 
@@ -35,3 +42,76 @@ def profile_trace(log_dir: str | None):
 def annotate(name: str):
     """Named region that appears on the host timeline of the trace."""
     return jax.profiler.TraceAnnotation(name)
+
+
+class StageTimers:
+    """Cumulative per-stage wall-time counters for the host data-plane.
+
+    One instance per trainer/bench; ``stage(name)`` is a context manager
+    that adds the enclosed wall time to the named counter (and, when
+    ``annotate_prefix`` is set, also opens a :func:`annotate` region so the
+    same stages line up on profiler traces). Thread-safe: the collector,
+    learner, write-back, and evaluator threads all report into one set of
+    counters, so the jsonl rows show TOTAL host-side time per stage —
+    divide by ``stage_<name>_calls`` for per-call cost.
+
+    The canonical stage names (the metrics.jsonl schema, docs/data_plane.md)
+    are in :attr:`STAGES`; ``stage()`` accepts any name.
+    """
+
+    STAGES = (
+        "env_step",            # acting forward + env/pool physics step
+        "replay_insert",       # n-step writer emit + ring/tree insert
+        "sample",              # PER descent + gather into staging buffers
+        "h2d_stage",           # wire-format cast + device_put enqueue
+        "train_dispatch",      # jitted train-step dispatch (async enqueue)
+        "priority_writeback",  # D2H priority fetch + gen-filtered tree set
+    )
+
+    def __init__(self, annotate_prefix: str | None = "host/"):
+        self._prefix = annotate_prefix
+        self._lock = threading.Lock()
+        self._acc: dict[str, float] = {}
+        self._n: dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def stage(self, name: str):
+        ann = (
+            annotate(self._prefix + name)
+            if self._prefix
+            else contextlib.nullcontext()
+        )
+        t0 = time.perf_counter()
+        try:
+            with ann:
+                yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self._acc[name] = self._acc.get(name, 0.0) + dt
+                self._n[name] = self._n.get(name, 0) + 1
+
+    def scalars(self) -> dict:
+        """Flat metrics row: ``stage_<name>_s`` cumulative seconds plus
+        ``stage_<name>_calls`` — per-stage rates fall out of successive
+        jsonl rows by differencing."""
+        with self._lock:
+            out: dict = {}
+            for k, v in self._acc.items():
+                out[f"stage_{k}_s"] = v
+                out[f"stage_{k}_calls"] = float(self._n[k])
+            return out
+
+    def summary_ms(self, per: int | None = None) -> dict:
+        """Mean milliseconds per call (or per ``per`` units, e.g. per
+        dispatch for stages that run once per dispatch)."""
+        with self._lock:
+            return {
+                k: v * 1e3 / (per if per else max(self._n[k], 1))
+                for k, v in self._acc.items()
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._acc.clear()
+            self._n.clear()
