@@ -443,6 +443,273 @@ def bench_host_pipeline(
     }
 
 
+def bench_serve(
+    *,
+    obs_dim: int = OBS_DIM,
+    act_dim: int = ACT_DIM,
+    hidden: int = 64,
+    max_batch: int = 32,
+    max_wait_us: int = 1000,
+    queue_limit: int | None = None,
+    closed_profiles: tuple = ((1, 1), (4, 16)),
+    open_load_factors: tuple = (0.5, 1.0, 2.0),
+    open_rates: tuple | None = None,
+    duration_s: float = 2.0,
+    deadline_ms: float = 0.0,
+    infer_delay_ms: float = 0.0,
+    seed: int = 0,
+) -> dict:
+    """Open+closed-loop load generator against a live policy server.
+
+    Starts a real :class:`~d4pg_tpu.serve.PolicyServer` (socket front-end,
+    dynamic batcher, the whole stack) on loopback and drives it two ways:
+
+    - **closed loop** — ``closed_profiles`` of ``(conns, window)``:
+      pipelined connections each keeping ``window`` requests in flight,
+      every completion immediately triggering the next send. ``(1, 1)``
+      is the single-request throughput floor (each request pays the full
+      batching window + device call — the honest cost of the serving
+      configuration at one client); the widest profile saturates the
+      batcher, and the headline ``batched_over_single`` ratio is
+      saturated ÷ single throughput — the dynamic-batching win.
+    - **open loop** — requests issued at a FIXED offered rate regardless
+      of reply latency (pipelined client + pacer; catch-up bursts when the
+      pacer falls behind), at multiples of the measured saturation
+      throughput. This is the regime that exposes load shedding: past
+      saturation a closed-loop client just slows down, an open-loop
+      arrival process fills the queue and the server must say
+      ``overloaded``. Reported per level: achieved rate, shed rate, and
+      client-measured p50/p95/p99 of the requests that WERE served.
+
+    Chip-independent by the same argument as ``bench_host_pipeline``: the
+    batching/queue/socket mechanics are host CPU work; only the actor
+    forward runs on the backend, and the comparison (batched vs single,
+    shed behavior under offered load) holds on any device.
+    """
+    import threading
+
+    from d4pg_tpu.agent.state import D4PGConfig
+    from d4pg_tpu.models.critic import DistConfig
+    from d4pg_tpu.serve import Overloaded, PolicyBundle, PolicyClient, PolicyServer
+    from d4pg_tpu.serve.bundle import actor_template
+    from d4pg_tpu.serve.client import ConnectionClosed
+
+    config = D4PGConfig(
+        obs_dim=obs_dim,
+        action_dim=act_dim,
+        hidden_sizes=(hidden, hidden, hidden),
+        dist=DistConfig(kind="categorical", num_atoms=ATOMS, v_min=V_MIN, v_max=V_MAX),
+    )
+    bundle = PolicyBundle(
+        config=config,
+        actor_params=actor_template(config),
+        action_low=np.full(act_dim, -1.0, np.float32),
+        action_high=np.full(act_dim, 1.0, np.float32),
+        obs_norm=None,
+        meta={"source": "bench_serve"},
+    )
+    server = PolicyServer(
+        bundle,
+        port=0,
+        max_batch=max_batch,
+        max_wait_us=max_wait_us,
+        queue_limit=queue_limit or 4 * max_batch,
+        watch_bundle=False,
+    )
+    server.start()
+    if infer_delay_ms:
+        # Slow-device stub for the OVERLOAD scenario: on a few-core bench
+        # host the stdlib load generator cannot out-pace the real batcher
+        # (it serves >1k rps while the generator tops out about there), so
+        # shedding never engages. Padding each device call makes the
+        # capacity crossover — and the queue-full/deadline shed behavior
+        # past it — measurable; the artifact labels these rows with the
+        # stub delay so nobody reads them as device throughput.
+        real_infer = server.batcher._infer
+
+        def slow_infer(params, obs_batch):
+            time.sleep(infer_delay_ms / 1e3)
+            return real_infer(params, obs_batch)
+
+        server.batcher._infer = slow_infer
+    rng = np.random.default_rng(seed)
+    obs = rng.normal(size=obs_dim).astype(np.float32)
+
+    def pct(lat):
+        if not lat:
+            return {"p50_ms": None, "p95_ms": None, "p99_ms": None}
+        v = np.percentile(np.asarray(lat), (50, 95, 99))
+        return {f"p{q}_ms": round(float(x) * 1e3, 4) for q, x in zip((50, 95, 99), v)}
+
+    def closed_loop(n_conns: int, window: int) -> dict:
+        """``n_conns`` pipelined connections, each holding ``window``
+        requests in flight (a completion immediately triggers the next
+        send, from the client reader thread). conns×window is the closed
+        population; (1, 1) is the strict one-at-a-time single-request
+        floor. Pipelining — not a thread per simulated user — because N
+        blocking threads measure the load generator's GIL thrash, not the
+        server, on a few-core bench host."""
+        lats: list[float] = []
+        counts = {"done": 0, "shed": 0}
+        lock = threading.Lock()
+        stop = threading.Event()
+        clients = [
+            PolicyClient("127.0.0.1", server.port) for _ in range(n_conns)
+        ]
+        idle = threading.Semaphore(0)  # released once per drained chain
+
+        def send_next(c):
+            # No deadline in the closed phase: it measures CAPACITY, and a
+            # deadline under a big closed population just converts queue
+            # wait into shed/retry churn that reads as lost throughput.
+            # Deadlines (the SLO) belong to the open-loop phase.
+            t0 = time.perf_counter()
+            fut = c.act_async(obs)
+
+            def done(f, t0=t0):
+                exc = f.exception()
+                with lock:
+                    if exc is None:
+                        counts["done"] += 1
+                        lats.append(time.perf_counter() - t0)
+                    else:
+                        counts["shed"] += 1  # closed loop: replaced below
+                if stop.is_set() or isinstance(exc, ConnectionClosed):
+                    idle.release()
+                else:
+                    send_next(c)  # back-to-back: the closed-loop property
+
+            fut.add_done_callback(done)
+
+        t_start = time.perf_counter()
+        for c in clients:
+            for _ in range(window):
+                send_next(c)
+        time.sleep(duration_s)
+        stop.set()
+        for _ in range(n_conns * window):
+            idle.acquire(timeout=30)
+        dt = time.perf_counter() - t_start
+        for c in clients:
+            c.close()
+        return {
+            "conns": n_conns,
+            "window": window,
+            "population": n_conns * window,
+            "throughput_rps": round(counts["done"] / dt, 2),
+            "completed": counts["done"],
+            "shed": counts["shed"],
+            **pct(lats),
+        }
+
+    def open_loop(offered_rps: float) -> dict:
+        counts = {"ok": 0, "ok_window": 0, "shed": 0, "err": 0}
+        lats: list[float] = []
+        lock = threading.Lock()
+        futures = []
+        with PolicyClient("127.0.0.1", server.port) as c:
+            interval = 1.0 / offered_rps
+            t_next = time.perf_counter()
+            t_end = t_next + duration_s
+            while time.perf_counter() < t_end:
+                now = time.perf_counter()
+                if now < t_next:
+                    time.sleep(t_next - now)
+                t_next += interval
+                t0 = time.perf_counter()
+                fut = c.act_async(obs, deadline_ms=deadline_ms or None)
+
+                def tally(f, t0=t0):
+                    t_done = time.perf_counter()
+                    exc = f.exception()
+                    with lock:
+                        if exc is None:
+                            counts["ok"] += 1
+                            # The rate only credits completions INSIDE the
+                            # offered window — the tail that drains from
+                            # the queue afterwards is latency, not
+                            # sustained throughput (it would inflate
+                            # achieved_rps by ~queue_limit/duration at
+                            # overload levels).
+                            if t_done <= t_end:
+                                counts["ok_window"] += 1
+                            lats.append(t_done - t0)
+                        elif isinstance(exc, Overloaded):
+                            counts["shed"] += 1
+                        else:
+                            counts["err"] += 1
+
+                fut.add_done_callback(tally)
+                futures.append(fut)
+            deadline = time.perf_counter() + 30
+            for fut in futures:
+                try:
+                    fut.result(max(0.01, deadline - time.perf_counter()))
+                except Exception:
+                    pass  # completed futures were tallied by the callback
+        # Futures still unresolved after the collective wait never reached
+        # a tally callback — count them as lost so total (and shed_rate's
+        # denominator) reflects every request actually offered.
+        lost = sum(1 for f in futures if not f.done())
+        total = counts["ok"] + counts["shed"] + counts["err"] + lost
+        return {
+            "offered_rps": round(offered_rps, 2),
+            "achieved_rps": round(counts["ok_window"] / duration_s, 2),
+            "ok": counts["ok"],
+            "shed": counts["shed"],
+            "errors": counts["err"],
+            "lost": lost,
+            "shed_rate": round(counts["shed"] / total, 4) if total else None,
+            **pct(lats),
+        }
+
+    try:
+        closed = [closed_loop(m, w) for m, w in closed_profiles]
+        single = closed[0]["throughput_rps"]
+        saturated = max(c["throughput_rps"] for c in closed)
+        levels = (
+            list(open_rates)
+            if open_rates
+            else [max(1.0, f * saturated) for f in open_load_factors]
+        )
+        open_levels = [open_loop(r) for r in levels]
+        health = server.healthz()
+    finally:
+        server.drain()
+    return {
+        "config": {
+            "obs_dim": obs_dim,
+            "act_dim": act_dim,
+            "hidden": hidden,
+            "max_batch": max_batch,
+            "max_wait_us": max_wait_us,
+            "queue_limit": queue_limit or 4 * max_batch,
+            "duration_s": duration_s,
+            "deadline_ms": deadline_ms,
+            "infer_delay_ms": infer_delay_ms,
+        },
+        "closed_loop": closed,
+        "single_rps": single,
+        "saturated_rps": saturated,
+        "batched_over_single": round(saturated / single, 3) if single else None,
+        "open_loop": open_levels,
+        "server": {
+            k: health[k]
+            for k in (
+                "batches_total",
+                "mean_batch",
+                "batch_size_hist",
+                "queue_depth_hist",
+                "compile_count",
+                "shed_total",
+                "replies_ok",
+                "params_version",
+            )
+            if k in health
+        },
+    }
+
+
 def bench_torch_cpu_baseline() -> float:
     """Reference-style D4PG step: CPU torch nets + host NumPy projection."""
     import torch
@@ -581,6 +848,15 @@ def main(argv=None) -> None:
         "CPU-backend host-pipeline numbers (a second JSON line) after the "
         "structured tpu_unreachable line",
     )
+    ap.add_argument(
+        "--serve",
+        action="store_true",
+        help="run the serving load generator (bench_serve: closed-loop "
+        "single-vs-saturated throughput + open-loop shed/latency per load "
+        "level) against an in-process policy server on the current "
+        "backend, print ONE JSON line, and exit; the committed "
+        "chip-independent artifact is benchmarks/serve_microbench.json",
+    )
     args = ap.parse_args(argv)
     # Hermetic gate: the driver must get ONE parseable JSON line even when
     # the TPU tunnel is wedged (raises, hangs, or silently downgrades to
@@ -655,6 +931,18 @@ def main(argv=None) -> None:
                         }
                     )
                 )
+        return
+    # --serve runs AFTER the hermetic gate on purpose: bench_serve
+    # initializes the backend in-process, which on a wedged tunnel raises,
+    # hangs, or silently downgrades (the exact failure modes the probe
+    # exists to intercept). A deliberate CPU run is JAX_PLATFORMS=cpu.
+    if args.serve:
+        out = bench_serve()
+        out["metric"] = "serve_loadgen"
+        import jax
+
+        out["backend"] = jax.default_backend()
+        print(json.dumps(out))
         return
     tpu = bench_tpu()
     # bf16 flagship line (same program, bf16 matmuls): the repo's own

@@ -49,8 +49,31 @@ from d4pg_tpu.envs.gym_adapter import NormalizeAction
 DMC_VALUE_RANGE = (0.0, 1000.0)
 
 
+def _egl_loadable() -> bool:
+    """Can this process load libEGL at all? dm_control imports its renderer
+    AT IMPORT TIME, so with ``MUJOCO_GL=egl`` on an image without a GL
+    stack even state-mode (never-rendering) envs die inside
+    ``OpenGL.raw.EGL`` — the exact environmental failure tier-1 used to
+    carry. A cheap dlopen probe decides before the import commits."""
+    import ctypes
+    import ctypes.util
+
+    try:
+        ctypes.CDLL(ctypes.util.find_library("EGL") or "libEGL.so.1")
+        return True
+    except OSError:
+        return False
+
+
 def _load_suite():
-    os.environ.setdefault("MUJOCO_GL", "egl")
+    # An explicit MUJOCO_GL always wins (the probe only picks the default):
+    # EGL when loadable — pixel rendering works and state mode is
+    # unaffected; otherwise "disabled" — dm_control imports with
+    # Renderer=None, state-mode physics runs fine, and pixel mode raises a
+    # clear error below instead of an AttributeError five frames deep in
+    # PyOpenGL.
+    if "MUJOCO_GL" not in os.environ:
+        os.environ["MUJOCO_GL"] = "egl" if _egl_loadable() else "disabled"
     from dm_control import suite
 
     return suite
@@ -109,6 +132,13 @@ class DMControlAdapter:
         self.action_dim = int(np.prod(spec.shape))
         self._render_kwargs = {}
         if pixels:
+            if os.environ.get("MUJOCO_GL") == "disabled":
+                raise RuntimeError(
+                    "dmc_pixels needs a working GL backend, but MUJOCO_GL="
+                    "disabled (either set explicitly, or chosen by the "
+                    "EGL-availability probe on an image without libEGL); "
+                    "state-mode dmc: envs still work"
+                )
             self.pixel_shape = (size, size, 2)
             self.observation_dim = size * size * 2
             # MEASURED on this image (round 5): the GL stack is llvmpipe

@@ -358,7 +358,7 @@ def make_on_device_trainer(
     return init_fn, warmup_fn, iterate_fn
 
 
-def run_on_device(config) -> dict:
+def run_on_device(config, preempt_event=None) -> dict:
     """CLI driver for the fully on-device loop (``train.py --on-device``).
 
     Wraps (init_fn, iterate_fn) with the same periphery the host
@@ -609,6 +609,17 @@ def run_on_device(config) -> dict:
             _eval_and_log(None)
             return last
         while grad_steps < total:
+            if preempt_event is not None and preempt_event.is_set():
+                # SIGTERM/SIGINT path (train.py handlers set the event):
+                # same checkpoint + exit-75 contract as the RSS watchdog.
+                _save()
+                print(
+                    f"[preempt] stop requested: checkpointed at step "
+                    f"{grad_steps}; exiting for a --resume restart"
+                )
+                last = dict(last)
+                last["_preempted"] = True
+                break
             carry, m = iterate_fn(carry, _noise_scale())
             prev = grad_steps
             grad_steps += K

@@ -105,11 +105,26 @@ def load_best_actor(log_dir: str, template):
     keep-best path) into the structure of ``template`` — a freshly-built
     actor params pytree with the run's net shapes. Leaves were saved in
     tree_flatten order under zero-padded keys, so sorted(files) restores
-    that order exactly."""
+    that order exactly. Leaf shapes are validated against the template:
+    tree_unflatten alone checks only the leaf COUNT, so e.g. an
+    --export-bundle with --hidden-sizes mismatching the checkpoint would
+    otherwise succeed silently and only blow up at serve-time load."""
     path = os.path.join(log_dir, "checkpoints", "best_actor.npz")
     with np.load(path) as z:
         leaves = [z[k] for k in sorted(z.files)]
-    treedef = jax.tree_util.tree_structure(template)
+    t_leaves, treedef = jax.tree_util.tree_flatten(template)
+    if len(leaves) != len(t_leaves):
+        raise ValueError(
+            f"{path} has {len(leaves)} leaves, template implies "
+            f"{len(t_leaves)} — config/checkpoint mismatch"
+        )
+    for i, (saved, want) in enumerate(zip(leaves, t_leaves)):
+        if tuple(saved.shape) != tuple(np.shape(want)):
+            raise ValueError(
+                f"{path} leaf {i} has shape {tuple(saved.shape)}, template "
+                f"implies {tuple(np.shape(want))} — does --hidden-sizes "
+                "match the trained run?"
+            )
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
@@ -342,6 +357,14 @@ class Trainer:
         # Set when the RSS watchdog ends a run early (checkpointed); lets
         # callers distinguish preemption from completion (train.py exits 75)
         self.preempted = False
+        # External preemption request (SIGTERM/SIGINT path, train.py):
+        # signal handlers only set this event — thread-safe and
+        # signal-safe — and the train/warmup loops notice it at the next
+        # iteration, checkpoint (state + trainer meta + replay snapshot if
+        # enabled; metrics flush on every log already), set
+        # ``self.preempted``, and return. Same exit contract as the RSS
+        # watchdog: train.py exits 75 so a supervisor --resumes.
+        self._preempt_requested = threading.Event()
         self._replay_restored = False
         if config.resume and self.ckpt.latest_step() is not None:
             self.state = self.ckpt.restore(self.state)
@@ -425,7 +448,8 @@ class Trainer:
         # published param copies so eval crossings cost the learner zero
         # grad steps (reference evaluator process, main.py:103-134).
         self._eval_thread: Optional[threading.Thread] = None
-        self._eval_req = None  # latest pending (params, step, scalars, env_steps)
+        # latest pending (params, step, scalars, env_steps, norm_state)
+        self._eval_req = None
         self._eval_req_lock = threading.Lock()
         self._eval_pending = threading.Event()
         self._eval_idle = threading.Event()
@@ -493,6 +517,20 @@ class Trainer:
             self._cpu_params = self._to_act_device(self.state.actor_params)
             self._cpu_params_step = self.grad_steps
         return self._cpu_params
+
+    def request_preemption(self) -> None:
+        """Ask the trainer to stop at the next loop boundary with a full
+        checkpoint (signal-handler-safe: only sets an event)."""
+        self._preempt_requested.set()
+
+    def _preempt_now(self, where: str) -> None:
+        """Act on a pending preemption request: checkpoint + mark."""
+        self._save_checkpoint()
+        print(
+            f"[preempt] stop requested ({where}): checkpointed at grad step "
+            f"{self.grad_steps}; exiting for a --resume restart"
+        )
+        self.preempted = True
 
     def _effective_warmup(self) -> int:
         """Warmup env-steps still owed: zero once a replay snapshot was
@@ -1076,6 +1114,11 @@ class Trainer:
             self.env_steps < self._effective_warmup()
             or len(self.buffer) < cfg.batch_size
         ):
+            if self._preempt_requested.is_set():
+                # Nothing worth saving mid-warmup beyond what the train
+                # loop's top-of-loop check will checkpoint; just stop
+                # collecting promptly.
+                return
             if self.has_pool:  # pool mode handles HER internally
                 self._pool_collect_steps(self.config.num_envs * 8, noise_scale=3.0)
             elif cfg.her:
@@ -1237,6 +1280,15 @@ class Trainer:
         loop_exc: Optional[BaseException] = None
         try:
             while grad_steps_done < total:
+                if self._preempt_requested.is_set():
+                    # SIGTERM/SIGINT path (train.py handlers): checkpoint
+                    # BEFORE touching another dispatch, then leave through
+                    # the normal finally (collector/writeback/eval all
+                    # drain). Runs before any sampling so a preemption
+                    # during an interrupted warmup never samples a buffer
+                    # that cannot serve a batch.
+                    self._preempt_now("train loop")
+                    break
                 if (
                     cfg.profile_dir
                     and not profiled
@@ -1260,7 +1312,11 @@ class Trainer:
                         + cfg.env_steps_per_train_step * self._learner_steps
                     ) or len(self.buffer) < cfg.batch_size:
                         self._check_collector_alive()
+                        if self._preempt_requested.is_set():
+                            break
                         time.sleep(0.001)
+                    if self._preempt_requested.is_set():
+                        continue  # loop top checkpoints and exits
                 else:
                     # interleave collection to hold the env:train ratio (sync modes)
                     collect_budget += cfg.env_steps_per_train_step * K
@@ -1529,12 +1585,15 @@ class Trainer:
                     self._eval_pending.clear()
                 if req is None:
                     continue
-                params, step, scalars, env_steps = req
+                params, step, scalars, env_steps, norm_state = req
                 ev = self._host_eval(eval_params=params)
                 # params is the REAL copy scored by this eval — exactly what
-                # keep-best must persist (the live params have moved on)
+                # keep-best must persist (the live params have moved on);
+                # norm_state is the normalizer snapshot from the same
+                # enqueue instant, for the same reason.
                 self._apply_eval(
-                    step, scalars, ev, params=params, env_steps=env_steps
+                    step, scalars, ev, params=params, env_steps=env_steps,
+                    norm_state=norm_state,
                 )
                 with self._eval_req_lock:
                     if self._eval_req is None:
@@ -1544,7 +1603,9 @@ class Trainer:
             self._eval_idle.set()  # never leave the end-of-train drain hanging
             raise
 
-    def _save_best(self, step: int, score: float, params, env_steps: int) -> None:
+    def _save_best(
+        self, step: int, score: float, params, env_steps: int, norm_state=None
+    ) -> None:
         """Persist the champion actor params + score. Write-ordering: params
         first, JSON second — a crash can never leave best_eval.json claiming
         params that were never persisted (same discipline as on_device)."""
@@ -1557,6 +1618,15 @@ class Trainer:
                 f, **{f"leaf_{i:04d}": np.asarray(l) for i, l in enumerate(leaves)}
             )
         os.replace(tmp, os.path.join(ckpt_dir, "best_actor.npz"))
+        if norm_state is not None:
+            # The normalizer statistics AS OF the scored param copy, so a
+            # bundle export pairs the champion with the μ/σ it was actually
+            # evaluated under — trainer_meta.json keeps drifting with later
+            # collection, which is the wrong normalizer for these params.
+            tmp = os.path.join(ckpt_dir, "best_obs_norm.json.tmp")
+            with open(tmp, "w") as f:
+                json.dump(norm_state, f)
+            os.replace(tmp, os.path.join(ckpt_dir, "best_obs_norm.json"))
         # env_steps is the value CAPTURED when the eval was enqueued, not
         # self.env_steps — in concurrent-eval mode this runs on the
         # evaluator thread while the collector mutates the live counter, so
@@ -1566,7 +1636,8 @@ class Trainer:
         save_best_eval(self.config.log_dir, step, score, env_steps)
 
     def _apply_eval(
-        self, step: int, scalars: dict, ev: dict, params=None, env_steps=None
+        self, step: int, scalars: dict, ev: dict, params=None, env_steps=None,
+        norm_state=None,
     ) -> None:
         """EWMA + log + print for one completed eval, at the step it was
         REQUESTED (the params it scored). Runs on the evaluator thread in
@@ -1586,11 +1657,17 @@ class Trainer:
             self._best_eval is None or ev["eval_return_mean"] > self._best_eval
         ):
             self._best_eval = ev["eval_return_mean"]
+            if norm_state is None and self.obs_norm is not None:
+                # inline (learner-thread) path: stats-now == stats at the
+                # scored params; the concurrent path passed the snapshot
+                # captured when the eval was enqueued
+                norm_state = self.obs_norm.state_dict()
             self._save_best(
                 step,
                 self._best_eval,
                 params,
                 self.env_steps if env_steps is None else env_steps,
+                norm_state=norm_state,
             )
         scalars = dict(scalars)
         scalars.update(ev)
@@ -1625,15 +1702,21 @@ class Trainer:
             )
             self._eval_thread.start()
         params = self._copy_eval_params()
+        norm_state = (
+            self.obs_norm.state_dict() if self.obs_norm is not None else None
+        )
         with self._eval_req_lock:
             replaced = self._eval_req
             self._eval_idle.clear()
-            # env_steps captured HERE, on the learner thread at enqueue —
-            # the evaluator thread must not read the live counter later.
-            self._eval_req = (params, self.grad_steps, scalars, self.env_steps)
+            # env_steps (and the normalizer snapshot) captured HERE, on the
+            # learner thread at enqueue — the evaluator thread must not
+            # read the live counter/stats later.
+            self._eval_req = (
+                params, self.grad_steps, scalars, self.env_steps, norm_state
+            )
             self._eval_pending.set()
         if replaced is not None:
-            _, r_step, r_scalars, _ = replaced
+            _, r_step, r_scalars, _, _ = replaced
             self.metrics.log(r_step, r_scalars, timers=self._timers)
 
     def _drain_eval(self, timeout: float = 600.0) -> None:

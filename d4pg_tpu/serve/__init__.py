@@ -1,0 +1,43 @@
+"""Policy serving: obs → action inference as a standalone subsystem.
+
+The training side ends at a checkpoint; this package is the other half of
+the ROADMAP's "serves heavy traffic" north star — the SEED-RL-shaped
+deployment of a trained D4PG actor (PAPERS.md: Espeholt et al. 2019;
+Barth-Maron et al. 2018 §deployment):
+
+- :mod:`~d4pg_tpu.serve.bundle`   — self-contained export/load of params +
+  config + bounds + obs-norm stats (``train.py --export-bundle``);
+- :mod:`~d4pg_tpu.serve.batcher`  — dynamic micro-batching onto one device
+  thread, bucket-compiled, donated inputs, explicit load shedding;
+- :mod:`~d4pg_tpu.serve.server`   — stdlib socket front-end with deadlines,
+  checkpoint hot-reload, graceful drain, healthz;
+- :mod:`~d4pg_tpu.serve.client`   — blocking + pipelined client;
+- :mod:`~d4pg_tpu.serve.protocol` — the length-prefixed binary frames;
+- :mod:`~d4pg_tpu.serve.stats`    — p50/p95/p99, batch/queue histograms.
+
+Run it: ``python -m d4pg_tpu.serve --bundle <dir>`` (docs/serving.md).
+"""
+
+from d4pg_tpu.serve.batcher import DynamicBatcher, ShedError, default_buckets
+from d4pg_tpu.serve.bundle import PolicyBundle, export_bundle, load_bundle
+from d4pg_tpu.serve.client import (
+    ConnectionClosed,
+    Overloaded,
+    PolicyClient,
+    ServerError,
+)
+from d4pg_tpu.serve.server import PolicyServer
+
+__all__ = [
+    "ConnectionClosed",
+    "DynamicBatcher",
+    "Overloaded",
+    "PolicyBundle",
+    "PolicyClient",
+    "PolicyServer",
+    "ServerError",
+    "ShedError",
+    "default_buckets",
+    "export_bundle",
+    "load_bundle",
+]

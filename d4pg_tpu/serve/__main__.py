@@ -1,0 +1,96 @@
+"""``python -m d4pg_tpu.serve``: run a policy server from a bundle.
+
+Installs SIGTERM/SIGINT handlers that trigger the graceful drain: stop
+accepting, answer everything admitted, then exit 0 — so an orchestrator's
+preemption notice never drops admitted requests. A second signal hard-kills
+(the handler restores the default disposition after the first).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from d4pg_tpu.utils.signals import install_graceful_signals
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m d4pg_tpu.serve", description=__doc__
+    )
+    p.add_argument("--bundle", required=True,
+                   help="bundle directory from train.py --export-bundle")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=7431,
+                   help="0 = ephemeral (printed on startup)")
+    p.add_argument("--max-batch", type=int, default=64,
+                   help="batch window cap; also the largest compile bucket")
+    p.add_argument("--max-wait-us", type=int, default=2000,
+                   help="batching window: max microseconds a batch waits "
+                        "for more requests after its first")
+    p.add_argument("--queue-limit", type=int, default=256,
+                   help="bounded request queue; past it requests shed with "
+                        "an explicit 'overloaded' reply")
+    p.add_argument("--default-deadline-ms", type=float, default=0.0,
+                   help="deadline applied to requests that carry none "
+                        "(0 = unbounded)")
+    p.add_argument("--watch-run", default=None,
+                   help="training run dir to hot-reload best_actor.npz "
+                        "from when its best_eval.json changes")
+    p.add_argument("--no-watch-bundle", dest="watch_bundle",
+                   action="store_false",
+                   help="disable hot-reloading the bundle dir on re-export")
+    p.add_argument("--poll-interval", type=float, default=2.0,
+                   help="hot-reload poll seconds")
+    p.add_argument("--log-dir", default=None,
+                   help="append serve metrics rows (metrics.jsonl) here")
+    p.add_argument("--metrics-interval", type=float, default=30.0)
+    return p
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    from d4pg_tpu.serve.bundle import load_bundle
+    from d4pg_tpu.serve.server import PolicyServer
+
+    bundle = load_bundle(args.bundle)
+    server = PolicyServer(
+        bundle,
+        host=args.host,
+        port=args.port,
+        max_batch=args.max_batch,
+        max_wait_us=args.max_wait_us,
+        queue_limit=args.queue_limit,
+        default_deadline_ms=args.default_deadline_ms,
+        watch_run=args.watch_run,
+        watch_bundle=args.watch_bundle,
+        poll_interval_s=args.poll_interval,
+        log_dir=args.log_dir,
+        metrics_interval_s=args.metrics_interval,
+    )
+
+    install_graceful_signals(
+        server.request_shutdown,
+        "[serve] {sig}: draining (second signal hard-kills)",
+    )
+
+    server.start()
+    print(
+        f"[serve] listening on {server.host}:{server.port} "
+        f"obs_dim={bundle.obs_dim} action_dim={bundle.action_dim} "
+        f"buckets={list(server.batcher.buckets)} "
+        f"source={bundle.meta.get('source', '?')}",
+        flush=True,
+    )
+    server.serve_until_shutdown()
+    snap = server.healthz()
+    print(
+        f"[serve] drained: {snap['replies_ok']} served, "
+        f"{snap['shed_total']} shed, p99={snap.get('p99_ms')} ms",
+        flush=True,
+    )
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,195 @@
+"""Socket client for the policy server: blocking or pipelined.
+
+``act`` is the simple call; ``act_async`` pipelines — many requests in
+flight on one connection, matched to replies by the echoed ``req_id`` on a
+dedicated reader thread. The pipelined form is what the open-loop load
+generator (``bench.py bench_serve``) is built on: an open-loop arrival
+process must keep issuing at its offered rate regardless of reply latency,
+which a blocking call cannot do.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from concurrent.futures import Future
+from typing import Optional
+
+import numpy as np
+
+from d4pg_tpu.serve import protocol
+from d4pg_tpu.serve.protocol import ProtocolError
+
+
+class Overloaded(RuntimeError):
+    """The server shed the request (reason: queue_full | deadline |
+    draining). Retry with backoff if you must; the action was not computed."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+class ServerError(RuntimeError):
+    """Server-side failure or protocol violation reply."""
+
+
+class ConnectionClosed(RuntimeError):
+    """The connection died with requests still in flight."""
+
+
+class PolicyClient:
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        # ``timeout`` governs CONNECT and the default future wait in act();
+        # the socket itself must block indefinitely — the reader thread sits
+        # in read() between replies, and a socket timeout there would kill
+        # the reader (and with it the whole client) after `timeout` idle
+        # seconds on a perfectly healthy connection.
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # Buffered read side (same rationale as the server): one kernel
+        # read per burst of pipelined replies, not per frame piece.
+        self._rfile = self._sock.makefile("rb")
+        self.timeout = timeout
+        self._send_lock = threading.Lock()
+        self._pending: dict[int, Future] = {}
+        self._pending_lock = threading.Lock()
+        self._next_id = 0
+        self._closed = False
+        # Terminal error once the reader exits: without it, a request
+        # issued AFTER the reader died would register a future nobody can
+        # ever resolve (the send usually still succeeds into the kernel
+        # buffer of a FIN'd socket) and hang its caller for the full
+        # timeout instead of failing fast.
+        self._dead: Exception | None = None
+        self._reader = threading.Thread(
+            target=self._read_loop, name="policy-client-reader", daemon=True
+        )
+        self._reader.start()
+
+    # ------------------------------------------------------------------ plumbing
+    def _register(self) -> tuple[int, Future]:
+        fut: Future = Future()
+        with self._pending_lock:
+            self._next_id = (self._next_id + 1) & 0xFFFFFFFF
+            req_id = self._next_id
+            self._pending[req_id] = fut
+        return req_id, fut
+
+    def _read_loop(self) -> None:
+        err: Exception = ConnectionClosed("server closed the connection")
+        try:
+            while True:
+                frame = protocol.read_frame(self._rfile)
+                if frame is None:
+                    break
+                msg_type, req_id, payload = frame
+                with self._pending_lock:
+                    fut = self._pending.pop(req_id, None)
+                if fut is None:
+                    # ERROR with req_id 0 is the server's "your framing is
+                    # broken, closing" notice — surface it to every waiter.
+                    if msg_type == protocol.ERROR:
+                        err = ServerError(payload.decode("utf-8", "replace"))
+                        break
+                    continue
+                if msg_type == protocol.ACT_OK:
+                    fut.set_result(protocol.decode_action(payload))
+                elif msg_type == protocol.HEALTHZ_OK:
+                    fut.set_result(payload.decode("utf-8", "replace"))
+                elif msg_type == protocol.OVERLOADED:
+                    fut.set_exception(
+                        Overloaded(payload.decode("utf-8", "replace"))
+                    )
+                elif msg_type == protocol.ERROR:
+                    fut.set_exception(
+                        ServerError(payload.decode("utf-8", "replace"))
+                    )
+                else:
+                    fut.set_exception(
+                        ProtocolError(f"unexpected reply type {msg_type}")
+                    )
+        except (OSError, ProtocolError) as e:
+            if not self._closed:
+                err = ConnectionClosed(str(e))
+        finally:
+            # Order: mark dead FIRST, then sweep — a racing act_async
+            # either lands in the swept dict (failed here) or sees _dead
+            # after registering and fails itself.
+            self._dead = err
+            with self._pending_lock:
+                pending, self._pending = list(self._pending.values()), {}
+            for fut in pending:
+                if not fut.done():
+                    fut.set_exception(err)
+
+    def _send(self, msg_type: int, req_id: int, payload: bytes) -> None:
+        with self._send_lock:
+            protocol.write_frame(self._sock, msg_type, req_id, payload)
+
+    # ------------------------------------------------------------------ API
+    def _fail_if_dead(self, req_id: int, fut: Future) -> bool:
+        if self._dead is None:
+            return False
+        with self._pending_lock:
+            self._pending.pop(req_id, None)
+        if not fut.done():
+            fut.set_exception(self._dead)
+        return True
+
+    def act_async(
+        self, obs: np.ndarray, deadline_ms: Optional[float] = None
+    ) -> Future:
+        req_id, fut = self._register()
+        if self._fail_if_dead(req_id, fut):
+            return fut
+        deadline_us = int(deadline_ms * 1e3) if deadline_ms else 0
+        try:
+            self._send(protocol.ACT, req_id, protocol.encode_act(obs, deadline_us))
+        except OSError as e:
+            with self._pending_lock:
+                self._pending.pop(req_id, None)
+            if not fut.done():
+                fut.set_exception(ConnectionClosed(str(e)))
+        return fut
+
+    def act(
+        self,
+        obs: np.ndarray,
+        deadline_ms: Optional[float] = None,
+        timeout: Optional[float] = None,
+    ) -> np.ndarray:
+        """One action, blocking. Raises :class:`Overloaded` when shed."""
+        return self.act_async(obs, deadline_ms).result(
+            timeout if timeout is not None else self.timeout
+        )
+
+    def healthz(self, timeout: Optional[float] = None) -> dict:
+        import json
+
+        req_id, fut = self._register()
+        if not self._fail_if_dead(req_id, fut):
+            self._send(protocol.HEALTHZ, req_id, b"")
+        return json.loads(
+            fut.result(timeout if timeout is not None else self.timeout)
+        )
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+        self._reader.join(timeout=5)
+        try:
+            self._rfile.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

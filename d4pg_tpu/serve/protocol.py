@@ -1,0 +1,160 @@
+"""Length-prefixed binary wire protocol for the policy server.
+
+One frame per message, fixed little-endian header, raw float32 payloads —
+no serialization library on the hot path (a pickle/JSON round-trip per
+request would dwarf the actor forward itself at serving batch sizes).
+
+Frame layout::
+
+    magic    2s   b"D4"
+    version  u8   PROTOCOL_VERSION
+    type     u8   MsgType
+    req_id   u32  client-chosen id, echoed verbatim in the reply (enables
+                  pipelining: many requests in flight per connection)
+    length   u32  payload byte count (<= MAX_PAYLOAD)
+    payload  bytes
+
+Message types and payloads:
+
+- ``ACT``          → ``u32 deadline_us`` (0 = none, relative to arrival)
+                     followed by ``obs_dim`` float32s.
+- ``ACT_OK``       ← ``action_dim`` float32s.
+- ``OVERLOADED``   ← utf-8 reason (``queue_full`` | ``deadline`` |
+                     ``draining``). The request was SHED, not failed: the
+                     client may retry with backoff. This is the explicit
+                     load-shedding reply — under overload the server says
+                     so immediately instead of letting latency diverge.
+- ``ERROR``        ← utf-8 message. Protocol violations (bad magic/size);
+                     the server closes the connection after sending.
+- ``HEALTHZ``      → empty payload.
+- ``HEALTHZ_OK``   ← utf-8 JSON: server stats snapshot (see
+                     docs/serving.md for the schema).
+
+``read_frame`` returns ``None`` on clean EOF (peer closed between frames)
+and raises :class:`ProtocolError` on anything malformed — oversized
+declared length, bad magic, version mismatch, or EOF mid-frame.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Optional, Tuple
+
+import numpy as np
+
+MAGIC = b"D4"
+PROTOCOL_VERSION = 1
+# Generous for observation vectors (a 348-dim Humanoid obs is ~1.4 KB;
+# even a flattened 96×96×4 pixel obs is ~147 KB) while bounding what a
+# malicious/buggy client can make the server buffer per frame.
+MAX_PAYLOAD = 1 << 20
+
+HEADER = struct.Struct("<2sBBII")
+_DEADLINE = struct.Struct("<I")
+
+# message types
+ACT = 1
+ACT_OK = 2
+OVERLOADED = 3
+ERROR = 4
+HEALTHZ = 5
+HEALTHZ_OK = 6
+
+
+class ProtocolError(Exception):
+    """Malformed frame — the connection is unrecoverable past this point
+    (framing is lost), so handlers reply ERROR once and close."""
+
+
+def recv_exact(stream, n: int) -> Optional[bytes]:
+    """Read exactly ``n`` bytes; None on EOF at a frame boundary (n>0 and
+    zero bytes read); ProtocolError on EOF mid-read.
+
+    ``stream`` is either a raw socket or a buffered file over one
+    (``sock.makefile("rb")``). Both hot paths use the buffered form — one
+    kernel read typically services a whole frame (or several, pipelined)
+    instead of a recv syscall per header/payload piece, which measured as
+    a large share of per-request cost on the serving hot path."""
+    read = getattr(stream, "read", None)
+    if read is not None:  # buffered file: read(n) is already exact-or-EOF
+        buf = read(n)
+        if not buf:
+            return None
+        if len(buf) < n:
+            raise ProtocolError(f"EOF mid-frame ({len(buf)}/{n} bytes)")
+        return buf
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = stream.recv(n - len(buf))
+        if not chunk:
+            if not buf:
+                return None
+            raise ProtocolError(f"EOF mid-frame ({len(buf)}/{n} bytes)")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def read_frame(stream) -> Optional[Tuple[int, int, bytes]]:
+    """One ``(msg_type, req_id, payload)`` frame from a socket or buffered
+    file; None on clean EOF."""
+    hdr = recv_exact(stream, HEADER.size)
+    if hdr is None:
+        return None
+    magic, version, msg_type, req_id, length = HEADER.unpack(hdr)
+    if magic != MAGIC:
+        raise ProtocolError(f"bad magic {magic!r}")
+    if version != PROTOCOL_VERSION:
+        raise ProtocolError(
+            f"protocol version {version} (this server speaks {PROTOCOL_VERSION})"
+        )
+    if length > MAX_PAYLOAD:
+        raise ProtocolError(f"payload length {length} > max {MAX_PAYLOAD}")
+    payload = b""
+    if length:
+        payload = recv_exact(stream, length)
+        if payload is None:
+            raise ProtocolError("EOF before payload")
+    return msg_type, req_id, payload
+
+
+def write_frame(sock, msg_type: int, req_id: int, payload: bytes = b"") -> None:
+    if len(payload) > MAX_PAYLOAD:
+        raise ProtocolError(f"payload length {len(payload)} > max {MAX_PAYLOAD}")
+    # ONE sendall per frame: header+payload concatenated so a concurrent
+    # writer on the same socket (replies come from batcher callbacks, the
+    # healthz reply from the reader thread) can never interleave a frame —
+    # callers still hold a per-connection send lock for ordering.
+    sock.sendall(
+        HEADER.pack(MAGIC, PROTOCOL_VERSION, msg_type, req_id, len(payload))
+        + payload
+    )
+
+
+# ----------------------------------------------------------- ACT payloads
+def encode_act(obs: np.ndarray, deadline_us: int = 0) -> bytes:
+    obs = np.ascontiguousarray(obs, dtype=np.float32)
+    return _DEADLINE.pack(int(deadline_us)) + obs.tobytes()
+
+
+def decode_act(payload: bytes, obs_dim: int) -> Tuple[np.ndarray, int]:
+    """Returns ``(obs [obs_dim] f32, deadline_us)``; ProtocolError on any
+    size mismatch (the oversized/undersized-request fault path)."""
+    want = _DEADLINE.size + 4 * obs_dim
+    if len(payload) != want:
+        raise ProtocolError(
+            f"ACT payload is {len(payload)} bytes, expected {want} "
+            f"(obs_dim={obs_dim})"
+        )
+    (deadline_us,) = _DEADLINE.unpack_from(payload)
+    obs = np.frombuffer(payload, np.float32, offset=_DEADLINE.size).copy()
+    return obs, deadline_us
+
+
+def encode_action(action: np.ndarray) -> bytes:
+    return np.ascontiguousarray(action, dtype=np.float32).tobytes()
+
+
+def decode_action(payload: bytes) -> np.ndarray:
+    if len(payload) % 4:
+        raise ProtocolError(f"ACT_OK payload length {len(payload)} not float32")
+    return np.frombuffer(payload, np.float32).copy()

@@ -1,0 +1,435 @@
+"""Socket front-end: accept loop, per-connection readers, hot reload, SLOs.
+
+Pure stdlib (``socket`` + ``threading``) — serving must not drag in an RPC
+framework the container doesn't have. The threading shape mirrors the
+trainer's: one accept thread, one reader thread per connection, the
+batcher's single device thread, a reload watcher, and a metrics ticker.
+Replies are written by whichever thread completes the future (the device
+thread via ``add_done_callback``), serialized per connection by a send
+lock; the ``req_id`` echo makes pipelining safe, so a connection can have
+many requests in flight and replies may arrive out of order.
+
+Checkpoint hot-reload: a watcher polls two sources —
+
+- the serving bundle's ``bundle.json`` mtime (the re-export flow:
+  ``train.py --export-bundle`` into the live directory; the exporter's
+  params-then-json write ordering makes the mtime an attestation), and
+- a training run directory (``--watch-run``): the trainer's
+  ``best_eval.json`` mtime, whose write-ordering contract says
+  ``checkpoints/best_actor.npz`` is already on disk when it moves.
+
+Either way the swap is :meth:`DynamicBatcher.set_params` — params are a
+traced argument of the compiled-per-bucket inference function, so a reload
+costs zero recompiles and in-flight batches finish on the params they
+started with.
+
+Graceful drain (SIGTERM path, wired in ``__main__``): stop accepting,
+shed new submissions with ``draining``, answer everything queued, then
+close. A preempted replica finishes its admitted work instead of dropping
+it on the floor.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import threading
+from typing import Optional
+
+import numpy as np
+
+from d4pg_tpu.serve import protocol
+from d4pg_tpu.serve.batcher import DynamicBatcher, ShedError
+from d4pg_tpu.serve.bundle import PolicyBundle, bundle_mtime, load_bundle
+from d4pg_tpu.serve.protocol import ProtocolError
+
+
+def load_best_actor_params(run_dir: str, config):
+    """``checkpoints/best_actor.npz`` from a training run, unflattened into
+    the bundle config's actor tree (the trainer saves leaves in
+    tree_flatten order under zero-padded keys)."""
+    import jax
+
+    from d4pg_tpu.serve.bundle import actor_template
+
+    path = os.path.join(run_dir, "checkpoints", "best_actor.npz")
+    template = actor_template(config)
+    t_leaves, treedef = jax.tree_util.tree_flatten(template)
+    with np.load(path) as z:
+        leaves = [z[k] for k in sorted(z.files)]
+    if len(leaves) != len(t_leaves):
+        raise ValueError(
+            f"{path} has {len(leaves)} leaves, bundle config implies "
+            f"{len(t_leaves)}"
+        )
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+class PolicyServer:
+    def __init__(
+        self,
+        bundle: PolicyBundle,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        *,
+        max_batch: int = 64,
+        max_wait_us: int = 2000,
+        queue_limit: int = 256,
+        default_deadline_ms: float = 0.0,
+        watch_run: Optional[str] = None,
+        watch_bundle: bool = True,
+        poll_interval_s: float = 2.0,
+        log_dir: Optional[str] = None,
+        metrics_interval_s: float = 30.0,
+    ):
+        self.bundle = bundle
+        self.host = host
+        self._requested_port = port
+        self.port: Optional[int] = None
+        self.default_deadline_s = (
+            default_deadline_ms / 1e3 if default_deadline_ms else None
+        )
+        self.batcher = DynamicBatcher(
+            bundle.config,
+            bundle.actor_params,
+            max_batch=max_batch,
+            max_wait_us=max_wait_us,
+            queue_limit=queue_limit,
+            action_low=bundle.action_low,
+            action_high=bundle.action_high,
+            obs_norm_stats=bundle.obs_norm,
+        )
+        self.stats = self.batcher.stats
+        self._watch_run = watch_run
+        self._watch_bundle = watch_bundle and bundle.path is not None
+        self._poll_interval_s = poll_interval_s
+        self._bundle_mtime = (
+            bundle_mtime(bundle.path) if self._watch_bundle else None
+        )
+        self._best_mtime = self._stat_best() if watch_run else None
+        self._log_dir = log_dir
+        self._metrics_interval_s = metrics_interval_s
+        self._metrics = None
+
+        self._listen_sock: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._watch_thread: Optional[threading.Thread] = None
+        self._metrics_thread: Optional[threading.Thread] = None
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
+        self._shutdown = threading.Event()
+        self._started = False
+
+    # -------------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        if self._started:
+            raise RuntimeError("server already started")
+        self._started = True
+        self.batcher.start(warmup=True)  # every bucket compiled before accept
+        self._listen_sock = socket.create_server(
+            (self.host, self._requested_port)
+        )
+        self.port = self._listen_sock.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="serve-accept", daemon=True
+        )
+        self._accept_thread.start()
+        if self._watch_bundle or self._watch_run:
+            self._watch_thread = threading.Thread(
+                target=self._watch_loop, name="serve-reload", daemon=True
+            )
+            self._watch_thread.start()
+        if self._log_dir:
+            from d4pg_tpu.runtime.metrics import MetricsLogger
+
+            self._metrics = MetricsLogger(self._log_dir, use_tensorboard=False)
+            self._metrics_thread = threading.Thread(
+                target=self._metrics_loop, name="serve-metrics", daemon=True
+            )
+            self._metrics_thread.start()
+
+    def request_shutdown(self) -> None:
+        """Signal-handler-safe: just set the event; the draining work
+        happens on whoever waits (serve_until_shutdown / drain)."""
+        self._shutdown.set()
+
+    def serve_until_shutdown(self) -> None:
+        self._shutdown.wait()
+        self.drain()
+
+    def drain(self, timeout: float = 30.0) -> None:
+        """Graceful stop: no new connections, shed new requests, answer
+        everything already admitted, then tear down."""
+        self._shutdown.set()
+        if self._listen_sock is not None:
+            # close() alone does NOT wake a thread blocked in accept() on
+            # Linux; shutdown() does, and the self-connect below covers
+            # stacks where even that is a no-op on listening sockets.
+            try:
+                self._listen_sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                with socket.create_connection(
+                    (self.host, self.port), timeout=1
+                ):
+                    pass
+            except OSError:
+                pass
+            try:
+                self._listen_sock.close()
+            except OSError:
+                pass
+        self.batcher.stop(drain=True, timeout=timeout)
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5)
+        if self._watch_thread is not None:
+            self._watch_thread.join(timeout=self._poll_interval_s + 5)
+        if self._metrics_thread is not None:
+            self._metrics_thread.join(timeout=self._metrics_interval_s + 5)
+        if self._metrics is not None:
+            self._metrics.log(self.stats.batches_total, self.stats.metrics_row())
+            self._metrics.close()
+            self._metrics = None
+        # Reader threads block in recv; closing the sockets unblocks them.
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------- hot reload
+    def _stat_best(self) -> Optional[float]:
+        try:
+            return os.stat(
+                os.path.join(self._watch_run, "best_eval.json")
+            ).st_mtime
+        except (OSError, TypeError):
+            return None
+
+    def check_reload(self) -> bool:
+        """One reload poll (also callable directly from tests — the watch
+        thread is just this on a timer). Returns True if params swapped."""
+        swapped = False
+        if self._watch_bundle:
+            m = bundle_mtime(self.bundle.path)
+            if m is not None and m != self._bundle_mtime:
+                try:
+                    # Reload the WHOLE bundle, not just the params: a
+                    # re-export from a live --obs-norm run carries fresher
+                    # normalizer statistics, and serving new params under
+                    # stale μ/σ silently scales the net's inputs off its
+                    # trained distribution. Config/bounds changes are
+                    # REFUSED (they are baked into the compiled bucket
+                    # programs — honoring them needs a restart).
+                    fresh = load_bundle(self.bundle.path)
+                    if fresh.config != self.bundle.config:
+                        raise ValueError(
+                            "agent config changed; restart the server to "
+                            "serve it (compiled programs are config-shaped)"
+                        )
+                    if not (
+                        np.array_equal(fresh.action_low, self.bundle.action_low)
+                        and np.array_equal(
+                            fresh.action_high, self.bundle.action_high
+                        )
+                    ):
+                        raise ValueError(
+                            "action bounds changed; restart the server to "
+                            "serve them (bounds are baked into the "
+                            "compiled programs)"
+                        )
+                    self.batcher.set_params(fresh.actor_params)
+                    self.batcher.set_obs_norm(fresh.obs_norm)
+                    self.bundle = fresh
+                    swapped = True
+                    print(f"[serve] reloaded bundle {self.bundle.path}")
+                except Exception as e:
+                    # ANY load/validation failure (a malformed bundle.json
+                    # raises KeyError/TypeError, not just OSError/
+                    # ValueError) means: keep serving the old params. The
+                    # mtime bookmark still advances below, so a bad export
+                    # logs once instead of retrying every poll forever.
+                    print(f"[serve] bundle reload failed (serving old params): {e}")
+                self._bundle_mtime = m
+        if self._watch_run:
+            m = self._stat_best()
+            if m is not None and m != self._best_mtime:
+                try:
+                    # best_actor.npz carries PARAMS ONLY — a run using
+                    # --obs-norm should hot-reload via bundle re-export
+                    # (which refreshes the statistics too); docs/serving.md
+                    # states this limitation.
+                    params = load_best_actor_params(
+                        self._watch_run, self.bundle.config
+                    )
+                    self.batcher.set_params(params)
+                    swapped = True
+                    print(
+                        f"[serve] reloaded best_actor.npz from {self._watch_run}"
+                    )
+                except Exception as e:  # same contract as the bundle branch
+                    print(f"[serve] run-dir reload failed (serving old params): {e}")
+                self._best_mtime = m
+        return swapped
+
+    def _watch_loop(self) -> None:
+        while not self._shutdown.wait(self._poll_interval_s):
+            try:
+                self.check_reload()
+            except Exception as e:  # watcher must never die silently mid-run
+                print(f"[serve] reload watcher error: {e}")
+
+    # ---------------------------------------------------------------- metrics
+    def _metrics_loop(self) -> None:
+        while not self._shutdown.wait(self._metrics_interval_s):
+            self._metrics.log(
+                self.stats.batches_total,
+                self.stats.metrics_row(),
+                timers=self.batcher.timers,
+            )
+
+    # ------------------------------------------------------------ connections
+    def _accept_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                conn, _addr = self._listen_sock.accept()
+            except OSError:
+                return  # listen socket closed: draining
+            if self._shutdown.is_set():
+                try:
+                    conn.close()  # the drain's own wake-up connection
+                except OSError:
+                    pass
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            try:
+                # Bounded SEND only (recv must block forever — idle
+                # connections are legal): replies for ALL connections
+                # funnel through the batcher's single reply thread, and a
+                # client that stops reading (zero TCP window) would
+                # otherwise head-of-line block every other client's
+                # replies — and wedge the drain — behind one sendall.
+                # On timeout the reply path closes this connection.
+                conn.setsockopt(
+                    socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                    struct.pack("ll", 10, 0),
+                )
+            except OSError:
+                pass  # stack without SO_SNDTIMEO: keep the old behavior
+            with self._conns_lock:
+                self._conns.add(conn)
+            threading.Thread(
+                target=self._serve_conn, args=(conn,),
+                name="serve-conn", daemon=True,
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        send_lock = threading.Lock()
+        # Buffered read side: one kernel read drains whatever frames are
+        # pipelined instead of 2+ recv syscalls per frame (a measured large
+        # slice of per-request cost at saturation). Writes stay on the raw
+        # socket (one sendall per frame).
+        rfile = conn.makefile("rb")
+
+        def reply(msg_type: int, req_id: int, payload: bytes = b"") -> None:
+            try:
+                with send_lock:
+                    protocol.write_frame(conn, msg_type, req_id, payload)
+            except OSError:
+                # Client gone before its reply (the disconnect-mid-request
+                # fault path) or wedged past the send timeout: the batch
+                # already computed its action; count it and CLOSE this
+                # connection — a timed-out sendall may have written a
+                # partial frame, so its framing is unrecoverable, and
+                # closing also unblocks this connection's reader thread.
+                self.stats.inc("dropped_replies")
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+        try:
+            while True:
+                frame = protocol.read_frame(rfile)
+                if frame is None:
+                    return  # clean EOF
+                msg_type, req_id, payload = frame
+                if msg_type == protocol.HEALTHZ:
+                    reply(
+                        protocol.HEALTHZ_OK,
+                        req_id,
+                        json.dumps(self.healthz()).encode(),
+                    )
+                    continue
+                if msg_type != protocol.ACT:
+                    raise ProtocolError(f"unexpected message type {msg_type}")
+                obs, deadline_us = protocol.decode_act(
+                    payload, self.bundle.obs_dim
+                )
+                deadline_s = (
+                    deadline_us / 1e6 if deadline_us else self.default_deadline_s
+                )
+                try:
+                    fut = self.batcher.submit(obs, deadline_s)
+                except ShedError as e:
+                    reply(protocol.OVERLOADED, req_id, e.reason.encode())
+                    continue
+
+                def deliver(f, req_id=req_id):
+                    exc = f.exception()
+                    if exc is None:
+                        reply(
+                            protocol.ACT_OK,
+                            req_id,
+                            protocol.encode_action(f.result()),
+                        )
+                    elif isinstance(exc, ShedError):
+                        reply(protocol.OVERLOADED, req_id, exc.reason.encode())
+                    else:
+                        reply(protocol.ERROR, req_id, str(exc).encode())
+
+                fut.add_done_callback(deliver)
+        except ProtocolError as e:
+            self.stats.inc("protocol_errors")
+            try:
+                with send_lock:
+                    protocol.write_frame(conn, protocol.ERROR, 0, str(e).encode())
+            except OSError:
+                pass
+        except OSError:
+            pass  # peer reset / socket closed by drain
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+            try:
+                rfile.close()
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # ----------------------------------------------------------------- status
+    def healthz(self) -> dict:
+        snap = self.stats.snapshot()
+        snap["status"] = "draining" if self._shutdown.is_set() else "ok"
+        snap["queue_depth"] = self.batcher.queue_depth
+        snap["compile_count"] = self.batcher.compile_count
+        snap["buckets"] = list(self.batcher.buckets)
+        snap["obs_dim"] = self.bundle.obs_dim
+        snap["action_dim"] = self.bundle.action_dim
+        snap["stage_ms"] = {
+            k: round(v, 4)
+            for k, v in self.batcher.timers.summary_ms().items()
+        }
+        return snap

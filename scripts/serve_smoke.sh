@@ -1,0 +1,65 @@
+#!/usr/bin/env bash
+# Serve smoke: the full train → export-bundle → serve → round-trip → drain
+# path on CPU, end to end through the real CLIs. Wired into tier-1 via
+# tests/test_serve_smoke.py; also runnable by hand:
+#
+#   scripts/serve_smoke.sh            # throwaway run dir
+#   SERVE_SMOKE_DIR=/tmp/x scripts/serve_smoke.sh
+#
+# Knobs (env vars): SERVE_SMOKE_DIR (run dir, default mktemp),
+# SERVE_SMOKE_STEPS (grad steps, default 2), SERVE_SMOKE_HIDDEN
+# (MLP widths, default 16,16 — tiny so the CPU compile stays seconds).
+#
+# Asserts: a checkpointed short training run exports a bundle; the server
+# answers one observation with an action inside the env's bounds; SIGTERM
+# drains cleanly (exit 0 with the drained summary line).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+RUN=${SERVE_SMOKE_DIR:-$(mktemp -d /tmp/serve_smoke.XXXXXX)}
+STEPS=${SERVE_SMOKE_STEPS:-2}
+HIDDEN=${SERVE_SMOKE_HIDDEN:-16,16}
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+
+echo "[serve-smoke] run dir: $RUN"
+python train.py --env Pendulum-v1 --hidden-sizes "$HIDDEN" \
+  --total-steps "$STEPS" --warmup 16 --bsize 8 --rmsize 512 \
+  --eval-interval "$STEPS" --eval-episodes 2 \
+  --checkpoint-interval "$STEPS" --num-envs 1 \
+  --log-dir "$RUN"
+
+python train.py --env Pendulum-v1 --hidden-sizes "$HIDDEN" \
+  --log-dir "$RUN" --export-bundle "$RUN/bundle"
+
+python - "$RUN/bundle" <<'EOF'
+import os, signal, subprocess, sys, numpy as np
+bundle = sys.argv[1]
+proc = subprocess.Popen(
+    [sys.executable, "-m", "d4pg_tpu.serve", "--bundle", bundle,
+     "--port", "0", "--max-batch", "8", "--max-wait-us", "500"],
+    stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+)
+port = None
+for line in proc.stdout:
+    sys.stdout.write("[server] " + line)
+    if "listening on" in line:
+        port = int(line.split(":")[1].split()[0])
+        break
+assert port, "server never reported its port"
+from d4pg_tpu.serve.client import PolicyClient
+with PolicyClient("127.0.0.1", port) as c:
+    a = c.act(np.array([0.1, -0.2, 0.05], np.float32))
+    # Pendulum-v1 torque bounds (the bundle carries them): env-scale output
+    assert a.shape == (1,) and abs(float(a[0])) <= 2.0, a
+    h = c.healthz()
+    assert h["status"] == "ok" and h["replies_ok"] >= 1, h
+proc.send_signal(signal.SIGTERM)
+tail = proc.stdout.read()
+sys.stdout.write("[server] " + tail)
+rc = proc.wait(timeout=120)
+assert rc == 0, f"server exit code {rc}"
+assert "drained" in tail, tail
+print("SERVE_SMOKE_ROUNDTRIP_OK")
+EOF
+
+echo "SERVE_SMOKE_OK"

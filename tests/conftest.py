@@ -28,3 +28,50 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platform_name", "cpu")
+
+import functools  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@functools.lru_cache(maxsize=1)
+def has_working_egl() -> bool:
+    """True iff an EGL context can be created and a frame rendered, probed
+    in a fresh interpreter with ``MUJOCO_GL=egl`` forced (cached per
+    session). Subprocess on purpose: merely importing ``OpenGL.EGL`` can
+    succeed on a box whose driver then fails at context creation, and a
+    failed probe must not poison this process's GL/dm_control import
+    state. Lazy on purpose: the hook below only calls this when an
+    ``egl``-marked test is actually about to RUN, so a tier-1 pass that
+    deselects them (they are all ``slow``) never pays the probe."""
+    import os
+    import subprocess
+    import sys
+
+    probe = (
+        "import os; os.environ['MUJOCO_GL'] = 'egl'; "
+        "from dm_control import suite; "
+        "e = suite.load('cartpole', 'swingup'); e.reset(); "
+        "e.physics.render(16, 16); print('EGL_OK')"
+    )
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
+        and "AXON" not in k
+        and "TPU" not in k
+    }
+    env["MUJOCO_GL"] = "egl"
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", probe],
+            capture_output=True, text=True, timeout=180, env=env,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    return "EGL_OK" in p.stdout
+
+
+def pytest_runtest_setup(item):
+    if item.get_closest_marker("egl") is not None and not has_working_egl():
+        pytest.skip("no working EGL/GL stack on this image (capability probe)")

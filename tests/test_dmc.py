@@ -1,11 +1,18 @@
-"""dm_control adapter: state + pixel modes behind the host-env interface."""
+"""dm_control adapter: state + pixel modes behind the host-env interface.
+
+EGL capability gating: state-mode tests run everywhere (the adapter falls
+back to ``MUJOCO_GL=disabled`` on images without libEGL — physics needs no
+GL), while rendering tests carry ``@pytest.mark.egl`` — skipped by the
+conftest hook when :func:`tests.conftest.has_working_egl`'s cached
+subprocess probe (create an EGL context, render a frame) fails. On images
+with working EGL the tests run exactly as before — the gate skips, it
+never weakens.
+"""
 
 import numpy as np
 import pytest
 
 pytest.importorskip("dm_control")
-
-
 
 
 def _clean_cpu_env():
@@ -96,7 +103,38 @@ def test_action_repeat_rejected_for_non_dmc():
         make_host_env("Pendulum-v1", action_repeat=2)
 
 
+def test_pixel_mode_without_gl_raises_clearly():
+    """With GL unavailable (MUJOCO_GL=disabled — what the adapter's probe
+    picks on an image without libEGL) pixel mode must fail with an
+    actionable message at construction, not an AttributeError deep inside
+    PyOpenGL; state mode in the same process keeps working."""
+    import subprocess
+    import sys
+    import textwrap
+
+    script = textwrap.dedent(
+        """
+        import os
+        os.environ["MUJOCO_GL"] = "disabled"
+        from d4pg_tpu.envs.dmc_adapter import make_dmc
+        env = make_dmc("dmc:cartpole:swingup")
+        env.reset(seed=0)  # state-mode physics needs no GL
+        try:
+            make_dmc("dmc_pixels:cartpole:swingup")
+        except RuntimeError as e:
+            assert "GL backend" in str(e), e
+            print("NO_GL_CLEAR_ERROR_OK")
+        """
+    )
+    p = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        timeout=300, env=_clean_cpu_env(),
+    )
+    assert "NO_GL_CLEAR_ERROR_OK" in p.stdout, p.stdout + p.stderr
+
+
 @pytest.mark.slow
+@pytest.mark.egl
 def test_pixel_mode_convention():
     """Pixels follow the repo convention: flattened [H, W, 2] floats in
     [0,1], two-frame grayscale stack, pixel_shape advertised for the conv
@@ -137,6 +175,7 @@ def test_pixel_mode_convention():
 
 
 @pytest.mark.slow
+@pytest.mark.egl
 def test_pixel_mode_trains_with_conv_encoder(tmp_path):
     """Trainer end-to-end on dm_control pixels: _reconcile_config adopts
     pixel_shape from the live env, replay stores uint8, conv encoder runs.
@@ -196,6 +235,7 @@ def test_pixel_mode_trains_with_conv_encoder(tmp_path):
 
 
 @pytest.mark.slow  # compile-heavy (conftest fast-tier budget)
+@pytest.mark.egl   # a GL-less image can't even construct the pixel env
 def test_pixel_env_refuses_pooled_collection(tmp_path):
     """Concurrent cross-process EGL rendering deadlocks on this image's GL
     stack (module docstring) — the trainer must refuse pooled/async

@@ -1,0 +1,363 @@
+"""Serving subsystem units: protocol frames, bundles, the dynamic batcher.
+
+Socket-level end-to-end (including the fault paths the server must
+survive) lives in test_serve_server.py; this file covers the pieces in
+isolation so a failure points at a layer.
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from d4pg_tpu.agent import act_deterministic
+from d4pg_tpu.agent.state import D4PGConfig
+from d4pg_tpu.serve import protocol
+from d4pg_tpu.serve.batcher import DynamicBatcher, ShedError, default_buckets
+from d4pg_tpu.serve.bundle import (
+    actor_template,
+    config_from_json,
+    config_to_json,
+    export_bundle,
+    load_bundle,
+)
+from d4pg_tpu.serve.protocol import ProtocolError
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = D4PGConfig(obs_dim=4, action_dim=2, hidden_sizes=(8, 8))
+    return cfg, actor_template(cfg)
+
+
+# ---------------------------------------------------------------- protocol
+def _sock_pair():
+    a, b = socket.socketpair()
+    return a, b
+
+
+def test_protocol_roundtrip():
+    a, b = _sock_pair()
+    obs = np.arange(5, dtype=np.float32)
+    protocol.write_frame(a, protocol.ACT, 7, protocol.encode_act(obs, 1234))
+    msg_type, req_id, payload = protocol.read_frame(b)
+    assert (msg_type, req_id) == (protocol.ACT, 7)
+    got, deadline = protocol.decode_act(payload, 5)
+    np.testing.assert_array_equal(got, obs)
+    assert deadline == 1234
+    act = np.array([0.5, -0.5], np.float32)
+    protocol.write_frame(b, protocol.ACT_OK, 7, protocol.encode_action(act))
+    _, _, pl = protocol.read_frame(a)
+    np.testing.assert_array_equal(protocol.decode_action(pl), act)
+    a.close(), b.close()
+
+
+def test_protocol_clean_eof_and_mid_frame_eof():
+    a, b = _sock_pair()
+    a.close()
+    assert protocol.read_frame(b) is None  # clean EOF between frames
+    b.close()
+    a, b = _sock_pair()
+    hdr = protocol.HEADER.pack(protocol.MAGIC, protocol.PROTOCOL_VERSION,
+                               protocol.ACT, 1, 64)
+    a.sendall(hdr + b"short")
+    a.close()
+    with pytest.raises(ProtocolError, match="EOF"):
+        protocol.read_frame(b)
+    b.close()
+
+
+def test_protocol_rejects_bad_magic_version_and_oversize():
+    a, b = _sock_pair()
+    a.sendall(b"XX" + bytes(protocol.HEADER.size - 2))
+    with pytest.raises(ProtocolError, match="magic"):
+        protocol.read_frame(b)
+    a2, b2 = _sock_pair()
+    a2.sendall(protocol.HEADER.pack(protocol.MAGIC, 99, protocol.ACT, 1, 0))
+    with pytest.raises(ProtocolError, match="version"):
+        protocol.read_frame(b2)
+    a3, b3 = _sock_pair()
+    a3.sendall(
+        protocol.HEADER.pack(
+            protocol.MAGIC, protocol.PROTOCOL_VERSION, protocol.ACT, 1,
+            protocol.MAX_PAYLOAD + 1,
+        )
+    )
+    with pytest.raises(ProtocolError, match="max"):
+        protocol.read_frame(b3)
+    with pytest.raises(ProtocolError):
+        protocol.write_frame(a3, protocol.ACT, 1, b"x" * (protocol.MAX_PAYLOAD + 1))
+    for s in (a, b, a2, b2, a3, b3):
+        s.close()
+
+
+def test_decode_act_size_mismatch():
+    with pytest.raises(ProtocolError, match="expected"):
+        protocol.decode_act(b"\x00" * 11, obs_dim=4)
+
+
+# ------------------------------------------------------------------ bundle
+def test_config_json_roundtrip_preserves_tuples():
+    cfg = D4PGConfig(
+        obs_dim=7, action_dim=3, hidden_sizes=(32, 16), pixel_shape=(8, 8, 2)
+    )
+    back = config_from_json(config_to_json(cfg))
+    assert back == cfg
+    assert isinstance(back.hidden_sizes, tuple)
+    assert isinstance(back.pixel_shape, tuple)
+
+
+def test_config_json_unknown_field_is_hard_error():
+    d = config_to_json(D4PGConfig())
+    d["from_the_future"] = 1
+    with pytest.raises(ValueError, match="from_the_future"):
+        config_from_json(d)
+
+
+def test_bundle_roundtrip_and_validation(tmp_path, tiny):
+    cfg, params = tiny
+    d = str(tmp_path / "b")
+    export_bundle(
+        d, cfg, params,
+        action_low=[-2.0, -1.0], action_high=[2.0, 1.0],
+        obs_norm_state={"count": 4.0, "mean": [0.0] * 4, "m2": [1.0] * 4},
+        meta={"source": "test"},
+    )
+    b = load_bundle(d)
+    assert b.config == cfg and b.meta["source"] == "test"
+    np.testing.assert_array_equal(b.action_low, [-2.0, -1.0])
+    for a, bb in zip(
+        __import__("jax").tree_util.tree_leaves(params),
+        __import__("jax").tree_util.tree_leaves(b.actor_params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(bb))
+    # config/params mismatch must fail loudly, not serve garbage
+    wide = D4PGConfig(obs_dim=4, action_dim=2, hidden_sizes=(16, 16))
+    export_bundle(str(tmp_path / "c"), cfg, params)
+    import json
+    import os
+
+    meta_path = os.path.join(str(tmp_path / "c"), "bundle.json")
+    with open(meta_path) as f:
+        doc = json.load(f)
+    doc["agent"] = config_to_json(wide)
+    with open(meta_path, "w") as f:
+        json.dump(doc, f)
+    with pytest.raises(ValueError, match="shape"):
+        load_bundle(str(tmp_path / "c"))
+
+
+def test_bundle_rejects_mismatched_obs_norm(tmp_path, tiny):
+    cfg, params = tiny
+    with pytest.raises(ValueError):
+        export_bundle(
+            str(tmp_path / "bad"), cfg, params,
+            action_low=[1.0, 1.0], action_high=[-1.0, -1.0],
+        )
+    d = str(tmp_path / "b2")
+    export_bundle(
+        d, cfg, params, obs_norm_state={"count": 1.0, "mean": [0.0], "m2": [1.0]}
+    )
+    with pytest.raises(ValueError, match="obs_norm"):
+        load_bundle(d)
+
+
+def test_export_prefers_best_obs_norm_snapshot(tmp_path):
+    """--export-bundle pairs best_actor.npz with the normalizer snapshot
+    captured when the champion was scored (best_obs_norm.json), NOT the
+    continually-drifting trainer_meta.json statistics."""
+    import json
+
+    import jax
+
+    from train import build_parser, config_from_args, export_bundle_from_run
+
+    run = tmp_path / "run"
+    ckpt = run / "checkpoints"
+    ckpt.mkdir(parents=True)
+    cfg = config_from_args(
+        build_parser().parse_args(
+            [
+                "--env", "Pendulum-v1", "--obs-norm",
+                "--hidden-sizes", "8,8", "--log-dir", str(run),
+            ]
+        )
+    )
+    params = actor_template(
+        __import__("dataclasses").replace(
+            cfg.agent, obs_dim=3, action_dim=1
+        )
+    )
+    leaves = jax.tree_util.tree_leaves(params)
+    with open(ckpt / "best_actor.npz", "wb") as f:
+        np.savez(
+            f, **{f"leaf_{i:04d}": np.asarray(l) for i, l in enumerate(leaves)}
+        )
+    drifted = {"count": 99.0, "mean": [9.0] * 3, "m2": [9.0] * 3}
+    at_best = {"count": 5.0, "mean": [1.0] * 3, "m2": [5.0] * 3}
+    with open(ckpt / "trainer_meta.json", "w") as f:
+        json.dump({"env_steps": 123, "ewma_return": 0.0, "obs_norm": drifted}, f)
+    with open(ckpt / "best_obs_norm.json", "w") as f:
+        json.dump(at_best, f)
+    out = export_bundle_from_run(cfg, str(tmp_path / "bundle"))
+    b = load_bundle(out)
+    assert b.obs_norm == at_best  # the paired snapshot, not the drifted meta
+    assert b.meta["source"] == "best_actor.npz"
+
+
+# ----------------------------------------------------------------- batcher
+def test_default_buckets_end_at_max_batch():
+    assert default_buckets(8) == (1, 2, 4, 8)
+    assert default_buckets(12) == (1, 2, 4, 8, 12)
+    assert default_buckets(1) == (1,)
+
+
+def test_batcher_matches_direct_forward_with_norm_and_bounds(tiny):
+    cfg, params = tiny
+    stats = {"count": 9.0, "mean": [0.5] * 4, "m2": [9.0] * 4}
+    b = DynamicBatcher(
+        cfg, params, max_batch=4, max_wait_us=200, queue_limit=16,
+        action_low=[-3.0, 0.0], action_high=[3.0, 2.0], obs_norm_stats=stats,
+    )
+    b.start()
+    try:
+        rng = np.random.default_rng(0)
+        obs = rng.normal(size=(6, 4)).astype(np.float32)
+        futs = [b.submit(o) for o in obs]
+        got = np.stack([f.result(30) for f in futs])
+        mean = np.full(4, 0.5, np.float32)
+        std = np.maximum(np.sqrt(np.full(4, 1.0)), 1e-2).astype(np.float32)
+        normed = np.clip((obs - mean) / std, -5, 5)
+        ref = np.clip(np.asarray(act_deterministic(cfg, params, normed)), -1, 1)
+        low = np.array([-3.0, 0.0], np.float32)
+        high = np.array([3.0, 2.0], np.float32)
+        ref = low + (ref + 1.0) * 0.5 * (high - low)
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+        assert np.all(got >= low - 1e-6) and np.all(got <= high + 1e-6)
+    finally:
+        b.stop()
+
+
+def _slow_batcher(cfg, params, delay_s: float, **kw):
+    """Batcher whose device call sleeps — the slow-device stub that makes
+    queue buildup deterministic."""
+    b = DynamicBatcher(cfg, params, **kw)
+    real = b._infer
+
+    def slow(p, o):
+        time.sleep(delay_s)
+        return real(p, o)
+
+    b._infer = slow
+    return b
+
+
+def test_batcher_queue_full_sheds_synchronously(tiny):
+    cfg, params = tiny
+    b = _slow_batcher(
+        cfg, params, 0.2, max_batch=2, max_wait_us=50_000, queue_limit=2
+    )
+    b.start()
+    try:
+        obs = np.zeros(4, np.float32)
+        futs = [b.submit(obs) for _ in range(2)]  # consumed into a batch
+        time.sleep(0.05)  # device thread now sleeping inside the stub
+        futs += [b.submit(obs), b.submit(obs)]  # fills the queue
+        with pytest.raises(ShedError, match="queue_full"):
+            b.submit(obs)
+        assert b.stats.shed_queue_full == 1
+        for f in futs:
+            assert f.result(30).shape == (2,)  # admitted work still answered
+    finally:
+        b.stop()
+
+
+def test_batcher_deadline_expired_requests_are_dropped(tiny):
+    cfg, params = tiny
+    b = _slow_batcher(
+        cfg, params, 0.25, max_batch=2, max_wait_us=0, queue_limit=16
+    )
+    b.start()
+    try:
+        obs = np.zeros(4, np.float32)
+        first = [b.submit(obs) for _ in range(2)]  # occupy the device
+        time.sleep(0.05)
+        doomed = b.submit(obs, deadline_s=0.05)  # expires while queued
+        ok = b.submit(obs, deadline_s=30.0)
+        with pytest.raises(ShedError, match="deadline"):
+            doomed.result(30)
+        assert ok.result(30).shape == (2,)
+        assert b.stats.shed_deadline == 1
+        for f in first:
+            f.result(30)
+    finally:
+        b.stop()
+
+
+def test_batcher_drain_answers_queued_then_sheds_new(tiny):
+    cfg, params = tiny
+    b = _slow_batcher(
+        cfg, params, 0.1, max_batch=2, max_wait_us=0, queue_limit=32
+    )
+    b.start()
+    obs = np.zeros(4, np.float32)
+    futs = [b.submit(obs) for _ in range(6)]
+    stopper = threading.Thread(target=b.stop, kwargs={"drain": True})
+    stopper.start()
+    time.sleep(0.02)
+    with pytest.raises(ShedError, match="draining|queue_full"):
+        for _ in range(40):  # racing the drain flag; one of them must shed
+            b.submit(obs)
+    stopper.join(timeout=30)
+    assert not stopper.is_alive()
+    for f in futs:
+        assert f.result(5).shape == (2,)  # everything admitted was answered
+
+
+def test_batcher_hot_swap_no_recompile_and_validates(tiny):
+    cfg, params = tiny
+    import jax
+
+    b = DynamicBatcher(cfg, params, max_batch=4, max_wait_us=100, queue_limit=16)
+    b.start()
+    try:
+        obs = np.ones(4, np.float32)
+        a_old = b.submit(obs).result(30)
+        compiles = b.compile_count
+        assert compiles == len(b.buckets)  # warmup compiled each bucket once
+        bumped = jax.tree_util.tree_map(lambda x: x + 0.25, params)
+        b.set_params(bumped)
+        a_new = b.submit(obs).result(30)
+        assert b.compile_count == compiles  # the whole point of hot reload
+        assert not np.allclose(a_old, a_new)  # new params actually serve
+        with pytest.raises(ValueError, match="shape"):
+            b.set_params(
+                actor_template(
+                    D4PGConfig(obs_dim=4, action_dim=2, hidden_sizes=(16, 16))
+                )
+            )
+    finally:
+        b.stop()
+
+
+def test_batcher_pads_to_buckets_and_counts(tiny):
+    cfg, params = tiny
+    b = _slow_batcher(
+        cfg, params, 0.05, max_batch=8, max_wait_us=50_000, queue_limit=64
+    )
+    b.start()
+    try:
+        obs = np.zeros(4, np.float32)
+        # 3 requests land within one window → bucket 4, one padded row
+        futs = [b.submit(obs) for _ in range(3)]
+        for f in futs:
+            f.result(30)
+        hist = b.stats.batch_hist.snapshot()
+        # the 3 requests share one 50 ms window → one bucket-4 batch with
+        # exactly one padded row
+        assert hist["le_4"] >= 1
+        assert b.stats.padded_rows_total >= 1
+    finally:
+        b.stop()

@@ -1,0 +1,73 @@
+"""Tier-1-safe serving microbench smoke.
+
+Keeps the PR-3 serving perf surface (closed-loop batching ratio, open-loop
+shed/latency per load level) exercised every test pass, and pins the
+committed artifact's schema + its ≥5× batched-over-single acceptance
+headline — the committed numbers live at
+``benchmarks/serve_microbench.json`` (regenerate with
+``JAX_PLATFORMS=cpu python benchmarks/serve_microbench.py``)."""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "benchmarks"))
+
+from serve_microbench import run_microbench  # noqa: E402
+
+
+def test_microbench_runs_and_records(tmp_path):
+    out_path = str(tmp_path / "serve_microbench.json")
+    out = run_microbench(
+        out_path,
+        hidden=8,
+        max_batch=8,
+        duration_s=0.4,
+        closed_wide=(2, 8),
+        overload_rates=(50, 400),
+        repeats=1,
+    )
+    with open(out_path) as f:
+        on_disk = json.load(f)
+    assert on_disk["metric"] == "serve_microbench"
+    thr = out["throughput"]
+    assert thr["single_rps"] > 0 and np.isfinite(thr["single_rps"])
+    assert thr["saturated_rps"] >= thr["single_rps"] * 0.5  # sanity, not SLO
+    assert thr["closed_loop"][0]["population"] == 1
+    for level in thr["open_loop"]:
+        assert level["shed_rate"] is not None
+        assert level["achieved_rps"] >= 0
+    # low-latency scenario: no window, single profile only
+    assert out["low_latency"]["config"]["max_wait_us"] == 0
+    assert out["low_latency"]["closed_loop"][0]["p50_ms"] > 0
+    # overload scenario carries the stub label and per-level shed rates
+    assert out["overload"]["config"]["infer_delay_ms"] > 0
+    assert [lv["offered_rps"] for lv in out["overload"]["open_loop"]] == [50, 400]
+    # compile-once-per-bucket: buckets for max_batch=8 are (1,2,4,8)
+    assert thr["server"]["compile_count"] == 4
+
+
+def test_committed_artifact_meets_acceptance():
+    """The committed artifact must stay parseable, carry the per-level SLO
+    surface, and show the ≥5× dynamic-batching headline plus engaged
+    shedding at the top overload level."""
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "benchmarks", "serve_microbench.json"
+    )
+    with open(path) as f:
+        art = json.load(f)
+    assert art["metric"] == "serve_microbench"
+    assert art["batched_over_single"] >= 5.0
+    thr = art["throughput"]
+    assert thr["single_rps"] > 0 and thr["saturated_rps"] > 0
+    assert thr["server"]["compile_count"] >= 1
+    for level in thr["open_loop"] + art["overload"]["open_loop"]:
+        for k in ("offered_rps", "achieved_rps", "shed_rate", "p99_ms"):
+            assert k in level
+    # sub-saturation overload levels shed ~nothing; the top level sheds
+    overload = art["overload"]["open_loop"]
+    assert overload[0]["shed_rate"] <= 0.05
+    assert overload[-1]["shed_rate"] > 0.1
+    assert art["overload"]["config"]["infer_delay_ms"] > 0  # labeled stub

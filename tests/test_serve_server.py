@@ -1,0 +1,384 @@
+"""Policy server end-to-end over real sockets: happy path, every fault
+path a public endpoint must survive, hot reload under live traffic, and
+the graceful drain contract."""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from d4pg_tpu.agent import act_deterministic
+from d4pg_tpu.agent.state import D4PGConfig
+from d4pg_tpu.serve import (
+    Overloaded,
+    PolicyBundle,
+    PolicyClient,
+    PolicyServer,
+    ShedError,
+    export_bundle,
+)
+from d4pg_tpu.serve import protocol
+from d4pg_tpu.serve.bundle import actor_template, load_bundle
+
+
+CFG = D4PGConfig(obs_dim=4, action_dim=2, hidden_sizes=(8, 8))
+
+
+def _bundle(params=None, path=None):
+    return PolicyBundle(
+        config=CFG,
+        actor_params=params if params is not None else actor_template(CFG),
+        action_low=np.full(2, -1.0, np.float32),
+        action_high=np.full(2, 1.0, np.float32),
+        obs_norm=None,
+        meta={"source": "test"},
+        path=path,
+    )
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = PolicyServer(
+        _bundle(), port=0, max_batch=4, max_wait_us=500, queue_limit=16,
+        watch_bundle=False,
+    )
+    srv.start()
+    yield srv
+    srv.drain()
+
+
+def test_roundtrip_matches_direct_forward(server):
+    rng = np.random.default_rng(3)
+    obs = rng.normal(size=4).astype(np.float32)
+    with PolicyClient("127.0.0.1", server.port) as c:
+        a = c.act(obs)
+    ref = np.clip(
+        np.asarray(
+            act_deterministic(CFG, server.bundle.actor_params, obs[None])[0]
+        ),
+        -1.0,
+        1.0,
+    )
+    np.testing.assert_allclose(a, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_pipelined_requests_one_connection(server):
+    rng = np.random.default_rng(4)
+    obs = rng.normal(size=(16, 4)).astype(np.float32)
+    with PolicyClient("127.0.0.1", server.port) as c:
+        futs = [c.act_async(o) for o in obs]
+        got = np.stack([f.result(30) for f in futs])
+    ref = np.clip(
+        np.asarray(act_deterministic(CFG, server.bundle.actor_params, obs)),
+        -1.0,
+        1.0,
+    )
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_healthz_schema(server):
+    with PolicyClient("127.0.0.1", server.port) as c:
+        c.act(np.zeros(4, np.float32))
+        h = c.healthz()
+    assert h["status"] == "ok"
+    assert h["obs_dim"] == 4 and h["action_dim"] == 2
+    assert h["replies_ok"] >= 1 and h["requests_total"] >= 1
+    assert h["compile_count"] == len(h["buckets"])
+    assert "p50_ms" in h and "batch_size_hist" in h and "queue_depth_hist" in h
+    assert "shed_total" in h and "params_version" in h
+
+
+def test_malformed_frame_gets_error_reply_and_close(server):
+    before = server.stats.protocol_errors
+    s = socket.create_connection(("127.0.0.1", server.port), timeout=10)
+    s.sendall(b"GARBAGE-NOT-A-FRAME" + bytes(16))
+    msg_type, req_id, payload = protocol.read_frame(s)
+    assert msg_type == protocol.ERROR
+    assert b"magic" in payload
+    assert s.recv(1) == b""  # server closed the connection
+    s.close()
+    assert server.stats.protocol_errors == before + 1
+    # the server is still healthy for the next client
+    with PolicyClient("127.0.0.1", server.port) as c:
+        assert c.act(np.zeros(4, np.float32)).shape == (2,)
+
+
+def test_wrong_obs_size_gets_error_reply(server):
+    s = socket.create_connection(("127.0.0.1", server.port), timeout=10)
+    protocol.write_frame(
+        s, protocol.ACT, 5, protocol.encode_act(np.zeros(9, np.float32))
+    )
+    msg_type, _, payload = protocol.read_frame(s)
+    assert msg_type == protocol.ERROR
+    assert b"obs_dim" in payload
+    s.close()
+
+
+def test_oversized_request_is_refused(server):
+    s = socket.create_connection(("127.0.0.1", server.port), timeout=10)
+    # a DECLARED length past the cap must be rejected from the header alone
+    # (the server must not try to buffer it)
+    s.sendall(
+        protocol.HEADER.pack(
+            protocol.MAGIC, protocol.PROTOCOL_VERSION, protocol.ACT, 1,
+            protocol.MAX_PAYLOAD + 1,
+        )
+    )
+    msg_type, _, payload = protocol.read_frame(s)
+    assert msg_type == protocol.ERROR
+    assert b"max" in payload
+    s.close()
+
+
+def test_client_disconnect_mid_request_does_not_poison_server(server):
+    dropped_before = server.stats.dropped_replies
+    s = socket.create_connection(("127.0.0.1", server.port), timeout=10)
+    protocol.write_frame(
+        s, protocol.ACT, 9, protocol.encode_act(np.zeros(4, np.float32))
+    )
+    s.close()  # gone before the reply
+    deadline = time.time() + 10
+    while server.stats.dropped_replies == dropped_before and time.time() < deadline:
+        time.sleep(0.01)
+    # the reply write may race the close and still succeed; either way the
+    # server must keep serving other clients
+    with PolicyClient("127.0.0.1", server.port) as c:
+        assert c.act(np.zeros(4, np.float32)).shape == (2,)
+
+
+def test_queue_full_shedding_over_socket():
+    """Slow device stub + tiny queue: the client sees explicit OVERLOADED
+    (queue_full) replies, never hangs, and admitted requests complete."""
+    srv = PolicyServer(
+        _bundle(), port=0, max_batch=2, max_wait_us=0, queue_limit=2,
+        watch_bundle=False,
+    )
+    srv.start()
+    real = srv.batcher._infer
+
+    def slow(p, o):
+        time.sleep(0.3)
+        return real(p, o)
+
+    srv.batcher._infer = slow
+    try:
+        with PolicyClient("127.0.0.1", srv.port) as c:
+            obs = np.zeros(4, np.float32)
+            futs = [c.act_async(obs) for _ in range(12)]
+            outcomes = {"ok": 0, "queue_full": 0}
+            for f in futs:
+                try:
+                    f.result(60)
+                    outcomes["ok"] += 1
+                except Overloaded as e:
+                    assert e.reason == "queue_full"
+                    outcomes["queue_full"] += 1
+            assert outcomes["queue_full"] >= 1, outcomes
+            assert outcomes["ok"] >= 2, outcomes
+        assert srv.stats.shed_queue_full >= 1
+    finally:
+        srv.drain()
+
+
+def test_hot_reload_during_live_traffic(tmp_path):
+    """Params swap mid-traffic: every in-flight and subsequent request gets
+    a VALID answer (old or new params, nothing else), none are dropped,
+    and the bucket programs never recompile."""
+    d = str(tmp_path / "hotbundle")
+    params_old = actor_template(CFG)
+    export_bundle(d, CFG, params_old)
+    # served from the on-disk bundle, watching it; the poll interval is
+    # huge on purpose — the test drives reloads via check_reload() so the
+    # swap instant is deterministic
+    srv = PolicyServer(
+        load_bundle(d), port=0, max_batch=4, max_wait_us=500, queue_limit=64,
+        watch_bundle=True, poll_interval_s=3600.0,
+    )
+    srv.start()
+    try:
+        obs = np.full(4, 0.3, np.float32)
+        ref_old = np.clip(
+            np.asarray(act_deterministic(CFG, params_old, obs[None])[0]), -1, 1
+        )
+        params_new = jax.tree_util.tree_map(lambda x: x + 0.5, params_old)
+        ref_new = np.clip(
+            np.asarray(act_deterministic(CFG, params_new, obs[None])[0]), -1, 1
+        )
+        compiles = srv.batcher.compile_count
+        results = []
+        errors = []
+        stop = threading.Event()
+
+        def traffic():
+            try:
+                with PolicyClient("127.0.0.1", srv.port) as c:
+                    while not stop.is_set():
+                        results.append(c.act(obs, timeout=30))
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        t = threading.Thread(target=traffic, daemon=True)
+        t.start()
+        time.sleep(0.15)  # traffic flowing on old params
+        # re-export over the live bundle dir, mtime changes → reload
+        export_bundle(d, CFG, params_new)
+        # ensure a visible mtime delta even on coarse filesystem clocks
+        os.utime(
+            os.path.join(d, "bundle.json"),
+            (time.time() + 2, time.time() + 2),
+        )
+        assert srv.check_reload() is True
+        time.sleep(0.15)  # traffic flowing on new params
+        stop.set()
+        t.join(timeout=30)
+        assert not errors, errors
+        assert srv.batcher.compile_count == compiles  # zero recompiles
+        assert srv.stats.params_reloads == 1
+        n_old = n_new = 0
+        for a in results:
+            if np.allclose(a, ref_old, atol=1e-5):
+                n_old += 1
+            elif np.allclose(a, ref_new, atol=1e-5):
+                n_new += 1
+            else:
+                raise AssertionError(f"reply matches neither param set: {a}")
+        assert n_old >= 1 and n_new >= 1, (n_old, n_new)
+    finally:
+        srv.drain()
+
+
+def test_bundle_reload_swaps_obs_norm_and_refuses_config_change(tmp_path):
+    """A re-exported bundle's normalizer stats ride the hot swap (new
+    params trained under fresher μ/σ must be served with them); a changed
+    agent config is refused — the compiled programs are config-shaped."""
+    d = str(tmp_path / "b")
+    params = actor_template(CFG)
+    stats0 = {"count": 4.0, "mean": [0.0] * 4, "m2": [4.0] * 4}
+    export_bundle(d, CFG, params, obs_norm_state=stats0)
+    srv = PolicyServer(
+        load_bundle(d), port=0, max_batch=2, max_wait_us=100,
+        watch_bundle=True, poll_interval_s=3600.0,
+    )
+    srv.start()
+    try:
+        obs = np.full(4, 2.0, np.float32)
+        with PolicyClient("127.0.0.1", srv.port) as c:
+            a0 = c.act(obs)
+            # re-export with shifted stats: same params, different μ/σ →
+            # different served action after reload
+            stats1 = {"count": 4.0, "mean": [1.5] * 4, "m2": [1.0] * 4}
+            export_bundle(d, CFG, params, obs_norm_state=stats1)
+            os.utime(
+                os.path.join(d, "bundle.json"),
+                (time.time() + 2, time.time() + 2),
+            )
+            assert srv.check_reload() is True
+            a1 = c.act(obs)
+            assert not np.allclose(a0, a1)
+            mean = np.full(4, 1.5, np.float32)
+            std = np.maximum(np.sqrt(np.full(4, 0.25)), 1e-2).astype(np.float32)
+            ref = np.clip(
+                np.asarray(
+                    act_deterministic(
+                        CFG, params, np.clip((obs - mean) / std, -5, 5)[None]
+                    )[0]
+                ),
+                -1, 1,
+            )
+            np.testing.assert_allclose(a1, ref, rtol=1e-5, atol=1e-6)
+            # a config change must NOT swap (and must not kill serving)
+            other = D4PGConfig(obs_dim=4, action_dim=2, hidden_sizes=(16, 16))
+            export_bundle(d, other, actor_template(other), obs_norm_state=stats1)
+            os.utime(
+                os.path.join(d, "bundle.json"),
+                (time.time() + 4, time.time() + 4),
+            )
+            assert srv.check_reload() is False
+            np.testing.assert_allclose(c.act(obs), a1, rtol=1e-5, atol=1e-6)
+    finally:
+        srv.drain()
+
+
+def test_watch_run_reloads_best_actor(tmp_path):
+    """The --watch-run flow: a new best_eval.json (whose contract says
+    best_actor.npz is already on disk) swaps serving params."""
+    run = tmp_path / "run"
+    ckpt = run / "checkpoints"
+    ckpt.mkdir(parents=True)
+    params_new = jax.tree_util.tree_map(
+        lambda x: x - 0.25, actor_template(CFG)
+    )
+    leaves = jax.tree_util.tree_leaves(params_new)
+    with open(ckpt / "best_actor.npz", "wb") as f:
+        np.savez(
+            f, **{f"leaf_{i:04d}": np.asarray(l) for i, l in enumerate(leaves)}
+        )
+    srv = PolicyServer(
+        _bundle(), port=0, max_batch=2, max_wait_us=100,
+        watch_bundle=False, watch_run=str(run),
+    )
+    srv.start()
+    try:
+        assert srv.check_reload() is False  # no best_eval.json yet
+        with open(run / "best_eval.json", "w") as f:
+            json.dump({"step": 7, "eval_return_mean": 1.0, "env_steps": 10}, f)
+        assert srv.check_reload() is True
+        obs = np.full(4, -0.2, np.float32)
+        ref = np.clip(
+            np.asarray(act_deterministic(CFG, params_new, obs[None])[0]), -1, 1
+        )
+        with PolicyClient("127.0.0.1", srv.port) as c:
+            np.testing.assert_allclose(c.act(obs), ref, rtol=1e-5, atol=1e-6)
+    finally:
+        srv.drain()
+
+
+def test_drain_sheds_new_answers_admitted():
+    srv = PolicyServer(
+        _bundle(), port=0, max_batch=2, max_wait_us=0, queue_limit=32,
+        watch_bundle=False,
+    )
+    srv.start()
+    real = srv.batcher._infer
+
+    def slow(p, o):
+        time.sleep(0.05)
+        return real(p, o)
+
+    srv.batcher._infer = slow
+    obs = np.zeros(4, np.float32)
+    with PolicyClient("127.0.0.1", srv.port) as c:
+        futs = [c.act_async(obs) for _ in range(8)]
+        time.sleep(0.02)
+        drainer = threading.Thread(target=srv.drain, daemon=True)
+        drainer.start()
+        ok = shed = 0
+        for f in futs:
+            try:
+                f.result(30)
+                ok += 1
+            except Overloaded as e:
+                assert e.reason in ("draining", "queue_full")
+                shed += 1
+            except Exception:
+                shed += 1  # connection torn at the tail of the drain
+        drainer.join(timeout=30)
+        assert not drainer.is_alive()
+        assert ok >= 1  # admitted work was answered, not dropped
+    # post-drain: accept loop exited and the batcher refuses new work
+    assert not srv._accept_thread.is_alive()
+    with pytest.raises((ShedError, RuntimeError)):
+        srv.batcher.submit(obs)
+
+
+def test_submit_after_batcher_stop_raises_shed():
+    srv = PolicyServer(_bundle(), port=0, max_batch=2, watch_bundle=False)
+    srv.start()
+    srv.drain()
+    with pytest.raises((ShedError, RuntimeError)):
+        srv.batcher.submit(np.zeros(4, np.float32))

@@ -1,0 +1,48 @@
+"""Tier-1 serve smoke: the whole train → --export-bundle → serve →
+round-trip → SIGTERM drain path through the real CLIs
+(``scripts/serve_smoke.sh``), in a subprocess with a clean CPU backend.
+
+This is THE end-to-end smoke for the serving subsystem (conftest fast-tier
+policy): everything else serve-related tests layers in-process; only this
+one proves the shipped commands compose.
+"""
+
+import os
+import subprocess
+import sys
+
+
+def _clean_cpu_env():
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
+        and "AXON" not in k
+        and "TPU" not in k
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def test_serve_smoke_script(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = _clean_cpu_env()
+    env["SERVE_SMOKE_DIR"] = str(tmp_path / "run")
+    p = subprocess.run(
+        ["bash", os.path.join(repo, "scripts", "serve_smoke.sh")],
+        capture_output=True,
+        text=True,
+        timeout=840,
+        env=env,
+        cwd=repo,
+    )
+    out = p.stdout + p.stderr
+    assert p.returncode == 0, out[-4000:]
+    assert "SERVE_SMOKE_ROUNDTRIP_OK" in p.stdout, out[-4000:]
+    assert "SERVE_SMOKE_OK" in p.stdout, out[-4000:]
+    # the exported bundle is a real directory artifact
+    assert os.path.exists(str(tmp_path / "run" / "bundle" / "bundle.json"))
+
+
+if __name__ == "__main__":
+    sys.exit(0)

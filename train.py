@@ -6,10 +6,18 @@ vectorized actors) and ``--dp`` (synchronous data-parallel devices, replacing
 Hogwild workers); ``--multithread`` is gone (the single-process design is
 always "multithreaded" via async dispatch).
 
+SIGTERM/SIGINT trigger a graceful preemption: the current dispatch
+finishes, a full checkpoint (+ replay snapshot if ``--snapshot-replay``)
+lands, and the process exits 75 — the same "restart me with --resume"
+contract as the RSS watchdog, so a TPU-VM preemption notice loses nothing
+since the last periodic save. A second signal hard-kills.
+
 Examples:
     python train.py --env pendulum --total-steps 50000
     python train.py --env pointmass_goal --her --n-step 1
     python train.py --env pendulum --dp 8 --batch-size 512   # 8-chip DP
+    python train.py --env Pendulum-v1 --log-dir runs/p1 \
+        --export-bundle runs/p1/bundle     # package for d4pg_tpu.serve
 """
 
 from __future__ import annotations
@@ -18,6 +26,7 @@ import argparse
 import dataclasses
 import os
 import sys
+import threading
 
 from d4pg_tpu.agent.state import D4PGConfig
 from d4pg_tpu.config import TrainConfig
@@ -101,6 +110,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "reference's Hogwild trade, staleness bounded by "
                         "K = --steps-per-dispatch)")
     p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--hidden-sizes", default=None,
+                   help="comma-separated MLP trunk widths (default "
+                        "256,256,256); must match the checkpoint when "
+                        "resuming or exporting a bundle")
     p.add_argument("--twin-critic", action="store_true",
                    help="clipped double-Q (TD3-style) distributional twin "
                         "critics; fixes the single-critic plateau on "
@@ -168,6 +181,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "uint8 (pixel envs) ships the replay's stored bytes "
                         "raw at 1/4 the f32 traffic "
                         "(docs/REMOTE_TPU.md 'fourth tax')")
+    p.add_argument("--export-bundle", default=None, metavar="DIR",
+                   help="instead of training: package this run's champion "
+                        "actor (checkpoints/best_actor.npz, else the "
+                        "latest Orbax step) + config + action bounds + "
+                        "obs-norm stats into a serving bundle at DIR for "
+                        "python -m d4pg_tpu.serve, then exit")
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace of grad steps 10-60 here")
     p.add_argument("--max-rss-gb", type=float, default=0.0,
@@ -225,6 +244,13 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         projection_backend=args.projection,
         twin_critic=args.twin_critic,
     )
+    if args.hidden_sizes:
+        agent = dataclasses.replace(
+            agent,
+            hidden_sizes=tuple(
+                int(h) for h in str(args.hidden_sizes).split(",") if h.strip()
+            ),
+        )
     # run-identity log dir (reference main.py:59-66)
     log_dir = args.log_dir or (
         f"runs/{args.env}_{'PER' if args.prioritized else 'UNI'}"
@@ -288,6 +314,138 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
     return cfg
 
 
+def export_bundle_from_run(cfg: TrainConfig, bundle_dir: str) -> str:
+    """Package a trained run into a serving bundle (``--export-bundle``).
+
+    Prefers the keep-best champion (``checkpoints/best_actor.npz`` — the
+    policy ``best_eval.json`` attests); falls back to the actor slice of
+    the latest Orbax full-state step. Action bounds come from the live
+    env's ``NormalizeAction`` when the env can be constructed here (host
+    adapters expose their Box); pure-JAX envs and unconstructible envs get
+    the canonical (−1, 1) box the policy acts in natively.
+    """
+    import json
+
+    import jax
+
+    from d4pg_tpu.runtime.checkpoint import load_trainer_meta
+    from d4pg_tpu.serve.bundle import actor_template, export_bundle
+
+    env = None
+    try:
+        from d4pg_tpu.envs import make_env
+
+        env = make_env(cfg.env, cfg.max_episode_steps, cfg.action_repeat)
+    except Exception as e:
+        print(
+            f"[export-bundle] could not construct env {cfg.env!r} ({e}); "
+            "using preset dims and canonical (-1,1) action bounds"
+        )
+    low = high = None
+    if env is not None:
+        from d4pg_tpu.runtime.trainer import _reconcile_config
+
+        cfg = _reconcile_config(cfg, env)
+        norm = getattr(env, "_normalize", None)
+        if norm is not None:
+            low, high = norm.low, norm.high
+    agent_cfg = cfg.agent
+    ckpt_dir = os.path.join(cfg.log_dir, "checkpoints")
+    best_npz = os.path.join(ckpt_dir, "best_actor.npz")
+    meta = load_trainer_meta(cfg.log_dir)
+    provenance = {
+        "env": cfg.env,
+        "log_dir": os.path.abspath(cfg.log_dir),
+        "env_steps": meta.get("env_steps"),
+    }
+    obs_norm_state = meta.get("obs_norm")
+    if os.path.exists(best_npz):
+        from d4pg_tpu.runtime.trainer import load_best_actor
+
+        params = load_best_actor(cfg.log_dir, actor_template(agent_cfg))
+        provenance["source"] = "best_actor.npz"
+        best_json = os.path.join(cfg.log_dir, "best_eval.json")
+        if os.path.exists(best_json):
+            try:
+                with open(best_json) as f:
+                    provenance["best_eval"] = json.load(f)
+            except (OSError, ValueError):
+                pass
+        # Pair the champion with the normalizer statistics captured WHEN it
+        # was scored (best_obs_norm.json, written beside best_actor.npz) —
+        # trainer_meta.json keeps drifting with later collection, which is
+        # the wrong μ/σ for these params.
+        best_norm = os.path.join(ckpt_dir, "best_obs_norm.json")
+        if os.path.exists(best_norm):
+            with open(best_norm) as f:
+                obs_norm_state = json.load(f)
+        elif cfg.obs_norm:
+            print(
+                "[export-bundle] warning: no best_obs_norm.json next to "
+                "best_actor.npz (run predates the paired snapshot); using "
+                "trainer_meta.json statistics, which may postdate the "
+                "champion params"
+            )
+    else:
+        from d4pg_tpu.agent import create_train_state
+        from d4pg_tpu.runtime.checkpoint import CheckpointManager
+
+        ckpt = CheckpointManager(ckpt_dir)
+        step = ckpt.latest_step()
+        if step is None:
+            ckpt.close()
+            raise SystemExit(
+                f"--export-bundle: no best_actor.npz and no Orbax "
+                f"checkpoint under {ckpt_dir} — train (and checkpoint) first"
+            )
+        state = ckpt.restore(
+            create_train_state(agent_cfg, jax.random.PRNGKey(cfg.seed)), step
+        )
+        ckpt.close()
+        params = jax.device_get(state.actor_params)
+        provenance["source"] = f"orbax:{step}"
+        provenance["grad_steps"] = step
+    if cfg.obs_norm and obs_norm_state is None:
+        raise SystemExit(
+            "--export-bundle: run is flagged --obs-norm but neither "
+            "best_obs_norm.json nor trainer_meta.json carries normalizer "
+            "statistics; export would serve the net un-normalized inputs"
+        )
+    out = export_bundle(
+        bundle_dir,
+        agent_cfg,
+        params,
+        action_low=low,
+        action_high=high,
+        obs_norm_state=obs_norm_state,
+        meta=provenance,
+    )
+    if env is not None and hasattr(env, "close"):
+        env.close()
+    print(
+        f"[export-bundle] wrote {out} "
+        f"(source={provenance['source']}, obs_dim={agent_cfg.obs_dim}, "
+        f"action_dim={agent_cfg.action_dim}, "
+        f"obs_norm={'yes' if obs_norm_state else 'no'})"
+    )
+    return out
+
+
+def install_preemption_handlers(stop_callback) -> None:
+    """SIGTERM/SIGINT → graceful preemption via ``stop_callback`` (which
+    must be signal-safe: it only sets an event). First signal arms the
+    checkpoint-and-exit-75 path, second hard-kills — the arm-first /
+    restore-disposition / guarded-print ordering lives in
+    :func:`d4pg_tpu.utils.signals.install_graceful_signals`."""
+    from d4pg_tpu.utils.signals import install_graceful_signals
+
+    install_graceful_signals(
+        stop_callback,
+        "[signal] {sig}: checkpointing and exiting 75 "
+        "(--resume restarts; second signal hard-kills)",
+    )
+
+
 def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
     if args.distributed or args.coordinator or (args.num_processes or 0) > 1:
@@ -305,6 +463,9 @@ def main(argv=None) -> None:
     from d4pg_tpu.runtime import Trainer
 
     cfg = config_from_args(args)
+    if args.export_bundle:
+        export_bundle_from_run(cfg, args.export_bundle)
+        return
     if info is not None and info["process_index"] != 0:
         # Every process runs the same command line; secondary hosts write
         # metrics/checkpoints to their own subdir so a shared filesystem
@@ -328,13 +489,16 @@ def main(argv=None) -> None:
             )
         from d4pg_tpu.runtime.on_device import run_on_device
 
-        final = run_on_device(cfg)
+        preempt_event = threading.Event()
+        install_preemption_handlers(preempt_event.set)
+        final = run_on_device(cfg, preempt_event=preempt_event)
         preempted = final.pop("_preempted", False)
         print(f"done: {final}")
         if preempted:
             sys.exit(75)  # rss-watchdog: checkpointed, restart with --resume
         return
     trainer = Trainer(cfg)
+    install_preemption_handlers(trainer.request_preemption)
     try:
         final = trainer.train()
         print(f"done: {final}")
