@@ -91,8 +91,10 @@ def run_microbench(
                 cost = cost[0]
             out[f"{name}_bytes_accessed"] = float(cost.get("bytes accessed", 0.0))
             out[f"{name}_flops"] = float(cost.get("flops", 0.0))
-        except Exception:
-            pass  # bytes proxy unavailable on this backend; timings still land
+        except Exception:  # d4pglint: disable=broad-except  -- optional XLA
+            # cost-analysis probe: shape of the failure varies by backend/
+            # jax version and the benchmark's timings land either way
+            pass
         state, _, priorities = step(state, batch_data)  # compile + warmup
         jax.block_until_ready(priorities)
         t0 = time.perf_counter()

@@ -1,0 +1,40 @@
+"""Runtime invariant guards for the D4PG data plane (``--debug-guards``).
+
+Three guards, each turning a silent-corruption/slow-tax bug class from
+past PRs into an immediate, attributable error:
+
+- :class:`~d4pg_tpu.analysis.recompile.RecompileSentinel` — compiles per
+  jitted entry point, with budgets (train_step once per config, serve
+  once per bucket);
+- :func:`~d4pg_tpu.analysis.transfer.no_implicit_transfers` — implicit
+  host→device transfers in steady-state dispatch raise instead of
+  silently re-uploading every step;
+- :class:`~d4pg_tpu.analysis.ledger.StagingLedger` — generation-tagged
+  rotated host staging slots; a write while an in-flight dispatch holds
+  the slot raises naming slot and holder.
+
+The static half of the correctness tooling lives in ``tools/d4pglint``
+(see docs/analysis.md for the full catalog).
+
+This package must stay importable without JAX (``ledger`` is carried by
+host-only modules), hence the lazy re-exports.
+"""
+
+from __future__ import annotations
+
+from d4pg_tpu._lazy import lazy_exports
+
+_EXPORTS = {
+    "StagingLedger": "d4pg_tpu.analysis.ledger",
+    "StagingReuseError": "d4pg_tpu.analysis.ledger",
+    "Hold": "d4pg_tpu.analysis.ledger",
+    "NULL_LEDGER": "d4pg_tpu.analysis.ledger",
+    "RecompileSentinel": "d4pg_tpu.analysis.recompile",
+    "RecompileBudgetError": "d4pg_tpu.analysis.recompile",
+    "no_implicit_transfers": "d4pg_tpu.analysis.transfer",
+    "explicit_transfer": "d4pg_tpu.analysis.transfer",
+}
+
+__getattr__, __dir__ = lazy_exports(__name__, _EXPORTS)
+
+__all__ = sorted(_EXPORTS)

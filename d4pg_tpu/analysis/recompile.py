@@ -1,0 +1,177 @@
+"""Recompile sentinel: per-entry-point compile accounting with budgets.
+
+A silent recompile is a correctness *and* a performance bug here: the
+trainer's ``train_step`` must compile exactly once per config (a traced
+arg degrading to a constant — a python scalar, a weak-typed array, a
+shape drift — retraces every dispatch and turns a µs hot loop into
+seconds), and the serve batcher's ``infer`` must compile once per
+bucket at warmup and never again across hot reloads.  PR 3 asserted
+this for serve only, via an ad-hoc trace-count stub; the sentinel
+generalizes it to every jitted entry point in the runtime.
+
+Two complementary mechanisms:
+
+- **per-entry accounting** — :meth:`RecompileSentinel.track` registers a
+  jitted callable by name and reads its jit cache size
+  (``fn._cache_size()``: the number of distinct traced/compiled
+  specializations). Exact attribution, no log parsing.
+- **global compile stream** — a ``jax.monitoring`` listener on the
+  ``/jax/core/compile/backend_compile_duration`` event counts *every*
+  backend compile in the process (:attr:`total_compiles`), so a steady
+  -state window can assert "no compile happened at all, anywhere",
+  including eager ops and entry points nobody remembered to track.
+
+Budgets: :meth:`freeze` snapshots each tracked entry's current count as
+its budget (optionally overridden per entry); :meth:`check` raises
+:class:`RecompileBudgetError` naming every entry over budget.  The
+trainer freezes after its first dispatch (warmup compiles are the
+budget) and checks at eval crossings and at the end of ``train()``;
+the batcher freezes after bucket warmup.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+class RecompileBudgetError(RuntimeError):
+    """A tracked jitted entry point compiled more often than its budget."""
+
+
+class _Entry:
+    __slots__ = ("name", "fn", "budget")
+
+    def __init__(self, name, fn, budget):
+        self.name = name
+        self.fn = fn
+        self.budget = budget
+
+
+def _cache_size(fn) -> int:
+    """Number of compiled specializations held by a jitted callable.
+
+    ``jax.jit`` wrappers expose ``_cache_size()``; anything else (an AOT
+    ``Compiled``, a plain function) is treated as never-recompiling."""
+    sizer = getattr(fn, "_cache_size", None)
+    return int(sizer()) if callable(sizer) else 0
+
+
+class RecompileSentinel:
+    """Records compiles per jitted entry point and asserts budgets.
+
+    Use as a context manager (or ``start()``/``stop()``) to also count
+    the process-wide compile stream via ``jax.monitoring``; ``track``/
+    ``freeze``/``check`` work regardless.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: dict[str, _Entry] = {}
+        self._listener = None
+        self._total = 0
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "RecompileSentinel":
+        """Register the global compile-event listener (idempotent)."""
+        if self._listener is not None:
+            return self
+        import jax.monitoring
+
+        def _on_event(name: str, duration: float, **kwargs) -> None:
+            if name == _COMPILE_EVENT:
+                with self._lock:
+                    self._total += 1
+
+        jax.monitoring.register_event_duration_secs_listener(_on_event)
+        self._listener = _on_event
+        return self
+
+    def stop(self) -> None:
+        """Unregister the global listener (jax only exposes removal via a
+        private helper; fall back to leaving a dead listener registered —
+        it only increments a counter nobody reads after this)."""
+        if self._listener is None:
+            return
+        try:
+            from jax._src import monitoring as _monitoring
+
+            _monitoring._unregister_event_duration_listener_by_callback(
+                self._listener
+            )
+        except (ImportError, AttributeError, ValueError):
+            pass
+        self._listener = None
+
+    def __enter__(self) -> "RecompileSentinel":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------- tracking
+    def track(self, name: str, fn, budget: Optional[int] = None) -> None:
+        """Register a jitted callable under ``name``. ``budget`` caps its
+        allowed compiled-specialization count; None = unbudgeted until
+        :meth:`freeze`. Re-tracking a name replaces the callable (the
+        trainer rebuilds entry points across modes)."""
+        with self._lock:
+            self._entries[name] = _Entry(name, fn, budget)
+
+    def count(self, name: str) -> int:
+        with self._lock:
+            e = self._entries[name]
+        return _cache_size(e.fn)
+
+    def counts(self) -> dict:
+        with self._lock:
+            entries = list(self._entries.values())
+        return {e.name: _cache_size(e.fn) for e in entries}
+
+    @property
+    def total_compiles(self) -> int:
+        """Process-wide backend compiles observed while started (every
+        jit/eager compile, tracked or not)."""
+        with self._lock:
+            return self._total
+
+    # -------------------------------------------------------------- budgets
+    def set_budget(self, name: str, budget: Optional[int]) -> None:
+        """Pin one entry's budget (None = unbudgeted, skipped by check)."""
+        with self._lock:
+            self._entries[name].budget = budget
+
+    def freeze(self, **overrides: int) -> dict:
+        """Snapshot each tracked entry's current compile count as its
+        budget (the warmup compiles ARE the budget); ``overrides`` pin
+        specific entries to an explicit budget. Returns the budgets."""
+        with self._lock:
+            entries = list(self._entries.values())
+        budgets = {}
+        for e in entries:
+            e.budget = int(overrides.get(e.name, _cache_size(e.fn)))
+            budgets[e.name] = e.budget
+        return budgets
+
+    def check(self, where: str = "") -> dict:
+        """Assert every budgeted entry is within budget; returns current
+        counts. Raises :class:`RecompileBudgetError` naming each offender
+        with its count and budget."""
+        with self._lock:
+            entries = list(self._entries.values())
+        counts, over = {}, []
+        for e in entries:
+            n = _cache_size(e.fn)
+            counts[e.name] = n
+            if e.budget is not None and n > e.budget:
+                over.append(f"{e.name}: {n} compiles > budget {e.budget}")
+        if over:
+            ctx = f" ({where})" if where else ""
+            raise RecompileBudgetError(
+                f"recompile budget exceeded{ctx}: " + "; ".join(over)
+                + " — a traced argument likely degraded to a constant or "
+                "changed shape/dtype between dispatches"
+            )
+        return counts

@@ -1,0 +1,46 @@
+"""Transfer guard: fail on implicit host↔device transfers in steady state.
+
+An *implicit* transfer — a numpy array or python scalar handed straight
+to a jitted call — silently re-uploads on every dispatch, which on a
+remote/tunneled chip is a ~100 ms link round-trip hiding inside a hot
+loop (docs/REMOTE_TPU.md).  The repo's discipline is: the steady-state
+dispatch consumes only device-resident operands; every host→device copy
+is an *explicit* ``jax.device_put``/``jnp.asarray`` in a staging step
+(replay ``_sample_staged``, the batcher's ``device_put`` of its staging
+slot), which the guard deliberately exempts.
+
+:func:`no_implicit_transfers` wraps exactly the dispatch call sites
+(trainer train-step dispatch, batcher infer dispatch) behind
+``--debug-guards``; any implicit transfer raises jax's
+``Disallowed host-to-device transfer`` error at the offending operand
+instead of slowly taxing every step. The context is thread-local (jax
+config scopes), so the batcher device thread guards only itself.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+
+@contextlib.contextmanager
+def no_implicit_transfers(enabled: bool = True):
+    """Context: disallow implicit host→device transfers (explicit
+    ``device_put`` stays allowed). No-op when ``enabled`` is False so
+    call sites can wrap unconditionally."""
+    if not enabled:
+        yield
+        return
+    import jax
+
+    with jax.transfer_guard_host_to_device("disallow"):
+        yield
+
+
+@contextlib.contextmanager
+def explicit_transfer():
+    """Escape hatch for a deliberate transfer *inside* a guarded region
+    (prefer restructuring so staging happens outside the guard)."""
+    import jax
+
+    with jax.transfer_guard_host_to_device("allow"):
+        yield

@@ -61,6 +61,12 @@ class TrainConfig:
     # class as steps_per_dispatch>1, and strictly less than async_collect's.
     # Default off so existing runs are batch-for-batch identical.
     prefetch: bool = False
+    # Runtime invariant guards (d4pg_tpu/analysis): recompile sentinel on
+    # every jitted entry point, transfer guard around steady-state
+    # dispatch, staging ledger on every rotated host staging slot. Debug
+    # mode — guard trips raise instead of silently corrupting/taxing the
+    # run. Off by default (the ledger adds a lock per staged slot).
+    debug_guards: bool = False
 
     # async actor/learner decoupling (host actor pool only): collection runs
     # in a background thread against periodically published actor params

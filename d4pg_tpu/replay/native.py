@@ -44,7 +44,9 @@ def load_library() -> ctypes.CDLL:
         # a foreign binary; no -march=native for the same reason (the build
         # dir is gitignored, but belt and braces).
         if not os.path.exists(so) or os.path.getmtime(so) <= os.path.getmtime(src):
-            subprocess.run(
+            # one-time compile; serializing concurrent first-users on the
+            # lock is the point (two racing g++ -o same.so corrupt it)
+            subprocess.run(  # d4pglint: disable=lock-blocking-call
                 ["g++", "-O3", "-shared", "-fPIC", "-o", so, src],
                 check=True,
                 capture_output=True,

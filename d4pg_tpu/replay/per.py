@@ -22,6 +22,7 @@ from typing import NamedTuple
 
 import numpy as np
 
+from d4pg_tpu.analysis.ledger import NULL_LEDGER
 from d4pg_tpu.replay.schedules import linear_schedule
 from d4pg_tpu.replay.segment_tree import MinTree, SumTree
 from d4pg_tpu.replay.uniform import ReplayBuffer, Transition
@@ -79,7 +80,14 @@ class PrioritizedReplayBuffer(ReplayBuffer):
                 self._sum = NativeSumTree(self.capacity)
                 self._min = NativeMinTree(self.capacity)
                 self._use_native = True
-            except Exception:
+            except Exception as e:
+                # "auto" means degrade, not die — but a 5-10x slower tree
+                # backend should never be a silent surprise: log which
+                # failure forced the fallback (no g++, bad build dir, ...).
+                print(
+                    f"[replay] native tree backend unavailable ({e!r}); "
+                    "falling back to NumPy trees"
+                )
                 self._sum = SumTree(self.capacity)
                 self._min = MinTree(self.capacity)
         else:
@@ -91,6 +99,10 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         # stay stable while the next sample_block of the same size fills a
         # different slot (see _staging_slot).
         self._staging: dict = {}
+        # Staging ledger (--debug-guards): generation-tags the rotation so
+        # a write into a slot an in-flight dispatch still holds raises
+        # instead of corrupting the staged batch. NULL_LEDGER = no-op.
+        self._ledger = NULL_LEDGER
 
     def add_batch(self, t: Transition) -> np.ndarray:
         idx = super().add_batch(t)
@@ -131,7 +143,9 @@ class PrioritizedReplayBuffer(ReplayBuffer):
             # Capture generations BEFORE gather: if a writer recycles a slot
             # in between, the stale stamp makes update_priorities drop that
             # entry (conservative) rather than mis-stamp the new transition.
-            gen = self._gen[idx].copy()
+            # The copy IS the capture (a view would track the writer) and
+            # must survive past the lock — small [B] int64 by design.
+            gen = self._gen[idx].copy()  # d4pglint: disable=hot-path-alloc
         return idx, weights.astype(np.float32), gen
 
     def sample(self, batch_size: int, rng: np.random.Generator, step: int = 0):
@@ -189,11 +203,12 @@ class PrioritizedReplayBuffer(ReplayBuffer):
             else _native.OBS_U8_RAW
         )
 
-    def _staging_slot(self, n: int) -> dict:
-        """Next staging buffer set for an n-row draw (allocated once per
-        size, then reused round-robin — the zero-alloc half of the native
-        data plane: ``jax.device_put`` always reads stable, caller-owned
-        memory that no GC or resize can move)."""
+    def _staging_slot(self, n: int) -> tuple[dict, int]:
+        """Next staging buffer set for an n-row draw plus its rotation
+        position (allocated once per size, then reused round-robin — the
+        zero-alloc half of the native data plane: ``jax.device_put``
+        always reads stable, caller-owned memory that no GC or resize can
+        move)."""
         entry = self._staging.get(n)
         if entry is None:
             obs_dtype = (
@@ -226,11 +241,28 @@ class PrioritizedReplayBuffer(ReplayBuffer):
                     )
                 return slot
 
-            entry = {"slots": [mk() for _ in range(self.STAGING_SLOTS)], "next": 0}
+            entry = {
+                "slots": [mk() for _ in range(self.STAGING_SLOTS)],
+                "next": 0,
+                # ledger group precomputed: no per-call f-string on the
+                # hot path (NULL_LEDGER would only discard it)
+                "group": f"per.sample_block[n={n}]",
+            }
             self._staging[n] = entry
-        slot = entry["slots"][entry["next"]]
-        entry["next"] = (entry["next"] + 1) % len(entry["slots"])
-        return slot
+        pos = entry["next"]
+        # Declares the overwrite to the ledger BEFORE the fill: trips here,
+        # at the reuse site, if a dispatch staged from this slot is still
+        # in flight (hold not yet released by the consumer).
+        self._ledger.write(entry["group"], pos)
+        slot = entry["slots"][pos]
+        entry["next"] = (pos + 1) % len(entry["slots"])
+        return slot, pos
+
+    def set_ledger(self, ledger) -> None:
+        """Attach a :class:`~d4pg_tpu.analysis.ledger.StagingLedger`
+        (--debug-guards); the trainer releases the returned holds at each
+        dispatch's priority-fetch synchronization point."""
+        self._ledger = ledger if ledger is not None else NULL_LEDGER
 
     def sample_block(
         self, batch_size: int, k: int, rng: np.random.Generator, step: int = 0
@@ -259,7 +291,7 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         it hands out one slot per call, not per thread.
         """
         n = batch_size * k
-        st = self._staging_slot(n)
+        st, slot_pos = self._staging_slot(n)
         if self._use_native:
             with self._lock:
                 total = self._sum.sum()
@@ -288,8 +320,18 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         }
         out["weights"] = block(st["weights"])
         out["indices"] = SampledIndices(
-            block(st["idx"]).copy(), block(st["gen"]).copy()
+            # fresh small copies ARE the contract: idx/gen outlive the
+            # staging rotation in the async priority flusher (docstring)
+            block(st["idx"]).copy(), block(st["gen"]).copy()  # d4pglint: disable=hot-path-alloc
         )
+        if self._ledger is not NULL_LEDGER:
+            # Hand the consumer a hold on this slot; it must release at the
+            # point that synchronizes the dispatch's read of the staged
+            # arrays (the trainer: its priority D2H fetch). Key is absent
+            # with guards off so default behavior is byte-identical.
+            out["_staging_hold"] = self._ledger.hold(
+                self._staging[n]["group"], slot_pos
+            )
         return out
 
     def _snapshot_arrays(self) -> dict:

@@ -6,11 +6,23 @@ vectorized rollouts (or host env threads for gymnasium), a lock-guarded host
 replay, a learner loop with double-buffered device prefetch and priority
 write-back, a greedy evaluator, TensorBoard/JSONL metrics, and Orbax
 checkpoint/resume.
+
+Lazy re-exports (the `_lazy.py` contract): importing a runtime submodule
+must not drag the JAX runtime in — ``runtime.actor_pool`` and
+``runtime.metrics`` are host-only (spawned pool workers, serve metrics),
+and an eager ``from .trainer import Trainer`` here made ANY
+``d4pg_tpu.runtime.*`` import pay the full JAX import.
 """
 
-from d4pg_tpu.runtime.metrics import MetricsLogger
-from d4pg_tpu.runtime.checkpoint import CheckpointManager
-from d4pg_tpu.runtime.evaluator import evaluate
-from d4pg_tpu.runtime.trainer import Trainer
+from d4pg_tpu._lazy import lazy_exports
 
-__all__ = ["MetricsLogger", "CheckpointManager", "evaluate", "Trainer"]
+_EXPORTS = {
+    "MetricsLogger": "d4pg_tpu.runtime.metrics",
+    "CheckpointManager": "d4pg_tpu.runtime.checkpoint",
+    "evaluate": "d4pg_tpu.runtime.evaluator",
+    "Trainer": "d4pg_tpu.runtime.trainer",
+}
+
+__getattr__, __dir__ = lazy_exports(__name__, _EXPORTS)
+
+__all__ = sorted(_EXPORTS)

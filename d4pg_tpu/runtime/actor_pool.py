@@ -28,9 +28,12 @@ episode ended, in which case the child has already reset).
 from __future__ import annotations
 
 import multiprocessing as mp
+from collections import deque
 from typing import Optional
 
 import numpy as np
+
+from d4pg_tpu.analysis.ledger import NULL_LEDGER
 
 
 def _worker(
@@ -104,6 +107,7 @@ class HostActorPool:
         seed: int = 0,
         start_method: str = "spawn",
         action_repeat: int = 1,
+        ledger=None,
     ):
         assert num_actors >= 1
         self.num_actors = num_actors
@@ -138,6 +142,15 @@ class HostActorPool:
         # pool step. Retention beyond one step would need a copy.
         self._reply_slots = None
         self._reply_next = 0
+        # Staging ledger (--debug-guards): each handed-out reply slot is
+        # held for the one step the caller retains it (acts on pol_obs,
+        # then steps again); the hold from two steps ago — whose slot this
+        # step rewrites — is released at entry, because the caller passing
+        # materialized actions proves it consumed that slot. A rotation
+        # regression (single-buffering the replies) trips the ledger at
+        # the overwrite. NULL_LEDGER = no-op when guards are off.
+        self._ledger = ledger if ledger is not None else NULL_LEDGER
+        self._reply_holds: deque = deque()
 
     def reset_all(self, seed: Optional[int] = None) -> np.ndarray:
         """Reset every env; returns stacked obs [N, obs_dim]."""
@@ -184,18 +197,25 @@ class HostActorPool:
                 )
 
             self._reply_slots = (mk(), mk())
-        slot = self._reply_slots[self._reply_next]
+        pos = self._reply_next
+        self._ledger.write("pool.reply", pos)
+        slot = self._reply_slots[pos]
         self._reply_next ^= 1
-        return slot
+        return slot, pos
 
     def _step_cmd(self, actions: np.ndarray, cmd: str):
         with_goals = cmd == "step_goal"
         actions = np.asarray(actions)
+        # The caller handing us materialized actions means it is done with
+        # the slot from two steps ago (it acted on last step's pol_obs to
+        # produce these) — release that hold before _reply_slot rewrites it.
+        while len(self._reply_holds) >= 2:
+            self._reply_holds.popleft().release()
         for i, c in enumerate(self._conns):
             c.send((cmd, actions[i]))
         replies = [c.recv() for c in self._conns]
-        obs2, rews, terms, truncs, pol_obs, succ, succ_rep = self._reply_slot(
-            np.size(replies[0][0])
+        (obs2, rews, terms, truncs, pol_obs, succ, succ_rep), slot_pos = (
+            self._reply_slot(np.size(replies[0][0]))
         )
         g_prev, g_next = [], []
         for i, reply in enumerate(replies):
@@ -211,6 +231,9 @@ class HostActorPool:
                 g_prev.append(reply[6])
                 g_next.append(reply[7])
         out = (obs2, rews, terms, truncs, pol_obs, succ, succ_rep)
+        self._reply_holds.append(
+            self._ledger.hold("pool.reply", slot_pos, holder=cmd)
+        )
         return out + (g_prev, g_next) if with_goals else out
 
     def close(self) -> None:
@@ -232,5 +255,7 @@ class HostActorPool:
     def __del__(self):  # best-effort cleanup
         try:
             self.close()
-        except Exception:
+        except Exception:  # d4pglint: disable=broad-except  -- interpreter
+            # teardown: pipes/children may already be gone and __del__ must
+            # never raise; close() is the loud path for live callers
             pass

@@ -58,7 +58,11 @@ class MetricsLogger:
                 from torch.utils.tensorboard import SummaryWriter
 
                 self._tb = SummaryWriter(log_dir)
-            except Exception:
+            except Exception as e:
+                # TensorBoard is an optional sink with many failure modes
+                # (no torch, proto version skew, read-only dir); training
+                # must proceed on JSONL alone — but say so, once.
+                print(f"[metrics] tensorboard writer disabled ({e!r})")
                 self._tb = None
         self._t0 = time.monotonic()
         # log() is called from the learner thread (replaced-request train

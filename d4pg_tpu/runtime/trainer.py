@@ -24,12 +24,14 @@ device mesh.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
 import os
 import queue
 import threading
 import time
+from collections import deque
 from typing import Optional
 
 import jax
@@ -187,6 +189,25 @@ def _reconcile_config(config: TrainConfig, env) -> TrainConfig:
 
 
 class Trainer:
+    # Cross-thread attributes written WITHOUT a lock, each safe by a
+    # specific argument (d4pglint shared-mutable-state contract: guard it,
+    # or declare it here with the why):
+    _THREAD_SAFE = (
+        # single-writer (collector thread only); learner reads env_steps as
+        # a monotone int for pacing and tolerates one-step staleness
+        "_pool_obs", "_pool_noise", "_collect_key", "env_steps",
+        # single-transition None→exception flags; readers only check
+        # is-None and then raise from them
+        "_collector_error", "_wb_error", "_eval_error",
+        # lazy one-time init + idempotent value (jit cache is shared), so
+        # a duplicate publication from a racing second caller is identical
+        "_eval_pool", "_eval_env", "_eval_act", "_cpu_params",
+        "_cpu_params_step",
+        # single-writer (evaluator thread, requests processed in order);
+        # learner-thread readers are documented one-eval-stale tolerant
+        "ewma_return", "_best_eval", "_last_eval_row", "_last_eval_ev",
+    )
+
     def __init__(self, config: TrainConfig):
         self.env = make_env(
             config.env, config.max_episode_steps, config.action_repeat
@@ -336,6 +357,35 @@ class Trainer:
                 f"got {config.transfer_dtype!r}"
             )
 
+        # Runtime invariant guards (--debug-guards, d4pg_tpu/analysis):
+        # recompile sentinel on every jitted entry point (train step budget
+        # pinned after the first dispatch, checked at eval crossings and at
+        # the end of train()); transfer guard around the steady-state
+        # dispatch (implicit host→device transfers raise); staging ledger
+        # on the replay sample_block rotation and the actor-pool reply
+        # slots (a write while a dispatch holds the slot raises, naming
+        # slot and holder).
+        self._debug_guards = bool(config.debug_guards)
+        self.sentinel = None
+        self._ledger = None
+        self._staging_holds: deque = deque()  # FIFO, one per PER block dispatch
+        self._dispatch_guard = contextlib.nullcontext
+        if self._debug_guards:
+            from d4pg_tpu.analysis import (
+                RecompileSentinel,
+                StagingLedger,
+                no_implicit_transfers,
+            )
+
+            self.sentinel = RecompileSentinel().start()
+            self.sentinel.track("train_step", self._train_step)
+            if self._fused_step is not None:
+                self.sentinel.track("fused_step", self._fused_step)
+            self._dispatch_guard = no_implicit_transfers
+            self._ledger = StagingLedger("trainer")
+            if hasattr(self.buffer, "set_ledger"):
+                self.buffer.set_ledger(self._ledger)
+
         self.metrics = MetricsLogger(config.log_dir)
         # Per-stage data-plane wall-time counters (env-step / replay-insert
         # / sample / H2D-stage / train-dispatch / priority-write-back),
@@ -482,15 +532,22 @@ class Trainer:
         else:
             self._setup_host_collect()
 
-    def _act_jit(self, fn):
+    def _act_jit(self, fn, budget: int = 1):
         """jit for the host-env acting paths. Placement is carried by the
         operands, not the jit: in CPU-acting mode every stateful input
         (params, PRNG key, noise state) is committed to the CPU device via
         ``jax.device_put`` and jit follows committed inputs — this keeps the
         C++ fast dispatch path (a ``jax.default_device`` context or the
         deprecated ``backend=`` argument forces Python dispatch, ~2 ms/call,
-        which would eat the entire win)."""
-        return jax.jit(fn)
+        which would eat the entire win).
+
+        With guards on, the jitted entry is tracked under ``fn.__name__``
+        with ``budget`` allowed specializations (acting shapes are fixed
+        per mode, so the default is one compile, ever)."""
+        jitted = jax.jit(fn)
+        if self.sentinel is not None:
+            self.sentinel.track(fn.__name__, jitted, budget=budget)
+        return jitted
 
     def _to_act_device(self, tree):
         """Commit a pytree to the acting backend's device (identity unless
@@ -656,6 +713,7 @@ class Trainer:
             seed=cfg.seed,
             start_method=cfg.pool_start_method,
             action_repeat=cfg.action_repeat,
+            ledger=self._ledger,
         )
         self.has_pool = True
         # One N-wide writer: vectorized window append + ONE add_batch per
@@ -853,6 +911,9 @@ class Trainer:
                         pri = np.concatenate(
                             [np.asarray(p) for _, p in items], axis=0
                         )
+                        # Every dispatch in this group has now materialized
+                        # its priorities — its staged batch is consumed.
+                        self._release_staging_holds(len(items))
                         with self._buffer_lock:
                             for k, ix in enumerate(idx_all):
                                 if ix is not None:
@@ -916,7 +977,10 @@ class Trainer:
                 priorities.copy_to_host_async()
             with self._wb_idle_lock:
                 self._wb_idle.clear()
-                self._wb_queue.put((indices, priorities))
+                # unbounded queue: put() cannot block; the lock exists
+                # precisely to order clear()+put() against the flusher's
+                # empty()+set() (TOCTOU note at _wb_idle_lock's init)
+                self._wb_queue.put((indices, priorities))  # d4pglint: disable=lock-blocking-call
 
     def _drain_writeback(self, timeout: float = 60.0) -> None:
         """Block until the flusher has applied everything queued so far —
@@ -1199,12 +1263,33 @@ class Trainer:
         per-batch path."""
         cfg = self.config
         if cfg.prioritized and hasattr(self.buffer, "sample_block"):
+            if self._ledger is not None and self._wb_thread is not None:
+                # Async flusher paces hold releases, so the learner must
+                # not rotate staging past slots whose holds the flusher
+                # simply hasn't fetched yet — that would false-trip the
+                # ledger on a correct run. Wait until the slot this call
+                # will rewrite has had its hold released (the dispatch it
+                # fed is always already queued to the flusher, so this
+                # cannot deadlock). Debug-guards-only pacing.
+                slots = getattr(self.buffer, "STAGING_SLOTS", 3)
+                while len(self._staging_holds) > slots - 1:
+                    if self._wb_error is not None:
+                        raise RuntimeError(
+                            "priority write-back thread died"
+                        ) from self._wb_error
+                    time.sleep(0.0005)
             with self._timers.stage("sample"):
                 with self._buffer_lock:
                     block = self.buffer.sample_block(
                         cfg.batch_size, K, self._rng, step=self.grad_steps
                     )
                 indices = block.pop("indices")
+                hold = block.pop("_staging_hold", None)
+                if hold is not None:
+                    # Released (FIFO) when this dispatch's priority fetch
+                    # synchronizes its read of the staged arrays — see
+                    # _release_staging_holds.
+                    self._staging_holds.append(hold)
                 if K == 1:  # [1, B] block → the flat [B] batch K=1 dispatches use
                     indices = SampledIndices(indices.idx[0], indices.gen[0])
                     block = {k: v[0] for k, v in block.items()}
@@ -1233,10 +1318,32 @@ class Trainer:
             indices = [s.pop("indices", None) for s in samples]
             with self._timers.stage("h2d_stage"):
                 dev_batch = {
-                    k: jnp.asarray(self._stage(k, np.stack([s[k] for s in samples])))
+                    # legacy non-block sampler (uniform replay / no
+                    # sample_block): K per-batch gathers have already
+                    # allocated, so the stack is not the marginal cost here
+                    k: jnp.asarray(self._stage(k, np.stack([s[k] for s in samples])))  # d4pglint: disable=hot-path-alloc
                     for k in samples[0]
                 }
         return indices, dev_batch
+
+    def _release_staging_holds(self, n: int = 1) -> None:
+        """Release the oldest ``n`` staging-ledger holds: called at each
+        dispatch's priority-fetch point (``np.asarray`` on the dispatch's
+        output synchronizes its compute, hence transitively the H2D read
+        of the staged batch). Dispatches and PER-block holds are both
+        FIFO, so popleft pairs them. No-op when guards are off (the deque
+        is only fed by _sample_staged's ledgered path).
+
+        Order matters: release BEFORE popleft. The learner's pacing gate
+        keys on the deque length, so shrinking it first would let the
+        learner write the slot in the window before the released flag is
+        visible — a spurious ledger trip. Releasing first errs the safe
+        way (one extra pacing wait)."""
+        for _ in range(n):
+            if not self._staging_holds:
+                return
+            self._staging_holds[0].release()
+            self._staging_holds.popleft()
 
     def _norm_obs(self, x: np.ndarray) -> np.ndarray:
         """Read-only normalizer view for eval forwards (identity when off)."""
@@ -1352,15 +1459,29 @@ class Trainer:
                 # dispatch is async: the TPU runs while we prefetch the next
                 # batch and write back the PREVIOUS step's priorities
                 with self._timers.stage("train_dispatch"):
-                    if K == 1:
-                        self.state, metrics, priorities = self._train_step(
-                            self.state, dev_batch
-                        )
-                    else:
-                        self.state, metrics_k, priorities = self._fused_step(
-                            self.state, dev_batch
-                        )
-                        metrics = jax.tree.map(lambda x: x.mean(), metrics_k)
+                    # _dispatch_guard (--debug-guards): the steady-state
+                    # dispatch may only consume device-resident operands —
+                    # an implicit host→device transfer (a numpy array or
+                    # python scalar smuggled into the batch) raises here
+                    # instead of silently re-uploading every step.
+                    with self._dispatch_guard():
+                        if K == 1:
+                            self.state, metrics, priorities = self._train_step(
+                                self.state, dev_batch
+                            )
+                        else:
+                            self.state, metrics_k, priorities = self._fused_step(
+                                self.state, dev_batch
+                            )
+                            metrics = jax.tree.map(
+                                lambda x: x.mean(), metrics_k
+                            )
+                if self.sentinel is not None and grad_steps_done == 0:
+                    # First dispatch done: its compiles ARE the budget (one
+                    # program per config). Any later growth is a traced arg
+                    # degrading to a constant or a shape/dtype drift.
+                    name = "train_step" if K == 1 else "fused_step"
+                    self.sentinel.set_budget(name, self.sentinel.count(name))
                 if cfg.prefetch and grad_steps_done + K < total:
                     # Sample batch N+1 and start its device_put NOW, under
                     # step N's device compute. The staged batch sees replay
@@ -1395,6 +1516,8 @@ class Trainer:
 
                 if cfg.async_collect and crossed(cfg.publish_interval):
                     self._publish_params()
+                if self.sentinel is not None and crossed(cfg.eval_interval):
+                    self.sentinel.check(f"eval crossing @ step {self.grad_steps}")
                 if crossed(cfg.eval_interval) or step >= total:
                     last = self._periodic(
                         metrics, t_start, grad_steps_done, env_steps_start
@@ -1448,6 +1571,12 @@ class Trainer:
             if self._last_eval_row:
                 last = self._last_eval_row
         self.ckpt.wait()
+        if self.sentinel is not None:
+            self.sentinel.check("end of train()")
+        # A prefetched-but-never-dispatched final batch (preemption, end of
+        # run) leaves its ledger hold active; release so a later train()
+        # leg never trips on a slot nothing reads anymore.
+        self._release_staging_holds(len(self._staging_holds))
         return last
 
     def _replay_snapshot_path(self) -> str:
@@ -1485,7 +1614,8 @@ class Trainer:
         (or the legacy list-of-K form from the non-block sampler)."""
         idx, pri_dev = pending
         with self._timers.stage("priority_writeback"):
-            pri = np.asarray(pri_dev)
+            pri = np.asarray(pri_dev)  # synchronizes the dispatch's compute
+            self._release_staging_holds(1)
             with self._buffer_lock:
                 if isinstance(idx, list):
                     for k, ix in enumerate(idx):
@@ -1552,9 +1682,13 @@ class Trainer:
         round-trip cost profile as collection."""
         if getattr(self, "_eval_act", None) is None:
             agent_cfg = self.config.agent
-            self._eval_act = self._act_jit(
-                lambda p, o: act_deterministic(agent_cfg, p, o)
-            )
+
+            def eval_act(p, o):
+                return act_deterministic(agent_cfg, p, o)
+
+            # budget 2: the pool path forwards [episodes, obs], the
+            # single-env path [1, obs] — at most two specializations.
+            self._eval_act = self._act_jit(eval_act, budget=2)
         return self._eval_act
 
     def _eval_params(self):
@@ -1847,6 +1981,8 @@ class Trainer:
         self._stop_collector()
         self._stop_eval_thread()
         self._stop_writeback()
+        if self.sentinel is not None:
+            self.sentinel.stop()
         if not self._eval_leaked:
             # A leaked evaluator thread will still call metrics.log() when
             # its eval completes; closing the logger under it would raise

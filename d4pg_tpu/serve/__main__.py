@@ -45,6 +45,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--log-dir", default=None,
                    help="append serve metrics rows (metrics.jsonl) here")
     p.add_argument("--metrics-interval", type=float, default=30.0)
+    p.add_argument("--debug-guards", action="store_true",
+                   help="runtime invariant guards (d4pg_tpu/analysis): "
+                        "staging ledger on the batcher's slot rotation, "
+                        "recompile sentinel (one program per bucket, "
+                        "checked at drain), transfer guard around "
+                        "dispatch; trips raise instead of corrupting")
     return p
 
 
@@ -67,6 +73,7 @@ def main(argv=None) -> None:
         poll_interval_s=args.poll_interval,
         log_dir=args.log_dir,
         metrics_interval_s=args.metrics_interval,
+        debug_guards=args.debug_guards,
     )
 
     install_graceful_signals(

@@ -33,6 +33,7 @@ client already gave up on).
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from collections import deque
@@ -42,6 +43,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from d4pg_tpu.agent.state import D4PGConfig
+from d4pg_tpu.analysis.ledger import NULL_LEDGER
 from d4pg_tpu.serve.stats import ServeStats
 from d4pg_tpu.utils.profiling import StageTimers
 
@@ -85,6 +87,16 @@ class DynamicBatcher:
     when shed.
     """
 
+    # Unguarded cross-thread writes, each safe by argument (d4pglint
+    # shared-mutable-state contract):
+    _THREAD_SAFE = (
+        # single transition None→exception; readers check-then-raise
+        "_thread_error",
+        # device thread is the ONLY writer (single-device-thread design);
+        # the reply thread never touches the rotation
+        "_staging_flip",
+    )
+
     def __init__(
         self,
         config: D4PGConfig,
@@ -101,6 +113,9 @@ class DynamicBatcher:
         obs_norm_eps: float = 1e-2,
         stats: Optional[ServeStats] = None,
         timers: Optional[StageTimers] = None,
+        ledger=None,
+        sentinel=None,
+        guard_transfers: bool = False,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -170,6 +185,22 @@ class DynamicBatcher:
         # The obs batch is DONATED: its device buffer is dead after the
         # forward and XLA may write the actions into it.
         self._infer = jax.jit(infer, donate_argnums=(1,))
+        # Recompile sentinel (--debug-guards): the jit cache must hold
+        # exactly one program per bucket after warmup; a hot reload or a
+        # stray dtype drift that retraces trips check(). The trace-count
+        # side effect above stays as the wire-visible compile_count.
+        self._sentinel = sentinel
+        if sentinel is not None:
+            sentinel.track("serve.infer", self._infer, budget=len(self.buckets))
+        # Transfer guard (--debug-guards): steady-state dispatch must see
+        # only device-resident operands; the staging device_put below is
+        # the one explicit, exempt copy. Resolved once here — the device
+        # loop must not pay import machinery per batch.
+        self._dispatch_guard = contextlib.nullcontext
+        if guard_transfers:
+            from d4pg_tpu.analysis.transfer import no_implicit_transfers
+
+            self._dispatch_guard = no_implicit_transfers
         self._jnp = jnp
         # Params live on device once; set_params swaps this reference
         # atomically (device thread reads it once per batch, so an in-flight
@@ -195,6 +226,15 @@ class DynamicBatcher:
         }
         self._staging_flip = {b: 0 for b in self.buckets}
         self._inflight = threading.Semaphore(2)
+        # Staging ledger (--debug-guards): generation-tags the 2-slot
+        # rotation above; a write into a slot whose dispatch the reply
+        # thread hasn't fetched yet raises at the overwrite site. Group
+        # names precomputed — no per-batch f-string on the device loop.
+        self._ledger = ledger if ledger is not None else NULL_LEDGER
+        self._staging_group = {b: f"serve.staging[{b}]" for b in self.buckets}
+        # Test hook (staging-ledger stress test): pin the rotation to one
+        # slot to seed the PR-2/PR-3 early-reuse bug class deliberately.
+        self._test_force_flip: Optional[int] = None
 
         self._queue: deque[_Request] = deque()
         self._cond = threading.Condition()
@@ -218,8 +258,9 @@ class DynamicBatcher:
             raise RuntimeError("batcher device thread already running")
         if warmup:
             self.warmup()
-        self._draining = False
-        self._stopped = False
+        with self._cond:  # same guard as every other _draining/_stopped write
+            self._draining = False
+            self._stopped = False
         self._thread = threading.Thread(
             target=self._device_loop, name="serve-batcher", daemon=True
         )
@@ -446,20 +487,36 @@ class DynamicBatcher:
                         ) from self._thread_error
                 with self.timers.stage("assemble"):
                     flip = self._staging_flip[bucket]
+                    if self._test_force_flip is not None:
+                        flip = self._test_force_flip
                     self._staging_flip[bucket] = 1 - flip
+                    self._ledger.write(self._staging_group[bucket], flip)
                     staging = self._staging[bucket][flip]
                     for i, req in enumerate(live):
                         staging[i] = req.obs
                 with self.timers.stage("device_infer"):
                     # device_put copies the staging slot to a fresh device
-                    # buffer (which infer then donates). The dispatch is
-                    # async — the reply thread pays the D2H fetch, so this
-                    # thread moves straight on to the next batch.
-                    dev_actions = self._infer(
-                        self._params, self._device_put(staging)
-                    )
+                    # buffer (which infer then donates) — the one explicit,
+                    # guard-exempt transfer. The dispatch is async — the
+                    # reply thread pays the D2H fetch, so this thread moves
+                    # straight on to the next batch.
+                    dev_obs = self._device_put(staging)
+                    with self._dispatch_guard():
+                        dev_actions = self._infer(self._params, dev_obs)
+                # The hold pins the staging slot until the reply thread's
+                # D2H fetch proves the dispatch (and its H2D) finished.
+                # holder formatted only for a real ledger — guards-off
+                # batches must not pay a per-batch f-string.
+                hold = self._ledger.hold(
+                    self._staging_group[bucket], flip,
+                    holder=(
+                        f"dispatch(n={n})"
+                        if self._ledger is not NULL_LEDGER
+                        else None
+                    ),
+                )
                 with self._reply_cond:
-                    self._reply_q.append((live, dev_actions))
+                    self._reply_q.append((live, dev_actions, hold))
                     self._reply_cond.notify()
                 live = []  # resolved (or failed) by the reply thread now
                 self.stats.observe_batch(n, bucket)
@@ -494,18 +551,21 @@ class DynamicBatcher:
                     item = self._reply_q.popleft()
                 if item is None:
                     return
-                live, dev_actions = item
+                live, dev_actions, hold = item
                 with self.timers.stage("reply"):
                     # D2H fetch synchronizes on this batch's compute (and
                     # transitively its H2D) — its staging slot is free the
-                    # moment this returns, so the permit is released here.
+                    # moment this returns, so the permit (and the ledger
+                    # hold) is released here.
                     actions = np.asarray(dev_actions)
+                    hold.release()
                     self._inflight.release()
                     t_done = time.perf_counter()
                     for i, req in enumerate(live):
                         # per-row copy: the futures outlive this loop and
-                        # must not alias one shared buffer
-                        req.future.set_result(actions[i].copy())
+                        # must not alias one shared buffer — aliasing IS
+                        # the bug class the ledger polices
+                        req.future.set_result(actions[i].copy())  # d4pglint: disable=hot-path-alloc
                         self.stats.latency.add(t_done - req.t_submit)
                     self.stats.inc("replies_ok", len(live))
         except BaseException as e:

@@ -39,6 +39,11 @@ class ConnectionClosed(RuntimeError):
 
 
 class PolicyClient:
+    # d4pglint shared-mutable-state: single transition None→exception by
+    # the reader thread; submitters read it check-then-fail (the
+    # mark-dead-then-sweep ordering note in _read_loop)
+    _THREAD_SAFE = ("_dead",)
+
     def __init__(self, host: str, port: int, timeout: float = 30.0):
         self._sock = socket.create_connection((host, port), timeout=timeout)
         # ``timeout`` governs CONNECT and the default future wait in act();

@@ -68,6 +68,12 @@ def load_best_actor_params(run_dir: str, config):
 
 
 class PolicyServer:
+    # d4pglint shared-mutable-state: the reload watcher thread is the ONLY
+    # writer of all three after start() (check_reload is watcher-only);
+    # readers (healthz, conn threads) take atomic reference snapshots and
+    # tolerate being one reload stale.
+    _THREAD_SAFE = ("bundle", "_bundle_mtime", "_best_mtime")
+
     def __init__(
         self,
         bundle: PolicyBundle,
@@ -83,6 +89,7 @@ class PolicyServer:
         poll_interval_s: float = 2.0,
         log_dir: Optional[str] = None,
         metrics_interval_s: float = 30.0,
+        debug_guards: bool = False,
     ):
         self.bundle = bundle
         self.host = host
@@ -91,6 +98,16 @@ class PolicyServer:
         self.default_deadline_s = (
             default_deadline_ms / 1e3 if default_deadline_ms else None
         )
+        # --debug-guards: staging ledger on the batcher's slot rotation,
+        # recompile sentinel on the per-bucket jit cache (budget = bucket
+        # count, asserted at drain), transfer guard around dispatch.
+        self.ledger = None
+        self.sentinel = None
+        if debug_guards:
+            from d4pg_tpu.analysis import RecompileSentinel, StagingLedger
+
+            self.ledger = StagingLedger("serve")
+            self.sentinel = RecompileSentinel().start()
         self.batcher = DynamicBatcher(
             bundle.config,
             bundle.actor_params,
@@ -100,6 +117,9 @@ class PolicyServer:
             action_low=bundle.action_low,
             action_high=bundle.action_high,
             obs_norm_stats=bundle.obs_norm,
+            ledger=self.ledger,
+            sentinel=self.sentinel,
+            guard_transfers=debug_guards,
         )
         self.stats = self.batcher.stats
         self._watch_run = watch_run
@@ -205,6 +225,13 @@ class PolicyServer:
                 c.close()
             except OSError:
                 pass
+        if self.sentinel is not None:
+            # Budget: one compiled program per bucket for the whole run —
+            # hot reloads and traffic shape must never have retraced. Last
+            # on purpose: a budget trip must fail the drain loudly WITHOUT
+            # leaking the shutdown path above (metrics flush, client
+            # socket closes, thread joins).
+            self.sentinel.check("serve drain")
 
     # ------------------------------------------------------------- hot reload
     def _stat_best(self) -> Optional[float]:

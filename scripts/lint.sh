@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Repo lint gate: d4pglint (repo-specific AST invariants, zero findings
+# required) + the benchmark/metrics JSON schema check. Wired into tier-1
+# both directly (scripts/tier1.sh runs this first) and as a test
+# (tests/test_d4pglint.py::test_repo_lints_clean), so the driver's
+# verbatim ROADMAP pytest command enforces it too.
+#
+# Usage: scripts/lint.sh            # lint the product-code manifest
+#        scripts/lint.sh --show-suppressed   # audit the justifications
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m tools.d4pglint "$@"
+python -m tools.d4pglint.schema_check
+echo "LINT_OK"

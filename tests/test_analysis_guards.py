@@ -1,0 +1,482 @@
+"""Runtime invariant guards (d4pg_tpu/analysis): each guard must (a) stay
+silent on the clean path and (b) catch a deliberately injected violation
+with an attributable error — the clean half alone would prove nothing.
+
+Covers the ISSUE-4 acceptance matrix: recompile sentinel (training
+regression, prefetch on AND off, plus an injected shape-drift trip),
+transfer guard (clean trainer/batcher dispatch, plus an injected
+implicit-transfer trip), staging ledger (unit semantics, replay
+sample_block rotation stress, serve batcher slow-device stress with the
+PR-3 "unbounded in-flight" bug seeded behind a test hook), and the
+--debug-guards integration smoke (all guards on, zero trips).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from d4pg_tpu.analysis import (
+    RecompileBudgetError,
+    RecompileSentinel,
+    StagingLedger,
+    StagingReuseError,
+    no_implicit_transfers,
+)
+from d4pg_tpu.analysis.ledger import NULL_LEDGER
+
+
+# ----------------------------------------------------------------- ledger unit
+def test_ledger_write_hold_release_cycle():
+    led = StagingLedger("t")
+    assert led.write("g", 0) == 1
+    h = led.hold("g", 0, holder="dispatch#1")
+    h.release()
+    assert led.write("g", 0) == 2  # released hold: rewrite fine
+    assert led.stats()["trips"] == 0
+
+
+def test_ledger_trips_on_write_while_held_naming_slot_and_holder():
+    led = StagingLedger("replay")
+    led.write("per.sample_block[n=64]", 1, writer="sampler")
+    led.hold("per.sample_block[n=64]", 1, holder="dispatch#7")
+    with pytest.raises(StagingReuseError) as ei:
+        led.write("per.sample_block[n=64]", 1, writer="sampler")
+    msg = str(ei.value)
+    assert "per.sample_block[n=64]" in msg and "[1]" in msg  # the slot
+    assert "dispatch#7" in msg                               # the holder
+    assert "sampler" in msg                                  # the writer
+    assert led.stats()["trips"] == 1
+
+
+def test_ledger_release_is_idempotent_and_null_ledger_is_free():
+    led = StagingLedger("t")
+    led.write("g", 0)
+    h = led.hold("g", 0)
+    h.release()
+    h.release()
+    led.write("g", 0)
+    # null ledger: everything is a no-op, never raises
+    NULL_LEDGER.write("g", 0)
+    NULL_LEDGER.hold("g", 0).release()
+    assert NULL_LEDGER.stats()["trips"] == 0
+
+
+# ------------------------------------------------------- ledger: replay staging
+def _tiny_per_buffer(ledger=None, slots=None):
+    from d4pg_tpu.replay import PrioritizedReplayBuffer, Transition
+
+    buf = PrioritizedReplayBuffer(256, 3, 1, tree_backend="numpy")
+    if slots is not None:
+        buf.STAGING_SLOTS = slots  # instance override: the test hook
+    if ledger is not None:
+        buf.set_ledger(ledger)
+    n = 64
+    rng = np.random.default_rng(0)
+    buf.add_batch(
+        Transition(
+            obs=rng.standard_normal((n, 3)).astype(np.float32),
+            action=rng.standard_normal((n, 1)).astype(np.float32),
+            reward=np.zeros(n, np.float32),
+            next_obs=rng.standard_normal((n, 3)).astype(np.float32),
+            discount=np.ones(n, np.float32),
+        )
+    )
+    return buf
+
+
+def test_sample_block_ledger_clean_with_prompt_releases():
+    led = StagingLedger("replay")
+    buf = _tiny_per_buffer(ledger=led)
+    rng = np.random.default_rng(1)
+    holds = []
+    for _ in range(10):  # well past the 3-slot rotation
+        out = buf.sample_block(8, 2, rng)
+        holds.append(out.pop("_staging_hold"))
+        while len(holds) > 2:  # trainer contract: ≤2 dispatches in flight
+            holds.pop(0).release()
+    assert led.stats()["trips"] == 0
+    assert led.stats()["writes"] == 10
+
+
+def test_sample_block_ledger_catches_late_consumer_past_rotation():
+    """Seeded bug: a consumer that holds staged batches longer than the
+    rotation depth (the PR-2 class: async dispatch outliving the slots)."""
+    led = StagingLedger("replay")
+    buf = _tiny_per_buffer(ledger=led)
+    rng = np.random.default_rng(1)
+    holds = [buf.sample_block(8, 2, rng).pop("_staging_hold")
+             for _ in range(buf.STAGING_SLOTS)]  # all 3 slots held
+    with pytest.raises(StagingReuseError) as ei:
+        buf.sample_block(8, 2, rng)  # wraps onto slot 0, still held
+    assert "per.sample_block[n=16]" in str(ei.value)
+    assert holds[0].released is False
+
+
+def test_sample_block_ledger_catches_shrunken_rotation():
+    """Seeded bug via the test hook: STAGING_SLOTS=1 (no rotation at all)
+    with a normally-paced consumer trips on the second sample."""
+    led = StagingLedger("replay")
+    buf = _tiny_per_buffer(ledger=led, slots=1)
+    rng = np.random.default_rng(1)
+    out = buf.sample_block(8, 1, rng)
+    _hold = out.pop("_staging_hold")  # dispatch in flight, never released
+    with pytest.raises(StagingReuseError):
+        buf.sample_block(8, 1, rng)
+
+
+def test_sample_block_without_ledger_has_no_hold_key():
+    buf = _tiny_per_buffer()
+    out = buf.sample_block(8, 2, np.random.default_rng(1))
+    assert "_staging_hold" not in out  # guards-off behavior is unchanged
+
+
+# ------------------------------------------------------------------- sentinel
+def test_sentinel_counts_and_budget_trip_on_shape_drift():
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x * 2)
+    with RecompileSentinel() as sen:
+        sen.track("f", f)
+        before = sen.total_compiles
+        f(jnp.ones(3))
+        assert sen.count("f") == 1
+        assert sen.total_compiles > before  # global stream sees it too
+        sen.freeze()  # budget: what warmup compiled
+        f(jnp.ones(3))
+        sen.check("steady")  # cache hit: fine
+        f(jnp.ones(4))  # injected violation: a shape drifted
+        with pytest.raises(RecompileBudgetError) as ei:
+            sen.check("steady")
+    assert "f: 2 compiles > budget 1" in str(ei.value)
+
+
+def test_sentinel_explicit_budget_and_unbudgeted_entries():
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x + 1)
+    g = jax.jit(lambda x: x - 1)
+    sen = RecompileSentinel()
+    sen.track("f", f, budget=2)
+    sen.track("g", g)  # unbudgeted: never checked until frozen
+    f(jnp.ones(2))
+    f(jnp.ones(3))
+    g(jnp.ones(2))
+    g(jnp.ones(3))
+    sen.check()  # f within its explicit budget, g unbudgeted
+    sen.set_budget("f", 1)
+    with pytest.raises(RecompileBudgetError):
+        sen.check()
+
+
+# -------------------------------------------------------------- transfer guard
+def test_transfer_guard_catches_implicit_transfer_and_exempts_device_put():
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x * 2)
+    host = np.ones(3, np.float32)
+    dev = jax.device_put(host)
+    f(dev)  # warmup outside the guard
+    with no_implicit_transfers():
+        f(dev)  # device operand: clean
+        jax.device_put(host)  # explicit transfer: exempt by design
+        with pytest.raises(Exception, match="[Dd]isallowed host-to-device"):
+            f(host)  # injected violation: implicit numpy upload
+    f(host)  # outside the guard: allowed again (context is scoped)
+
+
+def test_transfer_guard_disabled_is_a_noop():
+    import jax
+
+    f = jax.jit(lambda x: x * 2)
+    with no_implicit_transfers(enabled=False):
+        np.asarray(f(np.ones(3, np.float32)))  # implicit transfer fine
+
+
+# -------------------------------------------- batcher: slow-device stress test
+class _GatedArray:
+    """Device-output stub whose D2H fetch (np.asarray) blocks on an event:
+    makes 'the reply thread is slower than the device thread' a
+    deterministic fact instead of a race."""
+
+    def __init__(self, value: np.ndarray, gate: threading.Event):
+        self._value = value
+        self._gate = gate
+
+    def __array__(self, dtype=None, copy=None):
+        self._gate.wait(10.0)
+        return self._value if dtype is None else self._value.astype(dtype)
+
+
+def _tiny_batcher(**kw):
+    from d4pg_tpu.agent.state import D4PGConfig
+    from d4pg_tpu.serve.batcher import DynamicBatcher
+    from d4pg_tpu.serve.bundle import actor_template
+
+    cfg = D4PGConfig(obs_dim=3, action_dim=1, hidden_sizes=(8, 8))
+    return DynamicBatcher(
+        cfg, actor_template(cfg), max_batch=2, max_wait_us=0, **kw
+    )
+
+
+def test_batcher_ledger_clean_under_slow_device_past_rotation():
+    """Slow device, real backpressure (the 2-permit semaphore): many
+    batches rotate through the 2 slots with zero ledger trips."""
+    led = StagingLedger("serve")
+    b = _tiny_batcher(ledger=led)
+    orig = b._infer
+    b._infer = lambda p, o: (time.sleep(0.005), orig(p, o))[1]  # slow stub
+    b.start()
+    try:
+        futs = [b.submit(np.zeros(3, np.float32)) for _ in range(12)]
+        for f in futs:
+            f.result(timeout=30)
+    finally:
+        b.stop()
+    assert led.stats()["trips"] == 0
+    assert led.stats()["writes"] >= 6  # well past the 2-slot rotation
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_batcher_ledger_catches_seeded_inflight_bug():
+    """Seeded bug behind a test hook: remove the in-flight bound (the
+    PR-3 round-2 regression — staging rotation is only safe if the host
+    can't run ahead) and gate the reply thread's D2H. The third dispatch
+    wraps onto slot 0 while its hold is live → the ledger must name the
+    slot and the holding dispatch."""
+    led = StagingLedger("serve")
+    b = _tiny_batcher(ledger=led)
+    gate = threading.Event()
+    b._inflight = threading.Semaphore(1000)  # the deliberate bug
+    b._infer = lambda p, o: _GatedArray(
+        np.zeros((np.asarray(o).shape[0], 1), np.float32), gate
+    )
+    b.start(warmup=False)
+    try:
+        futs = []
+        for _ in range(6):  # one-request batches → ≥3 dispatches → reuse
+            try:
+                futs.append(b.submit(np.zeros(3, np.float32)))
+            except RuntimeError:
+                break  # device thread already died on the trip — enough
+            time.sleep(0.05)  # let the device thread dispatch one-by-one
+        gate.set()  # trip already happened; unblock the gated D2H fetches
+        excs = []
+        for f in futs:
+            try:
+                f.result(timeout=10)
+            except Exception as e:  # noqa: BLE001 - collecting the trip
+                excs.append(e)
+        trips = [e for e in excs if isinstance(e, StagingReuseError)]
+        assert trips, f"ledger never tripped; got {excs!r}"
+        msg = str(trips[0])
+        assert "serve.staging[" in msg        # the slot (bucket + index)
+        assert "dispatch(n=" in msg           # the holder
+        assert led.stats()["trips"] >= 1
+    finally:
+        gate.set()
+        try:
+            b.stop(timeout=5)
+        except RuntimeError:
+            pass  # device thread died on the trip — expected
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_batcher_force_flip_hook_defeats_rotation():
+    """The simpler seeded bug: _test_force_flip pins the rotation to one
+    slot; with the reply thread gated, the very next dispatch trips."""
+    led = StagingLedger("serve")
+    b = _tiny_batcher(ledger=led)
+    gate = threading.Event()
+    b._test_force_flip = 0  # the test hook: single-buffer the staging
+    b._infer = lambda p, o: _GatedArray(
+        np.zeros((np.asarray(o).shape[0], 1), np.float32), gate
+    )
+    b.start(warmup=False)
+    try:
+        futs = [b.submit(np.zeros(3, np.float32)) for _ in range(4)]
+        time.sleep(0.2)  # both slots written → the pinned flip has tripped
+        gate.set()
+        excs = []
+        for f in futs:
+            try:
+                f.result(timeout=10)
+            except Exception as e:  # noqa: BLE001
+                excs.append(e)
+        assert any(isinstance(e, StagingReuseError) for e in excs)
+    finally:
+        gate.set()
+        try:
+            b.stop(timeout=5)
+        except RuntimeError:
+            pass  # dead device thread, as engineered
+
+
+# ------------------------------------------- training regression + integration
+def _guarded_config(tmp_path, tag, **kw):
+    from d4pg_tpu.agent.state import D4PGConfig
+    from d4pg_tpu.config import TrainConfig
+
+    base = dict(
+        env="pendulum",
+        total_steps=4,
+        warmup_steps=32,
+        batch_size=16,
+        num_envs=2,
+        eval_interval=1000,
+        checkpoint_interval=1000,
+        debug_guards=True,
+        log_dir=str(tmp_path / tag),
+        agent=D4PGConfig(hidden_sizes=(16, 16)),
+    )
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+@pytest.mark.parametrize("prefetch", [False, True])
+def test_recompile_budget_flat_after_warmup(tmp_path, prefetch):
+    """Satellite: short CPU run, prefetch on and off — train_step/act
+    compile counts must not grow after the first dispatch, asserted by
+    the sentinel (not the old ad-hoc serve-test stub). A second train()
+    leg re-drives the whole loop against the frozen budget."""
+    from d4pg_tpu.runtime.trainer import Trainer
+
+    t = Trainer(_guarded_config(tmp_path, f"rc_{prefetch}", prefetch=prefetch))
+    try:
+        t.train()
+        counts = t.sentinel.counts()
+        assert counts["train_step"] == 1, counts
+        t.train(total_steps=4)  # second leg: budgets already pinned
+        after = t.sentinel.counts()
+        assert after == counts, f"compile counts moved: {counts} -> {after}"
+        t.sentinel.check("end of regression test")
+        assert t._ledger.stats()["trips"] == 0
+    finally:
+        t.close()
+
+
+def test_debug_guards_integration_smoke(tmp_path):
+    """Acceptance: --debug-guards runs the integration smoke with zero
+    guard trips — transfer guard wraps every dispatch, the ledger tags
+    replay staging under prefetch, and the sentinel budget holds."""
+    from d4pg_tpu.runtime.trainer import Trainer
+
+    cfg = _guarded_config(
+        tmp_path, "smoke", prefetch=True, total_steps=6, eval_interval=3
+    )
+    t = Trainer(cfg)
+    try:
+        out = t.train()
+        assert "eval_return_mean" in out
+        assert t.sentinel.counts()["train_step"] == 1
+        stats = t._ledger.stats()
+        assert stats["trips"] == 0 and stats["writes"] >= 6
+        assert not t._staging_holds  # all released at train() end
+    finally:
+        t.close()
+
+
+def test_guards_no_false_trip_with_lagging_async_flusher(tmp_path, monkeypatch):
+    """The async priority flusher paces hold releases; a lagging flusher
+    must make the guarded learner WAIT, not false-trip the ledger. The
+    flusher is artificially slowed so the learner would rotate staging
+    past held slots without the pacing loop in _sample_staged."""
+    from d4pg_tpu.runtime.trainer import Trainer
+
+    orig_start = Trainer._start_writeback
+
+    def slow_start(self):
+        orig_start(self)
+        real_get = self._wb_queue.get
+
+        def slow_get(*a, **kw):
+            item = real_get(*a, **kw)
+            time.sleep(0.05)  # the lag: learner outruns the release point
+            return item
+
+        self._wb_queue.get = slow_get
+
+    monkeypatch.setattr(Trainer, "_start_writeback", slow_start)
+    cfg = _guarded_config(
+        tmp_path, "lagwb", prefetch=True, total_steps=10,
+        async_priority_writeback=True,
+    )
+    t = Trainer(cfg)
+    try:
+        t.train()  # without the pacing wait this raises StagingReuseError
+        assert t._ledger.stats()["trips"] == 0
+        assert not t._staging_holds
+    finally:
+        t.close()
+
+
+def test_train_cli_wires_debug_guards_flag():
+    from train import build_parser, config_from_args
+
+    args = build_parser().parse_args(
+        ["--env", "pendulum", "--debug-guards"]
+    )
+    assert config_from_args(args).debug_guards is True
+    args = build_parser().parse_args(["--env", "pendulum"])
+    assert config_from_args(args).debug_guards is False
+
+
+def test_policy_server_debug_guards_end_to_end():
+    """--debug-guards through the real server: ledger + sentinel + transfer
+    guard active, traffic served, drain runs the bucket-budget check."""
+    from d4pg_tpu.agent.state import D4PGConfig
+    from d4pg_tpu.serve.bundle import PolicyBundle, actor_template
+    from d4pg_tpu.serve.client import PolicyClient
+    from d4pg_tpu.serve.server import PolicyServer
+
+    cfg = D4PGConfig(obs_dim=4, action_dim=2, hidden_sizes=(8, 8))
+    bundle = PolicyBundle(
+        config=cfg,
+        actor_params=actor_template(cfg),
+        action_low=np.full(2, -1.0, np.float32),
+        action_high=np.full(2, 1.0, np.float32),
+        obs_norm=None,
+        meta={"source": "test"},
+        path=None,
+    )
+    srv = PolicyServer(
+        bundle, port=0, max_batch=4, max_wait_us=500, queue_limit=16,
+        watch_bundle=False, debug_guards=True,
+    )
+    srv.start()
+    try:
+        with PolicyClient("127.0.0.1", srv.port) as c:
+            for i in range(5):
+                a = c.act(np.full(4, 0.1 * i, np.float32))
+                assert a.shape == (2,)
+    finally:
+        srv.drain()  # runs sentinel.check("serve drain")
+    assert srv.ledger.stats()["trips"] == 0
+    assert srv.sentinel.count("serve.infer") == len(srv.batcher.buckets)
+
+
+def test_transfer_guard_clean_on_serve_dispatch():
+    """Satellite: serve batcher dispatch runs clean under the transfer
+    guard (guard_transfers=True wraps the jitted infer call)."""
+    sen = RecompileSentinel().start()
+    b = _tiny_batcher(sentinel=sen, guard_transfers=True)
+    b.start()
+    try:
+        futs = [b.submit(np.zeros(3, np.float32)) for _ in range(8)]
+        for f in futs:
+            assert f.result(timeout=30).shape == (1,)
+    finally:
+        b.stop()
+        sen.stop()
+    sen.check("after serve traffic")
+    assert sen.count("serve.infer") == len(b.buckets)

@@ -1,0 +1,329 @@
+"""d4pglint self-tests: per check, a bad fixture that MUST fire, a good
+fixture that must NOT, and proof the ``# d4pglint: disable=`` suppression
+silences exactly that finding. Plus: the repo itself lints clean (the
+tier-1 contract scripts/lint.sh enforces), and the benchmark/metrics
+schema checker's own good/bad fixtures.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tools.d4pglint import ALL_CHECKS, lint_paths, lint_source
+from tools.d4pglint.schema_check import (
+    check_benchmark_json,
+    check_metrics_jsonl,
+)
+
+# (check_id, relpath, bad_src, good_src) — relpath matters: several checks
+# key on the manifests in tools/d4pglint/config.py.
+FIXTURES = [
+    (
+        "host-jax-import",
+        "d4pg_tpu/runtime/actor_pool.py",
+        """
+        import numpy as np
+        import jax
+        """,
+        """
+        import numpy as np
+
+        def act():
+            import jax  # lazy: only the paths that need it pay it
+            return jax
+        """,
+    ),
+    (
+        "lock-blocking-call",
+        "d4pg_tpu/runtime/x.py",
+        """
+        import time
+
+        def flush(self):
+            with self._lock:
+                time.sleep(0.1)
+        """,
+        """
+        import time
+
+        def flush(self):
+            with self._lock:
+                n = self._n
+            time.sleep(0.1)
+
+        def wait_pattern(self):
+            with self._cond:
+                self._cond.wait(1.0)  # cv pattern: waiting the held lock
+
+        def join_strings(self):
+            with self._lock:
+                return ", ".join(self.parts)  # str.join is not a thread join
+        """,
+    ),
+    (
+        "shared-mutable-state",
+        "d4pg_tpu/runtime/x.py",
+        """
+        import threading
+
+        class Pump:
+            def start(self):
+                threading.Thread(target=self._loop, name="p", daemon=True).start()
+
+            def _loop(self):
+                self.count = 1
+        """,
+        """
+        import threading
+
+        class Pump:
+            _THREAD_SAFE = ("count",)  # single-writer, readers tolerate staleness
+
+            def start(self):
+                threading.Thread(target=self._loop, name="p", daemon=True).start()
+
+            def _loop(self):
+                self.count = 1
+                with self._lock:
+                    self.guarded = 2
+        """,
+    ),
+    (
+        "wall-clock-deadline",
+        "d4pg_tpu/runtime/x.py",
+        """
+        import time
+
+        def deadline():
+            return time.time() + 5.0
+        """,
+        """
+        import time
+
+        def deadline():
+            return time.monotonic() + 5.0
+        """,
+    ),
+    (
+        "broad-except",
+        "d4pg_tpu/runtime/x.py",
+        """
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+        """,
+        """
+        def f():
+            try:
+                g()
+            except ValueError:
+                pass
+            try:
+                g()
+            except Exception as e:
+                print(f"context: {e}")
+            try:
+                g()
+            except BaseException:
+                raise
+        """,
+    ),
+    (
+        "jit-purity",
+        "d4pg_tpu/agent/x.py",
+        """
+        import jax
+        import numpy as np
+
+        def step(x):
+            return np.asarray(x) + 1
+
+        jit_step = jax.jit(step)
+        """,
+        """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        def step(x):
+            return jnp.asarray(x) + 1
+
+        jit_step = jax.jit(step)
+
+        def host_helper(x):
+            return np.asarray(x)  # not jit-traced: fine
+        """,
+    ),
+    (
+        "hot-path-alloc",
+        "d4pg_tpu/replay/per.py",
+        """
+        import numpy as np
+
+        class PrioritizedReplayBuffer:
+            def sample_block(self, b, k):
+                return np.stack([self.rows[i] for i in range(k)])
+        """,
+        """
+        import numpy as np
+
+        class PrioritizedReplayBuffer:
+            def sample_block(self, b, k):
+                def mk():  # nested lazy init closure: exempt
+                    return np.zeros((b, k))
+
+                st = self._staging or mk()
+                st[:] = 0
+                return st
+        """,
+    ),
+    (
+        "thread-discipline",
+        "d4pg_tpu/runtime/x.py",
+        """
+        import threading
+
+        def start(fn):
+            threading.Thread(target=fn).start()
+        """,
+        """
+        import threading
+
+        def start(fn):
+            threading.Thread(target=fn, name="worker", daemon=True).start()
+        """,
+    ),
+    (
+        "global-rng",
+        "d4pg_tpu/replay/x.py",
+        """
+        import numpy as np
+
+        def draw(n):
+            return np.random.uniform(size=n)
+        """,
+        """
+        import numpy as np
+
+        def draw(n, rng=None):
+            rng = rng or np.random.default_rng(0)
+            return rng.uniform(size=n)
+        """,
+    ),
+]
+
+assert {f[0] for f in FIXTURES} == set(ALL_CHECKS), "fixture per check"
+
+
+def _lint(src: str, relpath: str, check: str):
+    return lint_source(textwrap.dedent(src), relpath, checks=[check])
+
+
+@pytest.mark.parametrize(
+    "check,relpath,bad,good", FIXTURES, ids=[f[0] for f in FIXTURES]
+)
+def test_bad_fixture_fires_good_fixture_clean(check, relpath, bad, good):
+    findings, _ = _lint(bad, relpath, check)
+    assert findings, f"{check}: bad fixture produced no finding"
+    assert all(f.check == check for f in findings)
+    findings, _ = _lint(good, relpath, check)
+    assert findings == [], f"{check}: good fixture fired: {findings}"
+
+
+@pytest.mark.parametrize(
+    "check,relpath,bad,good", FIXTURES, ids=[f[0] for f in FIXTURES]
+)
+def test_suppression_silences_exactly_the_finding(check, relpath, bad, good):
+    findings, _ = _lint(bad, relpath, check)
+    lines = textwrap.dedent(bad).splitlines()
+    for f in findings:
+        lines[f.line - 1] += f"  # d4pglint: disable={check}  -- test fixture"
+    suppressed_src = "\n".join(lines)
+    findings2, suppressed = lint_source(
+        suppressed_src, relpath, checks=[check]
+    )
+    assert findings2 == []
+    assert len(suppressed) == len(findings)  # audited, not vanished
+    # an unrelated id must NOT suppress it
+    other = next(c for c in ALL_CHECKS if c != check)
+    lines = textwrap.dedent(bad).splitlines()
+    for f in findings:
+        lines[f.line - 1] += f"  # d4pglint: disable={other}"
+    findings3, _ = lint_source("\n".join(lines), relpath, checks=[check])
+    assert len(findings3) == len(findings)
+
+
+def test_repo_lints_clean():
+    """The tier-1 contract: zero findings over the product-code manifest
+    (suppressions are allowed — they carry justifications)."""
+    findings, _suppressed = lint_paths()
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_cli_exit_codes(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\n\ndef f():\n    return time.time()\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.d4pglint", str(bad)],
+        capture_output=True, text=True, cwd="/root/repo",
+    )
+    assert proc.returncode == 1
+    assert "wall-clock-deadline" in proc.stdout
+    ok = tmp_path / "ok.py"
+    ok.write_text("import time\n\ndef f():\n    return time.monotonic()\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.d4pglint", str(ok)],
+        capture_output=True, text=True, cwd="/root/repo",
+    )
+    assert proc.returncode == 0, proc.stdout
+
+
+def test_lint_counts_at_least_eight_checks():
+    assert len(ALL_CHECKS) >= 8  # ISSUE-4 acceptance floor
+
+
+# ------------------------------------------------------------- schema checks
+def test_benchmark_schema_good_and_bad(tmp_path):
+    good_obj = tmp_path / "a.json"
+    good_obj.write_text(json.dumps({"backend": "cpu", "x": 1.0}))
+    assert check_benchmark_json(str(good_obj)) == []
+    good_list = tmp_path / "b.json"
+    good_list.write_text(json.dumps([{"bench": "mfu_sweep", "x": 1}]))
+    assert check_benchmark_json(str(good_list)) == []
+    for bad_doc in ["{", json.dumps({"x": 1}), json.dumps([{"x": 1}]),
+                    json.dumps(3), json.dumps({})]:
+        p = tmp_path / "bad.json"
+        p.write_text(bad_doc)
+        assert check_benchmark_json(str(p)), f"accepted: {bad_doc!r}"
+
+
+def test_metrics_jsonl_schema_good_and_bad(tmp_path):
+    good = tmp_path / "metrics.jsonl"
+    good.write_text(
+        json.dumps({"step": 1, "t": 0.5, "loss": 1.25}) + "\n"
+        + json.dumps({"step": 2, "t": 1.0, "loss": 1.0}) + "\n"
+    )
+    assert check_metrics_jsonl(str(good)) == []
+    for bad_row in [
+        "not json",
+        json.dumps({"t": 0.5}),                       # no step
+        json.dumps({"step": "three", "t": 0.5}),      # non-int step
+        json.dumps({"step": 1}),                      # no t
+        json.dumps({"step": 1, "t": 0.1, "env": "pendulum"}),  # non-numeric
+    ]:
+        p = tmp_path / "bad.jsonl"
+        p.write_text(bad_row + "\n")
+        assert check_metrics_jsonl(str(p)), f"accepted: {bad_row!r}"
+
+
+def test_schema_check_passes_on_committed_artifacts():
+    from tools.d4pglint.schema_check import check_tree
+
+    assert check_tree("/root/repo") == []

@@ -1,0 +1,23 @@
+"""d4pglint: repo-specific AST lint for the D4PG data-plane invariants.
+
+Not a general-purpose linter — every check codifies one invariant this
+codebase's decoupled acting/learning + single-device-thread-serving
+design depends on (and that a past PR has violated at least once):
+host-only modules stay JAX-free, no blocking calls under locks,
+cross-thread state is lock-guarded or declared, deadlines use the
+monotonic clock, exceptions never swallow device errors silently, jit
+-traced code stays numpy/float64-free, hot-path functions never
+allocate per step, threads are named daemons, and RNG is always an
+explicit seeded Generator.
+
+Usage::
+
+    python -m tools.d4pglint [paths...]      # default: the repo manifest
+    # suppress one finding, with a justification on the same line:
+    ...  # d4pglint: disable=<check-id>  -- why this one is fine
+
+Catalog (ids, rationale, examples, how to add a check): docs/analysis.md.
+"""
+
+from tools.d4pglint.core import Finding, lint_paths, lint_source  # noqa: F401
+from tools.d4pglint.config import ALL_CHECKS, DEFAULT_PATHS  # noqa: F401

@@ -1,0 +1,50 @@
+"""CLI: ``python -m tools.d4pglint [paths...]`` — exit 1 on any finding.
+
+``--list-checks`` prints the catalog ids; ``--show-suppressed`` also
+prints findings that a ``# d4pglint: disable=`` comment silenced (audit
+mode for reviewing justifications).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tools.d4pglint.config import ALL_CHECKS, DEFAULT_PATHS
+from tools.d4pglint.core import lint_paths
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="python -m tools.d4pglint")
+    p.add_argument("paths", nargs="*",
+                   help=f"files/dirs to lint (default: {' '.join(DEFAULT_PATHS)})")
+    p.add_argument("--check", action="append", dest="checks", metavar="ID",
+                   help="run only these check ids (repeatable)")
+    p.add_argument("--list-checks", action="store_true")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="also print findings silenced by disable= comments")
+    args = p.parse_args(argv)
+    if args.list_checks:
+        for c in ALL_CHECKS:
+            print(c)
+        return 0
+    if args.checks:
+        unknown = [c for c in args.checks if c not in ALL_CHECKS]
+        if unknown:
+            p.error(f"unknown check ids: {', '.join(unknown)}")
+    findings, suppressed = lint_paths(args.paths or None, checks=args.checks)
+    for f in findings:
+        print(f)
+    if args.show_suppressed:
+        for f in suppressed:
+            print(f"(suppressed) {f}")
+    n = len(findings)
+    print(
+        f"d4pglint: {n} finding{'s' if n != 1 else ''}, "
+        f"{len(suppressed)} suppressed"
+    )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

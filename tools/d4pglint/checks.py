@@ -1,0 +1,602 @@
+"""The d4pglint checks. Each is ``fn(tree, src_lines, relpath) -> [Finding]``.
+
+All checks are pure AST analysis — no imports of the linted code, so the
+linter runs on any file regardless of the container's runtime deps, and
+linting can never execute repo code.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.d4pglint.config import (
+    ALLOC_CALLS,
+    BLOCKING_METHOD_CALLS,
+    BLOCKING_MODULE_CALLS,
+    BLOCKING_QUEUE_METHODS,
+    BLOCKING_SIMPLE_CALLS,
+    HOST_ONLY_MODULES,
+    HOT_PATH_FUNCTIONS,
+    JAX_FAMILY,
+    JIT_WRAPPER_CALLS,
+    RNG_OK,
+)
+from tools.d4pglint.core import Finding
+
+REGISTRY: dict = {}
+
+
+def check(check_id: str):
+    def deco(fn):
+        REGISTRY[check_id] = fn
+        fn.check_id = check_id
+        return fn
+
+    return deco
+
+
+# --------------------------------------------------------------- ast helpers
+def _dotted(node) -> str | None:
+    """'a.b.c' for nested Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _terminal_name(node) -> str | None:
+    """The last identifier of a Name/Attribute chain ('self._wb_queue' →
+    '_wb_queue')."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _lockish(name: str | None) -> bool:
+    if not name:
+        return False
+    low = name.lower()
+    return "lock" in low or "cond" in low or "mutex" in low
+
+
+def _walk_skip_nested_defs(node):
+    """Walk statements/expressions of ``node``'s body without descending
+    into nested function/class definitions."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(
+            n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+# ------------------------------------------------------------------ check 1
+@check("host-jax-import")
+def host_jax_import(tree, src_lines, relpath):
+    """Host-only modules (the `_lazy.py` contract) must not import the JAX
+    runtime at module top level: spawned actor-pool workers and thin
+    clients import them, and pulling jax there drags a TPU client into a
+    child process (unsafe) or pre-empts backend configuration."""
+    if relpath not in HOST_ONLY_MODULES:
+        return []
+    out = []
+
+    def scan(body):
+        for node in body:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    root = a.name.split(".")[0]
+                    if root in JAX_FAMILY:
+                        out.append(
+                            Finding(
+                                "host-jax-import", relpath, node.lineno,
+                                f"top-level `import {a.name}` in a host-only "
+                                "module (the _lazy.py contract): move the "
+                                "import inside the function that needs it",
+                            )
+                        )
+            elif isinstance(node, ast.ImportFrom):
+                root = (node.module or "").split(".")[0]
+                if root in JAX_FAMILY:
+                    out.append(
+                        Finding(
+                            "host-jax-import", relpath, node.lineno,
+                            f"top-level `from {node.module} import ...` in a "
+                            "host-only module: move it into the consumer",
+                        )
+                    )
+            elif isinstance(node, (ast.If, ast.Try)):
+                for sub in ast.iter_child_nodes(node):
+                    if isinstance(sub, ast.ExceptHandler):
+                        scan(sub.body)
+                for attr in ("body", "orelse", "finalbody"):
+                    scan(getattr(node, attr, []) or [])
+
+    scan(tree.body)
+    return out
+
+
+# ------------------------------------------------------------------ check 2
+@check("lock-blocking-call")
+def lock_blocking_call(tree, src_lines, relpath):
+    """A blocking call (socket/queue/file/timer/thread-join) while holding
+    a lock serializes every other thread on that lock behind I/O — the
+    exact shape of the PR-3 reply-thread head-of-line wedge."""
+    out = []
+
+    def held_exprs(with_node):
+        held = []
+        for item in with_node.items:
+            expr = item.context_expr
+            if _lockish(_terminal_name(expr)):
+                held.append(ast.dump(expr))
+        return held
+
+    def blocking_reason(call: ast.Call, held: list[str]) -> str | None:
+        fn = call.func
+        if isinstance(fn, ast.Name) and fn.id == "open":
+            return "file open()"
+        if not isinstance(fn, ast.Attribute):
+            return None
+        owner = fn.value
+        dotted = _dotted(owner)
+        attr = fn.attr
+        if dotted in ("time",) and attr in BLOCKING_SIMPLE_CALLS:
+            return f"time.{attr}()"
+        for mod, names in BLOCKING_MODULE_CALLS.items():
+            if dotted == mod and attr in names:
+                return f"{mod}.{attr}()"
+        if attr in BLOCKING_METHOD_CALLS:
+            return f".{attr}() (socket/future I/O)"
+        if attr == "wait":
+            # cond.wait() on the HELD condition is the cv pattern (it
+            # releases the lock while waiting) — only flag foreign waits.
+            if ast.dump(owner) not in held:
+                return ".wait() on an object other than the held lock"
+            return None
+        if attr == "join":
+            args_ok = all(
+                isinstance(a, ast.Constant)
+                and isinstance(a.value, (int, float))
+                for a in call.args
+            )
+            kw_ok = all(k.arg == "timeout" for k in call.keywords)
+            if args_ok and kw_ok:
+                return ".join() (thread join)"
+            return None  # str.join(iterable) etc.
+        name = _terminal_name(owner) or ""
+        if attr in BLOCKING_QUEUE_METHODS and (
+            "queue" in name.lower() or name.lower().endswith("_q") or name == "q"
+        ):
+            # queue.get/put block unless explicitly non-blocking
+            nonblocking = any(
+                k.arg == "block" and isinstance(k.value, ast.Constant)
+                and k.value.value is False
+                for k in call.keywords
+            )
+            if not nonblocking and not attr.endswith("_nowait"):
+                return f"queue .{attr}()"
+        return None
+
+    def visit(node, held):
+        for child in ast.iter_child_nodes(node):
+            child_held = held
+            if isinstance(child, ast.With):
+                child_held = held + held_exprs(child)
+            elif isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                # a nested def's body runs later, not under this lock
+                child_held = []
+            if isinstance(child, ast.Call) and held:
+                reason = blocking_reason(child, held)
+                if reason:
+                    out.append(
+                        Finding(
+                            "lock-blocking-call", relpath, child.lineno,
+                            f"blocking call {reason} while holding a lock: "
+                            "every thread contending on the lock stalls "
+                            "behind this I/O — move it outside the locked "
+                            "region",
+                        )
+                    )
+            visit(child, child_held)
+
+    visit(tree, [])
+    return out
+
+
+# ------------------------------------------------------------------ check 3
+@check("shared-mutable-state")
+def shared_mutable_state(tree, src_lines, relpath):
+    """Attributes written by code reachable from a thread-target function
+    must be written under a lock-ish `with`, or declared in the class's
+    `_THREAD_SAFE` tuple (with a comment saying why the unguarded write
+    is safe). Undeclared cross-thread writes are how torn reads ship."""
+    out = []
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        methods = {
+            m.name: m
+            for m in cls.body
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        declared: set[str] = set()
+        for node in cls.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == "_THREAD_SAFE":
+                        for elt in getattr(node.value, "elts", []):
+                            if isinstance(elt, ast.Constant):
+                                declared.add(str(elt.value))
+        # thread targets: threading.Thread(target=self.X / X) in any method
+        targets: set[str] = set()
+        for m in methods.values():
+            for call in [
+                n for n in ast.walk(m) if isinstance(n, ast.Call)
+            ]:
+                callee = _dotted(call.func) or ""
+                if callee.split(".")[-1] != "Thread":
+                    continue
+                for kw in call.keywords:
+                    if kw.arg == "target":
+                        tname = _terminal_name(kw.value)
+                        if tname in methods:
+                            targets.add(tname)
+        if not targets:
+            continue
+        # intra-class call graph: which methods a target reaches
+        calls: dict[str, set[str]] = {}
+        for name, m in methods.items():
+            callees = set()
+            for call in [n for n in ast.walk(m) if isinstance(n, ast.Call)]:
+                fn = call.func
+                if (
+                    isinstance(fn, ast.Attribute)
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id == "self"
+                    and fn.attr in methods
+                ):
+                    callees.add(fn.attr)
+            calls[name] = callees
+        reachable = set(targets)
+        frontier = list(targets)
+        while frontier:
+            for callee in calls.get(frontier.pop(), ()):
+                if callee not in reachable:
+                    reachable.add(callee)
+                    frontier.append(callee)
+
+        def self_attr_store(node) -> str | None:
+            t = node
+            if isinstance(t, ast.Subscript):
+                t = t.value
+            if (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+            ):
+                return t.attr
+            return None
+
+        for name in sorted(reachable):
+            m = methods[name]
+
+            def visit(node, locked):
+                for child in ast.iter_child_nodes(node):
+                    child_locked = locked
+                    if isinstance(child, ast.With):
+                        if any(
+                            _lockish(_terminal_name(i.context_expr))
+                            for i in child.items
+                        ):
+                            child_locked = True
+                    if isinstance(child, (ast.FunctionDef, ast.Lambda)):
+                        continue
+                    stores = []
+                    if isinstance(child, ast.Assign):
+                        stores = child.targets
+                    elif isinstance(child, (ast.AugAssign, ast.AnnAssign)):
+                        stores = [child.target]
+                    for t in stores:
+                        targets_ = t.elts if isinstance(t, ast.Tuple) else [t]
+                        for tt in targets_:
+                            attr = self_attr_store(tt)
+                            if (
+                                attr
+                                and not child_locked
+                                and attr not in declared
+                            ):
+                                out.append(
+                                    Finding(
+                                        "shared-mutable-state", relpath,
+                                        child.lineno,
+                                        f"`self.{attr}` written in "
+                                        f"`{cls.name}.{name}` (reachable "
+                                        "from a thread target) without a "
+                                        "lock: guard it or declare it in "
+                                        "_THREAD_SAFE with a why-safe "
+                                        "comment",
+                                    )
+                                )
+                    visit(child, child_locked)
+
+            visit(m, False)
+    return out
+
+
+# ------------------------------------------------------------------ check 4
+@check("wall-clock-deadline")
+def wall_clock_deadline(tree, src_lines, relpath):
+    """time.time() jumps with NTP/suspend; every deadline, interval, and
+    rate in this codebase is monotonic (time.monotonic/perf_counter).
+    Wall-clock reads are for human-facing timestamps only — suppress
+    with a justification where that is really what you want."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _dotted(node.func) == "time.time":
+            out.append(
+                Finding(
+                    "wall-clock-deadline", relpath, node.lineno,
+                    "time.time() is not a deadline/interval clock (NTP "
+                    "steps, suspend): use time.monotonic() or "
+                    "time.perf_counter()",
+                )
+            )
+    return out
+
+
+# ------------------------------------------------------------------ check 5
+@check("broad-except")
+def broad_except(tree, src_lines, relpath):
+    """A bare/broad except that neither re-raises nor logs swallows device
+    errors (XlaRuntimeError et al.) and turns a dead learner into a
+    silent hang. Narrow it, re-raise, or log with context."""
+    broad_names = {"Exception", "BaseException"}
+
+    def is_broad(h: ast.ExceptHandler) -> bool:
+        if h.type is None:
+            return True
+        names = (
+            h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+        )
+        return any(_terminal_name(n) in broad_names for n in names)
+
+    def handles(h: ast.ExceptHandler) -> bool:
+        for node in ast.walk(h):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call):
+                fn = node.func
+                if isinstance(fn, ast.Name) and fn.id == "print":
+                    return True
+                dotted = _dotted(fn) or ""
+                head = dotted.split(".")[0].lower()
+                if "log" in head or "warn" in dotted.split(".")[-1].lower():
+                    return True
+        return False
+
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and is_broad(node):
+            if not handles(node):
+                out.append(
+                    Finding(
+                        "broad-except", relpath, node.lineno,
+                        "broad except neither re-raises nor logs: device/"
+                        "thread errors disappear here — narrow the type, "
+                        "re-raise, or log with context (disable= needs a "
+                        "one-line justification)",
+                    )
+                )
+    return out
+
+
+# ------------------------------------------------------------------ check 6
+@check("jit-purity")
+def jit_purity(tree, src_lines, relpath):
+    """Host numpy ops and float64 literals inside jit-traced functions
+    either bake silent trace-time constants, force implicit transfers,
+    or upcast the lane layout — jit-reachable code is jnp/f32 only."""
+    traced: set[str] = set()
+
+    def jit_callee(fn) -> bool:
+        dotted = _dotted(fn) or ""
+        tail = dotted.split(".")[-1]
+        return tail in JIT_WRAPPER_CALLS or dotted in ("jax.jit",)
+
+    def first_fn_name(call: ast.Call) -> str | None:
+        if not call.args:
+            return None
+        a = call.args[0]
+        if isinstance(a, ast.Name):
+            return a.id
+        if isinstance(a, ast.Call):  # jax.jit(partial(f, cfg), ...)
+            inner = _dotted(a.func) or ""
+            if inner.split(".")[-1] == "partial" and a.args:
+                if isinstance(a.args[0], ast.Name):
+                    return a.args[0].id
+        return None
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and jit_callee(node.func):
+            name = first_fn_name(node)
+            if name:
+                traced.add(name)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                d = deco.func if isinstance(deco, ast.Call) else deco
+                dotted = _dotted(d) or ""
+                if dotted.split(".")[-1] in ("jit", "partial") and (
+                    "jit" in dotted
+                    or any(
+                        "jit" in (_dotted(a) or "")
+                        for a in getattr(deco, "args", [])
+                    )
+                ):
+                    traced.add(node.name)
+
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef) or node.name not in traced:
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                dotted = _dotted(sub.func) or ""
+                root = dotted.split(".")[0]
+                if root in ("np", "numpy"):
+                    out.append(
+                        Finding(
+                            "jit-purity", relpath, sub.lineno,
+                            f"`{dotted}` inside jit-traced `{node.name}`: "
+                            "host numpy in traced code bakes a trace-time "
+                            "constant or forces a transfer — use jnp",
+                        )
+                    )
+                if dotted.startswith("time."):
+                    out.append(
+                        Finding(
+                            "jit-purity", relpath, sub.lineno,
+                            f"`{dotted}` inside jit-traced `{node.name}`: "
+                            "runs at trace time only, not per step",
+                        )
+                    )
+            if isinstance(sub, ast.Attribute) and sub.attr == "float64":
+                out.append(
+                    Finding(
+                        "jit-purity", relpath, sub.lineno,
+                        f"float64 inside jit-traced `{node.name}`: x64 is "
+                        "disabled on TPU and doubles lane pressure — keep "
+                        "traced code f32/bf16",
+                    )
+                )
+            if (
+                isinstance(sub, ast.Constant)
+                and sub.value == "float64"
+            ):
+                out.append(
+                    Finding(
+                        "jit-purity", relpath, sub.lineno,
+                        f"'float64' literal inside jit-traced `{node.name}`",
+                    )
+                )
+    return out
+
+
+# ------------------------------------------------------------------ check 7
+@check("hot-path-alloc")
+def hot_path_alloc(tree, src_lines, relpath):
+    """The hot-path manifest functions run once per step/dispatch; a fresh
+    numpy allocation there is the regression PR 2 removed (preallocated
+    staging). Nested defs are exempt (lazy one-time init closures)."""
+    wanted = {}
+    for entry in HOT_PATH_FUNCTIONS:
+        suffix, qual = entry.split("::")
+        if relpath.endswith(suffix):
+            wanted[qual] = entry
+    if not wanted:
+        return []
+    out = []
+
+    def scan_fn(fn: ast.FunctionDef, qual: str):
+        for sub in _walk_skip_nested_defs(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            dotted = _dotted(sub.func) or ""
+            parts = dotted.split(".")
+            if parts[0] in ("np", "numpy") and parts[-1] in ALLOC_CALLS:
+                out.append(
+                    Finding(
+                        "hot-path-alloc", relpath, sub.lineno,
+                        f"`{dotted}` in hot-path `{qual}`: per-step "
+                        "allocation on the data plane — preallocate and "
+                        "rotate (see the staging-slot pattern)",
+                    )
+                )
+            elif (
+                isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "copy"
+                and not sub.args
+            ):
+                out.append(
+                    Finding(
+                        "hot-path-alloc", relpath, sub.lineno,
+                        f"`.copy()` in hot-path `{qual}`: per-step "
+                        "allocation — if the copy is the retention "
+                        "contract, suppress with the reason",
+                    )
+                )
+
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        for m in cls.body:
+            if isinstance(m, ast.FunctionDef):
+                qual = f"{cls.name}.{m.name}"
+                if qual in wanted:
+                    scan_fn(m, qual)
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef) and node.name in wanted:
+            scan_fn(node, node.name)
+    return out
+
+
+# ------------------------------------------------------------------ check 8
+@check("thread-discipline")
+def thread_discipline(tree, src_lines, relpath):
+    """Every thread is a NAMED daemon: names make ledger holds, profiler
+    traces, and crash dumps attributable; daemon=True keeps a wedged
+    worker from hanging interpreter exit."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func) or ""
+        if dotted.split(".")[-1] != "Thread" or "threading" not in dotted:
+            continue
+        kwargs = {k.arg for k in node.keywords}
+        missing = [k for k in ("name", "daemon") if k not in kwargs]
+        if missing:
+            out.append(
+                Finding(
+                    "thread-discipline", relpath, node.lineno,
+                    f"threading.Thread(...) without {'/'.join(missing)}=: "
+                    "threads must be named (error attribution) daemons "
+                    "(no hang on exit)",
+                )
+            )
+    return out
+
+
+# ------------------------------------------------------------------ check 9
+@check("global-rng")
+def global_rng(tree, src_lines, relpath):
+    """np.random module-level state breaks the seeded determinism
+    contract (frozen-draw regression tests pin exact streams). Use
+    np.random.default_rng(seed) / a passed Generator."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Attribute):
+            continue
+        dotted = _dotted(node) or ""
+        parts = dotted.split(".")
+        if (
+            len(parts) >= 3
+            and parts[0] in ("np", "numpy")
+            and parts[1] == "random"
+            and parts[2] not in RNG_OK
+        ):
+            out.append(
+                Finding(
+                    "global-rng", relpath, node.lineno,
+                    f"`{dotted}`: hidden global RNG state — pass a seeded "
+                    "np.random.Generator (default_rng) instead",
+                )
+            )
+    return out

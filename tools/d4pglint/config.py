@@ -1,0 +1,111 @@
+"""d4pglint manifests: which files answer to which invariant.
+
+These lists ARE the policy — adding a module to a manifest turns the
+corresponding checks on for it, and a module's absence is an explicit
+decision, not an oversight (reviewed like code, because it is code).
+Paths are repo-root-relative with forward slashes.
+"""
+
+from __future__ import annotations
+
+# Every check id, as referenced by `# d4pglint: disable=<id>` comments.
+ALL_CHECKS = (
+    "host-jax-import",       # host-only modules must not import jax at top level
+    "lock-blocking-call",    # no blocking call while holding a lock
+    "shared-mutable-state",  # cross-thread attribute writes: lock or declare
+    "wall-clock-deadline",   # time.time() is not a deadline/interval clock
+    "broad-except",          # broad handlers must re-raise or log
+    "jit-purity",            # no numpy/float64 host ops inside jit-traced fns
+    "hot-path-alloc",        # no per-step allocation in hot-path functions
+    "thread-discipline",     # threads are named daemons
+    "global-rng",            # seeded Generators only, no np.random module state
+)
+
+# What `python -m tools.d4pglint` lints when given no paths: the product
+# code. Tests are exempt on purpose (they monkeypatch, sleep under locks
+# in stress harnesses, and seed deliberate violations).
+DEFAULT_PATHS = (
+    "d4pg_tpu",
+    "tools",
+    "benchmarks",
+    "train.py",
+    "bench.py",
+    "__graft_entry__.py",
+)
+
+# The `_lazy.py` contract: these modules are imported by processes that
+# must never pull the JAX runtime (spawned actor-pool workers, thin
+# clients) or before backend configuration (__graft_entry__ dryrun), so
+# `import jax`/`flax`/... at module top level is a bug even though it
+# "works" on the dev box.
+HOST_ONLY_MODULES = (
+    "d4pg_tpu/__init__.py",
+    "d4pg_tpu/_lazy.py",
+    "d4pg_tpu/config.py",
+    "d4pg_tpu/envs/__init__.py",
+    "d4pg_tpu/envs/gym_adapter.py",
+    "d4pg_tpu/runtime/__init__.py",
+    "d4pg_tpu/runtime/actor_pool.py",
+    "d4pg_tpu/runtime/metrics.py",
+    "d4pg_tpu/serve/protocol.py",
+    "d4pg_tpu/serve/client.py",
+    "d4pg_tpu/serve/stats.py",
+    "d4pg_tpu/utils/signals.py",
+    "d4pg_tpu/analysis/__init__.py",
+    "d4pg_tpu/analysis/ledger.py",
+)
+
+# JAX-runtime packages whose top-level import violates host-only-ness.
+JAX_FAMILY = ("jax", "jaxlib", "flax", "optax", "orbax", "chex")
+
+# Preallocated-staging rule: these functions are the per-step hot path of
+# the data plane — a fresh numpy allocation per call here is the exact
+# regression PR 2 existed to remove. `module suffix::qualname` keys;
+# nested function defs inside them are exempt (lazy one-time init
+# closures like the staging `mk()` allocators).
+HOT_PATH_FUNCTIONS = (
+    "d4pg_tpu/replay/per.py::PrioritizedReplayBuffer.sample_block",
+    "d4pg_tpu/replay/per.py::PrioritizedReplayBuffer._draw",
+    "d4pg_tpu/runtime/actor_pool.py::HostActorPool._step_cmd",
+    "d4pg_tpu/runtime/trainer.py::Trainer._sample_staged",
+    "d4pg_tpu/serve/batcher.py::DynamicBatcher._device_loop",
+    "d4pg_tpu/serve/batcher.py::DynamicBatcher._reply_loop",
+    "d4pg_tpu/serve/batcher.py::DynamicBatcher.submit",
+)
+
+# numpy allocators flagged inside hot-path functions (np.asarray is
+# exempt: it is a no-op on an existing same-dtype array, which is how
+# the hot paths use it).
+ALLOC_CALLS = (
+    "stack", "concatenate", "vstack", "hstack", "empty", "zeros",
+    "ones", "full", "array", "copy", "tile", "repeat",
+)
+
+# np.random attributes that are fine (explicit seeded generator API —
+# RandomState included: a seeded instance is an explicit generator, and
+# dm_control's task seeding requires one); everything else on np.random
+# is hidden global state.
+RNG_OK = (
+    "default_rng", "Generator", "SeedSequence", "PCG64", "Philox",
+    "RandomState",
+)
+
+# Local wrapper callables that jit their argument — functions passed to
+# these are treated as jit-traced for the jit-purity check, in addition
+# to @jax.jit/@jit/@partial(jax.jit, ...) decorators and
+# `x = jax.jit(f)` assignments.
+JIT_WRAPPER_CALLS = ("jit", "_act_jit")
+
+# Blocking calls under a lock: method names that block on I/O, timers, or
+# other threads. `.wait` on the lock object being held is exempt (that is
+# the condition-variable pattern). `.join` is only flagged for no-arg /
+# timeout-only calls (so `", ".join(parts)` never matches).
+BLOCKING_SIMPLE_CALLS = ("sleep",)                     # time.sleep
+BLOCKING_MODULE_CALLS = {
+    "subprocess": ("run", "call", "check_call", "check_output", "Popen"),
+    "os": ("system", "waitpid", "read", "write"),
+}
+BLOCKING_METHOD_CALLS = (
+    "recv", "send", "sendall", "accept", "connect", "listen", "result",
+)
+BLOCKING_QUEUE_METHODS = ("get", "put")  # on names containing queue/_q

@@ -1,0 +1,121 @@
+"""d4pglint driver: parse, run checks, apply suppressions, report.
+
+A finding is suppressed by a ``# d4pglint: disable=<id>[,<id>...]``
+comment on the finding's line or the line directly above it (use the
+rest of the comment to say WHY — the repo convention is
+``# d4pglint: disable=<id>  -- justification``). ``disable=all``
+suppresses every check for that line; use it never.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+
+from tools.d4pglint.config import ALL_CHECKS, DEFAULT_PATHS
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*d4pglint:\s*disable=([a-z0-9_\-]+(?:\s*,\s*[a-z0-9_\-]+)*)"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    check: str
+    path: str      # repo-root-relative, forward slashes
+    line: int      # 1-indexed
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.check}] {self.message}"
+
+
+def _suppressions(src_lines: list[str]) -> dict[int, set[str]]:
+    """line (1-indexed) -> set of check ids disabled on that line."""
+    out: dict[int, set[str]] = {}
+    for i, line in enumerate(src_lines, start=1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            ids = {s.strip() for s in m.group(1).split(",") if s.strip()}
+            out[i] = ids
+    return out
+
+
+def _is_suppressed(f: Finding, sup: dict[int, set[str]]) -> bool:
+    for line in (f.line, f.line - 1):
+        ids = sup.get(line)
+        if ids and (f.check in ids or "all" in ids):
+            return True
+    return False
+
+
+def lint_source(
+    src: str, relpath: str, checks=None
+) -> tuple[list[Finding], list[Finding]]:
+    """Lint one file's source. Returns ``(findings, suppressed)``.
+
+    ``relpath`` must be repo-root-relative with forward slashes — the
+    manifests in config.py key on it.
+    """
+    from tools.d4pglint import checks as checks_mod
+
+    tree = ast.parse(src, filename=relpath)
+    src_lines = src.splitlines()
+    sup = _suppressions(src_lines)
+    selected = checks if checks is not None else ALL_CHECKS
+    raw: list[Finding] = []
+    for check_id in selected:
+        fn = checks_mod.REGISTRY[check_id]
+        raw.extend(fn(tree, src_lines, relpath))
+    findings = [f for f in raw if not _is_suppressed(f, sup)]
+    suppressed = [f for f in raw if _is_suppressed(f, sup)]
+    findings.sort(key=lambda f: (f.path, f.line, f.check))
+    return findings, suppressed
+
+
+def iter_py_files(paths, root: str):
+    """Yield (abspath, relpath) for every .py under the given paths."""
+    skip_dirs = {"__pycache__", ".git", "_native_build", ".claude"}
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(ap):
+            yield ap, os.path.relpath(ap, root).replace(os.sep, "/")
+            continue
+        for dirpath, dirnames, filenames in os.walk(ap):
+            dirnames[:] = [d for d in dirnames if d not in skip_dirs]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    full = os.path.join(dirpath, fn)
+                    yield full, os.path.relpath(full, root).replace(os.sep, "/")
+
+
+def repo_root() -> str:
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def lint_paths(
+    paths=None, root: str | None = None, checks=None
+) -> tuple[list[Finding], list[Finding]]:
+    """Lint files/trees (default: the repo manifest). Returns
+    ``(findings, suppressed)`` across all files."""
+    root = root or repo_root()
+    paths = list(paths) if paths else list(DEFAULT_PATHS)
+    findings: list[Finding] = []
+    suppressed: list[Finding] = []
+    for ap, rel in iter_py_files(paths, root):
+        with open(ap, encoding="utf-8") as f:
+            src = f.read()
+        try:
+            got, sup = lint_source(src, rel, checks=checks)
+        except SyntaxError as e:
+            findings.append(
+                Finding("parse", rel, e.lineno or 0, f"syntax error: {e.msg}")
+            )
+            continue
+        findings.extend(got)
+        suppressed.extend(sup)
+    return findings, suppressed

@@ -1,0 +1,124 @@
+"""Schema checks for committed benchmark artifacts and metrics logs.
+
+Two machine-readable surfaces downstream tooling (plots, regression
+smokes, the bench comparison scripts) parses:
+
+- ``benchmarks/*.json`` — one JSON document per microbench: either a
+  single object carrying a ``backend`` key, or a list of row objects
+  each carrying a ``bench`` key (the mfu sweep shape). A truncated or
+  hand-mangled artifact should fail lint, not a plot script three PRs
+  later.
+- ``metrics.jsonl`` — append-only rows from
+  :class:`d4pg_tpu.runtime.MetricsLogger`: every line a JSON object with
+  an int ``step``, a numeric ``t``, and numeric values throughout
+  (schema: docs/data_plane.md).
+
+CLI: ``python -m tools.d4pglint.schema_check [root]`` checks every
+``benchmarks/*.json`` plus every ``runs/**/metrics.jsonl``; exits 1 on
+any violation.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+
+def check_benchmark_json(path: str) -> list[str]:
+    """Problems with one benchmarks/*.json artifact ([] = clean)."""
+    errs = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: unreadable/invalid JSON ({e})"]
+    if isinstance(doc, dict):
+        if not doc:
+            errs.append(f"{path}: empty object")
+        elif "backend" not in doc:
+            errs.append(
+                f"{path}: benchmark object missing 'backend' (which "
+                "hardware produced this number?)"
+            )
+    elif isinstance(doc, list):
+        if not doc:
+            errs.append(f"{path}: empty list")
+        for i, row in enumerate(doc):
+            if not isinstance(row, dict):
+                errs.append(f"{path}[{i}]: row is not an object")
+            elif "bench" not in row:
+                errs.append(f"{path}[{i}]: sweep row missing 'bench'")
+    else:
+        errs.append(f"{path}: top level must be an object or list of objects")
+    return errs
+
+
+def check_metrics_jsonl(path: str, max_rows: int | None = None) -> list[str]:
+    """Problems with one metrics.jsonl ([] = clean)."""
+    errs = []
+    try:
+        f = open(path, encoding="utf-8")
+    except OSError as e:
+        return [f"{path}: unreadable ({e})"]
+    with f:
+        for lineno, line in enumerate(f, start=1):
+            if max_rows is not None and lineno > max_rows:
+                break
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                errs.append(f"{path}:{lineno}: invalid JSON row")
+                continue
+            if not isinstance(row, dict):
+                errs.append(f"{path}:{lineno}: row is not an object")
+                continue
+            step = row.get("step")
+            if not isinstance(step, int) or isinstance(step, bool):
+                errs.append(f"{path}:{lineno}: missing/non-int 'step'")
+            if not isinstance(row.get("t"), (int, float)):
+                errs.append(f"{path}:{lineno}: missing/non-numeric 't'")
+            for k, v in row.items():
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    errs.append(
+                        f"{path}:{lineno}: non-numeric value for {k!r} "
+                        f"({type(v).__name__}) — MetricsLogger rows are "
+                        "numeric-only by contract"
+                    )
+                    break
+    return errs
+
+
+def check_tree(root: str) -> list[str]:
+    errs = []
+    for path in sorted(glob.glob(os.path.join(root, "benchmarks", "*.json"))):
+        errs.extend(check_benchmark_json(path))
+    for path in sorted(
+        glob.glob(os.path.join(root, "runs", "**", "metrics.jsonl"),
+                  recursive=True)
+    ):
+        # Bounded: the lint gate must stay O(1) in the operator's local
+        # run history (a long run logs hundreds of thousands of rows).
+        errs.extend(check_metrics_jsonl(path, max_rows=2000))
+    return errs
+
+
+def main(argv=None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    root = args[0] if args else os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    errs = check_tree(root)
+    for e in errs:
+        print(e)
+    n = len(errs)
+    print(f"schema-check: {n} problem{'s' if n != 1 else ''}")
+    return 1 if errs else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

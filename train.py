@@ -189,6 +189,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "python -m d4pg_tpu.serve, then exit")
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace of grad steps 10-60 here")
+    p.add_argument("--debug-guards", action="store_true",
+                   help="runtime invariant guards (d4pg_tpu/analysis): "
+                        "recompile sentinel on every jitted entry point, "
+                        "transfer guard around the steady-state dispatch, "
+                        "staging ledger on replay/pool staging slots — "
+                        "guard trips raise immediately instead of "
+                        "silently corrupting or taxing the run")
     p.add_argument("--max-rss-gb", type=float, default=0.0,
                    help="RSS watchdog: past this limit the trainer "
                         "checkpoints and exits cleanly so a supervisor can "
@@ -289,6 +296,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         resume=args.resume,
         snapshot_replay=args.snapshot_replay,
         profile_dir=args.profile_dir,
+        debug_guards=args.debug_guards,
         max_rss_gb=args.max_rss_gb,
         dp=args.dp,
         dp_hogwild=args.dp_hogwild,
