@@ -1,0 +1,224 @@
+"""Deterministic chaos harness: seeded fault plans injected at named sites.
+
+D4PG's decomposition (acting / replay / learning / serving as separate
+processes and threads) means each piece fails independently in
+production — and nothing proves recovery except injecting the failures.
+This module is the injection half: a **fault plan** is a seeded, fully
+deterministic schedule of faults at named *sites*, parsed from a compact
+spec string (``--chaos`` on ``train.py`` and ``python -m d4pg_tpu.serve``)
+so the exact same faults replay run after run.
+
+Spec syntax (entries separated by ``;`` or ``,``)::
+
+    [seed=<int>;] <site>@<count>[:<arg>][#<actor>] ...
+
+    env_raise@40#1        worker 1's env raises on ITS 40th step
+    env_hang@60:30#0      worker 0's env hangs 30 s on its 60th step
+    worker_kill@12#1      SIGKILL worker 1 at the pool's 12th step
+    ckpt_truncate@2       truncate the 2nd checkpoint after it commits
+    wb_stall@3:0.5        stall the priority flusher 0.5 s at wake 3
+    sock_reset@5          force-reset the serve conn at its 5th frame
+
+``count`` is 1-based and counted *at the site* (a worker counts its own
+env steps; the pool counts pool steps; the flusher counts wakes), which
+is what makes the plan deterministic regardless of wall-clock timing.
+``#actor`` omitted on a worker-targeted site resolves deterministically
+from the seed and the entry's count once the pool size is known
+(:meth:`ChaosPlan.resolve_actors`).
+
+Deliberately stdlib-only (no numpy/jax): the plan rides into spawned
+actor-pool workers as plain tuples, and the serve CLI builds an injector
+before any heavy import.
+
+Site reference (who ticks, who reacts — docs/fault_tolerance.md):
+
+====================  ==========================  =========================
+site                  tick location               recovery proven
+====================  ==========================  =========================
+``env_raise``         pool worker, per env step   supervisor restart
+``env_hang``          pool worker, per env step   step deadline + restart
+``worker_kill``       pool parent, per pool step  is_alive detect + restart
+``ckpt_truncate``     trainer, per checkpoint     verify-on-restore fallback
+``wb_stall``          writeback flusher, per wake  hold pacing (guards green)
+``sock_reset``        serve conn, per frame       reader survives, drop count
+====================  ==========================  =========================
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+# Sites whose faults run INSIDE a pool worker process (entries for them
+# are shipped to the worker as plain tuples at spawn).
+WORKER_SITES = ("env_raise", "env_hang")
+
+KNOWN_SITES = WORKER_SITES + (
+    "worker_kill",
+    "ckpt_truncate",
+    "wb_stall",
+    "sock_reset",
+)
+
+
+@dataclass(frozen=True)
+class ChaosEntry:
+    site: str
+    at: int                      # 1-based count at the site
+    arg: Optional[float] = None  # site-specific (hang/stall seconds)
+    actor: Optional[int] = None  # worker index for worker-targeted sites
+
+    def __str__(self) -> str:
+        s = f"{self.site}@{self.at}"
+        if self.arg is not None:
+            s += f":{self.arg:g}"
+        if self.actor is not None:
+            s += f"#{self.actor}"
+        return s
+
+
+@dataclass
+class ChaosPlan:
+    seed: int = 0
+    entries: tuple = ()
+
+    @classmethod
+    def parse(cls, spec: str) -> "ChaosPlan":
+        """Parse the ``--chaos`` spec string; raises ``ValueError`` with
+        the offending token on any malformed entry."""
+        seed = 0
+        entries = []
+        for raw in spec.replace(",", ";").split(";"):
+            tok = raw.strip()
+            if not tok:
+                continue
+            if tok.startswith("seed="):
+                seed = int(tok[len("seed="):])
+                continue
+            try:
+                head, _, actor_s = tok.partition("#")
+                site, _, at_s = head.partition("@")
+                at_s, _, arg_s = at_s.partition(":")
+                if site not in KNOWN_SITES:
+                    raise ValueError(
+                        f"unknown site {site!r} (known: {', '.join(KNOWN_SITES)})"
+                    )
+                entry = ChaosEntry(
+                    site=site,
+                    at=int(at_s),
+                    arg=float(arg_s) if arg_s else None,
+                    actor=int(actor_s) if actor_s else None,
+                )
+            except ValueError as e:
+                raise ValueError(f"bad chaos entry {tok!r}: {e}") from e
+            if entry.at < 1:
+                raise ValueError(f"bad chaos entry {tok!r}: count is 1-based")
+            if any(
+                e.site == entry.site and e.at == entry.at for e in entries
+            ):
+                # The injector keys on (site, count); a duplicate would
+                # silently shadow one planned fault — refuse instead of
+                # quietly weakening the plan.
+                raise ValueError(
+                    f"duplicate chaos entry {entry.site}@{entry.at}: only "
+                    "one fault per (site, count) — use a different count"
+                )
+            entries.append(entry)
+        return cls(seed=seed, entries=tuple(entries))
+
+    def resolve_actors(self, num_actors: int) -> "ChaosPlan":
+        """Pin every worker-targeted entry to a concrete worker index.
+        Entries without an explicit ``#actor`` resolve deterministically
+        from (seed, count) — no RNG state, so resolution is stable however
+        many times it runs."""
+        resolved = []
+        for e in self.entries:
+            if e.site in WORKER_SITES + ("worker_kill",) and e.actor is None:
+                e = ChaosEntry(e.site, e.at, e.arg, (self.seed + e.at) % num_actors)
+            elif e.actor is not None and e.actor >= num_actors:
+                raise ValueError(
+                    f"chaos entry {e} targets actor {e.actor} but the pool "
+                    f"has {num_actors}"
+                )
+            resolved.append(e)
+        return ChaosPlan(seed=self.seed, entries=tuple(resolved))
+
+    def worker_entries(self, actor: int) -> tuple:
+        """The (site, at, arg) triples worker ``actor`` enforces itself —
+        plain tuples so they cross the spawn boundary without importing
+        this module in the child."""
+        return tuple(
+            (e.site, e.at, e.arg)
+            for e in self.entries
+            if e.site in WORKER_SITES and e.actor == actor
+        )
+
+
+@dataclass
+class ChaosInjector:
+    """Per-site counters over a :class:`ChaosPlan`; thread-safe.
+
+    Each call to :meth:`tick` advances the named site's counter and
+    returns the entry scheduled for that count (or ``None``). An entry
+    fires exactly once. Fired entries accumulate in :attr:`fired` for
+    observability (metrics rows, serve healthz).
+    """
+
+    plan: ChaosPlan
+    fired: list = field(default_factory=list)
+
+    def __post_init__(self):
+        self._lock = threading.Lock()
+        self._counts: dict = {}
+        self._by_site: dict = {}
+        for e in self.plan.entries:
+            self._by_site.setdefault(e.site, {})[e.at] = e
+
+    def tick(self, site: str) -> Optional[ChaosEntry]:
+        with self._lock:
+            n = self._counts.get(site, 0) + 1
+            self._counts[site] = n
+            e = self._by_site.get(site, {}).pop(n, None)
+            if e is not None:
+                self.fired.append(e)
+                print(f"[chaos] inject {e} (site count {n})", flush=True)
+            return e
+
+    @property
+    def injections_total(self) -> int:
+        with self._lock:
+            return len(self.fired)
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {
+                "chaos_injections": len(self.fired),
+                "chaos_pending": sum(len(v) for v in self._by_site.values()),
+            }
+
+
+def truncate_checkpoint_step(step_dir: str) -> Optional[str]:
+    """The ``ckpt_truncate`` fault: cut the largest file under an Orbax
+    step directory to half its size (deterministic victim choice — ties
+    broken by path sort). Returns the truncated path, or ``None`` when
+    the directory holds no non-empty file."""
+    victim, vsize = None, -1
+    for dirpath, _dirnames, filenames in os.walk(step_dir):
+        for fn in sorted(filenames):
+            p = os.path.join(dirpath, fn)
+            try:
+                size = os.path.getsize(p)
+            except OSError:
+                continue
+            if size > vsize:
+                victim, vsize = p, size
+    if victim is None or vsize <= 0:
+        return None
+    with open(victim, "rb+") as f:
+        f.truncate(vsize // 2)
+    print(
+        f"[chaos] truncated {victim} {vsize} -> {vsize // 2} bytes", flush=True
+    )
+    return victim

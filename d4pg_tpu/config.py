@@ -88,6 +88,22 @@ class TrainConfig:
     # with an initialized TPU client); "fork" starts much faster on few-core
     # hosts since children inherit the parent's imports.
     pool_start_method: str = "spawn"
+    # Supervised-pool failure handling (runtime/actor_pool.py): a worker
+    # that misses this monotonic per-step reply deadline is treated as hung
+    # — killed and restarted under jittered exponential backoff. Generous
+    # by default: a false positive costs a worker restart plus a dropped
+    # n-step window.
+    pool_step_timeout_s: float = 60.0
+    # Consecutive failures (crash/hang/failed restart) before a worker is
+    # QUARANTINED: permanently masked out of the batch (the compiled batch
+    # shape never changes; the effective batch shrinks). A completed step
+    # resets the count.
+    pool_max_worker_failures: int = 3
+    # Chaos harness (d4pg_tpu/chaos.py): seeded deterministic fault-plan
+    # spec, e.g. "seed=7;env_raise@40;worker_kill@12#1;ckpt_truncate@1".
+    # None = no injection (production). The plan is deterministic in
+    # per-site event counts, so a chaos run replays exactly.
+    chaos: Optional[str] = None
     # Where host-env collection/eval forwards run: "cpu" jits the actor on
     # the host CPU backend against published numpy params, "default" uses
     # the accelerator, "auto" picks cpu whenever the default backend is an

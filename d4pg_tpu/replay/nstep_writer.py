@@ -115,10 +115,17 @@ class BatchedNStepWriter:
         next_obs: np.ndarray,
         terminated: np.ndarray,
         truncated: np.ndarray,
+        active: np.ndarray = None,
     ) -> int:
         """Feed one synchronized pool step for all N actors; emits every
         ready/flushed n-step transition as ONE ``add_batch``. Returns the
-        number of transitions emitted."""
+        number of transitions emitted.
+
+        ``active`` (bool [N], optional) masks rows that did NOT step this
+        call (supervised-pool worker down/rejoining/quarantined): masked
+        actors' windows are untouched — their in-flight episode was
+        either already dropped whole (:meth:`drop_actor`) or resumes on a
+        later step. ``None`` means all rows stepped (the steady state)."""
         obs = np.asarray(obs)
         actions = np.asarray(actions)
         rewards = np.asarray(rewards, np.float64)
@@ -128,6 +135,38 @@ class BatchedNStepWriter:
         N, n = self.num_actors, self.n
         if self._obs_w is None:
             self._alloc(obs, actions)
+        if active is not None and not active.all():
+            # Degraded step (rare): ordered per-actor path over the live
+            # rows only — identical per-actor emission semantics, one
+            # add_batch for the whole step.
+            cols: list[tuple] = []
+            pos = (self._start + self._len) % n
+            for i in range(N):
+                if not active[i]:
+                    continue
+                self._obs_w[i, pos[i]] = obs[i]
+                self._act_w[i, pos[i]] = actions[i]
+                self._rew_w[i, pos[i]] = rewards[i]
+                self._len[i] += 1
+                if self._len[i] == n:
+                    cols.append(self._pop_front(i, next_obs[i], terminated[i]))
+                if terminated[i] or truncated[i]:
+                    while self._len[i] > 0:
+                        cols.append(
+                            self._pop_front(i, next_obs[i], terminated[i])
+                        )
+            if not cols:
+                return 0
+            self.buffer.add_batch(
+                Transition(
+                    np.stack([c[0] for c in cols]),
+                    np.stack([c[1] for c in cols]),
+                    np.asarray([c[2] for c in cols]),
+                    np.stack([c[3] for c in cols]),
+                    np.asarray([c[4] for c in cols]),
+                )
+            )
+            return len(cols)
         rows = np.arange(N)
         pos = (self._start + self._len) % n
         self._obs_w[rows, pos] = obs
@@ -194,6 +233,13 @@ class BatchedNStepWriter:
         self._start[i] = (s + 1) % self.n
         self._len[i] -= 1
         return row
+
+    def drop_actor(self, i: int) -> None:
+        """Drop actor ``i``'s in-flight window WHOLE (supervised-pool
+        worker failure): the episode tore mid-window, so emitting any of
+        it would store transitions whose tail the env never produced."""
+        self._start[i] = 0
+        self._len[i] = 0
 
     def reset(self) -> None:
         """Drop all unfinished windows (e.g. on pool restart)."""

@@ -1,22 +1,73 @@
-"""Orbax checkpointing of the FULL training state.
+"""Orbax checkpointing of the FULL training state, crash-consistently.
 
 The reference saves only actor/critic weights (``torch.save``,
 ``main.py:367-368``) with no optimizer/step/RNG state and no resume CLI
 (SURVEY.md §5). Here one checkpoint captures the entire
 :class:`~d4pg_tpu.agent.TrainState` pytree — params, targets, both Adam
 moment sets, step counter, PRNG key — so ``--resume`` is bit-exact.
+
+**Crash consistency** (docs/fault_tolerance.md): a checkpoint is several
+artifacts (the Orbax step directory, ``trainer_meta.json``, optionally
+``replay.npz``), and ``kill -9`` can land between — or inside — any of
+them. The commit record is a per-step **manifest**
+(``checkpoints/manifest_<step>.json``) holding content digests of every
+file in the Orbax step directory plus the side files, written LAST (the
+same write-ordering discipline as the keep-best contract: the attestation
+never claims bytes that are not on disk). On ``--resume``,
+:meth:`CheckpointManager.restore_verified` walks steps newest→oldest and
+restores the newest *intact* one: a step whose manifest is missing (crash
+mid-save) or whose digests mismatch (truncation/corruption — the chaos
+harness's ``ckpt_truncate`` fault) is skipped with a logged
+``checkpoint_fallback``, and a step that fails inside Orbax restore falls
+through the same way. Side-file drift (meta/replay newer than the chosen
+step: crash between meta write and manifest) is warned about but not
+fatal — those files are atomically replaced and strictly newer.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
+import shutil
 from typing import Optional
 
 import jax
 import orbax.checkpoint as ocp
 
 from d4pg_tpu.agent.state import TrainState
+
+
+# Side files (trainer_meta.json, replay.npz) above this size are recorded
+# size-only in the manifest: their mismatch is warn-only at restore, so a
+# full read-back of a multi-GB replay snapshot per checkpoint would buy a
+# log line at real learner-stall cost. Orbax step files (which GATE the
+# restore) are always content-hashed.
+SIDE_DIGEST_MAX_BYTES = 16 << 20
+
+
+def _sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def _dir_digests(root: str) -> dict:
+    """``relpath -> {sha256, size}`` for every file under ``root``,
+    deterministic order."""
+    out: dict = {}
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames.sort()
+        for fn in sorted(filenames):
+            p = os.path.join(dirpath, fn)
+            rel = os.path.relpath(p, root).replace(os.sep, "/")
+            out[rel] = {"sha256": _sha256_file(p), "size": os.path.getsize(p)}
+    return out
 
 
 class CheckpointManager:
@@ -29,15 +80,40 @@ class CheckpointManager:
         )
 
     def save(self, step: int, state: TrainState) -> None:
-        self._mgr.save(step, args=ocp.args.StandardSave(jax.device_get(state)))
+        saved = self._mgr.save(
+            step, args=ocp.args.StandardSave(jax.device_get(state))
+        )
+        if saved is False and step != self._mgr.latest_step():
+            # Orbax SILENTLY skips saves at steps older than the newest on
+            # disk — which happens exactly when a log dir holds another
+            # run's checkpoints. The old behavior was the worst failure
+            # mode: training proceeds, trainer_meta/replay keep updating,
+            # and no checkpoint ever lands. (A re-save at the CURRENT
+            # latest step — e.g. preemption right after a periodic save —
+            # is legitimately skipped: those bytes already exist.)
+            raise RuntimeError(
+                f"Orbax skipped the save at step {step}: this directory "
+                f"already holds a NEWER checkpoint (latest "
+                f"{self._mgr.latest_step()}), so it belongs to another "
+                "run — resume it with --resume, or use a fresh --log-dir"
+            )
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def all_steps(self) -> list:
+        return sorted(self._mgr.all_steps())
+
     def delete(self, step: int) -> None:
         """Remove one saved step (keep-best re-saves at a colliding step
-        after a resume — Orbax raises on save-over-existing)."""
+        after a resume — Orbax raises on save-over-existing). The step's
+        manifest goes with it: an attestation must never outlive its
+        bytes."""
         self._mgr.delete(step)
+        try:
+            os.remove(self.manifest_path(step))
+        except FileNotFoundError:
+            pass
 
     def restore(self, template: TrainState, step: Optional[int] = None) -> TrainState:
         """Restore into the structure of ``template`` (a freshly-created
@@ -49,6 +125,192 @@ class CheckpointManager:
             step, args=ocp.args.StandardRestore(jax.device_get(template))
         )
         return restored
+
+    # ----------------------------------------------------- crash consistency
+    def manifest_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"manifest_{step}.json")
+
+    def step_dir(self, step: int) -> Optional[str]:
+        """The Orbax step directory for ``step`` (the default layout is
+        ``<directory>/<step>``; fall back to scanning for prefixed or
+        zero-padded layouts)."""
+        d = os.path.join(self.directory, str(step))
+        if os.path.isdir(d):
+            return d
+        for name in sorted(os.listdir(self.directory)):
+            full = os.path.join(self.directory, name)
+            if not os.path.isdir(full):
+                continue
+            digits = "".join(ch for ch in name if ch.isdigit())
+            if digits and int(digits) == step:
+                return full
+        return None
+
+    def write_manifest(self, step: int, side_files: Optional[list] = None) -> str:
+        """Write the commit record for ``step``: digests of the finalized
+        Orbax step directory plus any side files (absolute paths; digested
+        under a separate key — mismatch there is drift, not corruption).
+        MUST be called after the save is finalized (``wait()``) and after
+        the side files landed — the manifest's existence is the claim that
+        everything it names is on disk. Also prunes manifests for steps
+        Orbax has garbage-collected (max_to_keep)."""
+        step_dir = self.step_dir(step)
+        if step_dir is None:
+            raise FileNotFoundError(
+                f"no Orbax step directory for step {step} under {self.directory}"
+            )
+        manifest = {
+            "step": step,
+            "files": _dir_digests(step_dir),
+            "side": {},
+        }
+        for p in side_files or []:
+            if os.path.exists(p):
+                size = os.path.getsize(p)
+                entry = {"size": size}
+                # Side mismatches are warn-only at restore (drift, not
+                # corruption), so a full read-back of a multi-GB replay
+                # snapshot per save buys nothing — hash only small side
+                # files (the meta), record size alone for the big ones.
+                if size <= SIDE_DIGEST_MAX_BYTES:
+                    entry["sha256"] = _sha256_file(p)
+                manifest["side"][os.path.basename(p)] = entry
+        path = self.manifest_path(step)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+        os.replace(tmp, path)
+        live = set(self._mgr.all_steps())
+        for name in os.listdir(self.directory):
+            if name.startswith("manifest_") and name.endswith(".json"):
+                try:
+                    s = int(name[len("manifest_"):-len(".json")])
+                except ValueError:
+                    continue
+                if s not in live:
+                    try:
+                        os.remove(os.path.join(self.directory, name))
+                    except FileNotFoundError:
+                        pass
+        return path
+
+    def load_manifest(self, step: int) -> Optional[dict]:
+        try:
+            with open(self.manifest_path(step)) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+        except (OSError, ValueError) as e:
+            print(f"[checkpoint] unreadable manifest for step {step}: {e}")
+            return None
+
+    def verify_step(self, step: int) -> tuple:
+        """``(ok, why, side_warnings)``: digest-check the step's Orbax files
+        against its manifest. No manifest = unattested (the save never
+        committed). Side-file mismatches come back as warnings, not
+        failures — meta/replay are atomically replaced and may legitimately
+        postdate the step by one crashed save."""
+        m = self.load_manifest(step)
+        if m is None:
+            return False, "no manifest (save did not commit)", []
+        step_dir = self.step_dir(step)
+        if step_dir is None:
+            return False, "manifest exists but step directory is gone", []
+        for rel, want in m.get("files", {}).items():
+            p = os.path.join(step_dir, rel)
+            if not os.path.exists(p):
+                return False, f"missing file {rel}", []
+            if os.path.getsize(p) != want["size"]:
+                return (
+                    False,
+                    f"{rel}: size {os.path.getsize(p)} != {want['size']} "
+                    "(truncated?)",
+                    [],
+                )
+            if _sha256_file(p) != want["sha256"]:
+                return False, f"{rel}: content digest mismatch", []
+        warnings = []
+        ckpt_parent = os.path.dirname(self.directory)
+        for base, want in m.get("side", {}).items():
+            for cand in (
+                os.path.join(self.directory, base),
+                os.path.join(ckpt_parent, base),
+            ):
+                if os.path.exists(cand):
+                    if os.path.getsize(cand) != want["size"] or (
+                        "sha256" in want
+                        and _sha256_file(cand) != want["sha256"]
+                    ):
+                        warnings.append(
+                            f"{base} differs from the step-{step} manifest "
+                            "(a newer save's side file; proceeding with the "
+                            "current one)"
+                        )
+                    break
+            else:
+                warnings.append(f"side file {base} is missing")
+        return True, "ok", warnings
+
+    def restore_verified(self, template: TrainState) -> tuple:
+        """Restore the newest INTACT step: ``(state, step, fallbacks)``.
+
+        Walks steps newest→oldest; skips any step whose manifest is
+        missing/mismatched, and any step Orbax itself fails to restore.
+        ``fallbacks`` lists one reason per skipped step (log them — each is
+        a ``checkpoint_fallback`` event). Runs that predate manifests
+        (no manifest for ANY step) restore best-effort newest-first."""
+        steps = sorted(self._mgr.all_steps(), reverse=True)
+        if not steps:
+            raise FileNotFoundError(f"no checkpoint in {self.directory}")
+        attested_any = any(
+            os.path.exists(self.manifest_path(s)) for s in steps
+        )
+        fallbacks = []
+        for step in steps:
+            if attested_any:
+                ok, why, warnings = self.verify_step(step)
+                if not ok:
+                    fallbacks.append(f"step {step}: {why}")
+                    continue
+                for w in warnings:
+                    print(f"[checkpoint] step {step}: {w}")
+            try:
+                state = self.restore(template, step)
+            except FileNotFoundError:
+                raise
+            except Exception as e:
+                # Orbax raises a zoo of types on partial/corrupt steps; any
+                # of them means "this step is not intact" — fall back to
+                # the next-older one, loudly.
+                fallbacks.append(f"step {step}: restore failed: {e!r}")
+                print(f"[checkpoint] step {step} failed to restore ({e!r}); "
+                      "falling back")
+                continue
+            # Prune every SKIPPED newer step: they are dead branches
+            # (uncommitted or corrupt), and leaving them would make the
+            # resumed run's next save at that step collide (Orbax raises
+            # on save-over-existing) and keep latest_step() lying.
+            for bad in [s for s in steps if s > step]:
+                print(f"[checkpoint] pruning non-intact step {bad}")
+                try:
+                    self.delete(bad)
+                except Exception as e:
+                    # a half-written step can confuse Orbax's own delete;
+                    # fall back to removing the bytes directly
+                    print(f"[checkpoint] orbax delete({bad}) failed ({e!r}); "
+                          "removing the step directory")
+                    d = self.step_dir(bad)
+                    if d is not None:
+                        shutil.rmtree(d, ignore_errors=True)
+                    try:
+                        os.remove(self.manifest_path(bad))
+                    except FileNotFoundError:
+                        pass
+            return state, step, fallbacks
+        raise RuntimeError(
+            f"no intact checkpoint under {self.directory}: "
+            + "; ".join(fallbacks)
+        )
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()
@@ -108,8 +370,22 @@ def invalidate_best_eval(log_dir: str) -> None:
 
 
 def load_trainer_meta(log_dir: str) -> dict:
+    """The resume-side counters, or ``{}`` when absent — INCLUDING when the
+    file exists but does not parse. The write side is atomic
+    (tmp+rename), but the directory can still hold garbage after disk
+    faults or a mid-write ``kill -9`` on filesystems without atomic
+    rename durability; resume must degrade (fresh counters, full noise
+    schedule) instead of dying in ``json.load``."""
     path = trainer_meta_path(log_dir)
     if not os.path.exists(path):
         return {}
-    with open(path) as f:
-        return json.load(f)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (ValueError, OSError) as e:
+        print(
+            f"[checkpoint] {path} is unreadable/corrupt ({e}); treating "
+            "trainer meta as missing — env-step counters and normalizer "
+            "stats restart fresh"
+        )
+        return {}

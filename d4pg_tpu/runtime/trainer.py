@@ -31,6 +31,7 @@ import os
 import queue
 import threading
 import time
+import zipfile
 from collections import deque
 from typing import Optional
 
@@ -65,6 +66,7 @@ from d4pg_tpu.runtime.checkpoint import (
     load_trainer_meta,
     save_best_eval,
     save_trainer_meta,
+    trainer_meta_path,
 )
 from d4pg_tpu.runtime.evaluator import evaluate
 from d4pg_tpu.runtime.metrics import MetricsLogger, interval_crossed
@@ -206,6 +208,10 @@ class Trainer:
         # single-writer (evaluator thread, requests processed in order);
         # learner-thread readers are documented one-eval-stale tolerant
         "ewma_return", "_best_eval", "_last_eval_row", "_last_eval_ev",
+        # per-actor HER writer slots: rebuilt (on worker drop) and used
+        # only by the collection path, which runs on exactly one thread
+        # (learner in sync mode, collector in async mode)
+        "her_writers",
     )
 
     def __init__(self, config: TrainConfig):
@@ -357,6 +363,19 @@ class Trainer:
                 f"got {config.transfer_dtype!r}"
             )
 
+        # Chaos harness (--chaos, d4pg_tpu/chaos): a seeded deterministic
+        # fault plan. Sites owned by the trainer: wb_stall (flusher wake),
+        # ckpt_truncate (after a save commits); the pool owns worker_kill
+        # and ships env_raise/env_hang entries into its workers.
+        self._chaos = None
+        if getattr(config, "chaos", None):
+            from d4pg_tpu.chaos import ChaosInjector, ChaosPlan
+
+            self._chaos = ChaosInjector(ChaosPlan.parse(config.chaos))
+        # checkpoint_fallback count from resume (restore_verified skipped
+        # corrupt/uncommitted steps); surfaces in every metrics row.
+        self._ckpt_fallbacks = 0
+
         # Runtime invariant guards (--debug-guards, d4pg_tpu/analysis):
         # recompile sentinel on every jitted entry point (train step budget
         # pinned after the first dispatch, checked at eval crossings and at
@@ -417,7 +436,17 @@ class Trainer:
         self._preempt_requested = threading.Event()
         self._replay_restored = False
         if config.resume and self.ckpt.latest_step() is not None:
-            self.state = self.ckpt.restore(self.state)
+            # Verified restore: the newest INTACT step wins. A kill -9 that
+            # landed mid-save (no manifest) or corruption caught by the
+            # manifest digests (chaos ckpt_truncate) falls back to the
+            # next-older attested step instead of dying on partial bytes.
+            self.state, restored_step, fallbacks = self.ckpt.restore_verified(
+                self.state
+            )
+            self._ckpt_fallbacks = len(fallbacks)
+            for fb in fallbacks:
+                print(f"[checkpoint] fallback: {fb}")
+            print(f"[checkpoint] resumed from step {restored_step}")
             self.grad_steps = int(jax.device_get(self.state.step))
             m = load_trainer_meta(config.log_dir)
             # env_steps drives the noise-decay schedule; without it a
@@ -452,9 +481,19 @@ class Trainer:
                     pass  # corrupt best file: start fresh, never crash
             snap = self._replay_snapshot_path()
             if config.snapshot_replay and os.path.exists(snap):
-                n = self.buffer.restore(snap)
-                self._replay_restored = True
-                print(f"restored replay snapshot: {n} transitions")
+                try:
+                    n = self.buffer.restore(snap)
+                    self._replay_restored = True
+                    print(f"restored replay snapshot: {n} transitions")
+                except (OSError, ValueError, KeyError, zipfile.BadZipFile) as e:
+                    # A torn/corrupt snapshot must degrade (repay warmup
+                    # with fresh collection), never kill the resume — the
+                    # whole point of surviving kill -9 at any instant.
+                    print(
+                        f"[checkpoint] replay snapshot {snap} unreadable "
+                        f"({e}); resuming with an empty buffer (warmup "
+                        "will be repaid)"
+                    )
 
         self._rng = np.random.default_rng(config.seed)
         self._noise_init, self._noise_sample, self._noise_reset = make_noise(agent_cfg)
@@ -714,6 +753,9 @@ class Trainer:
             start_method=cfg.pool_start_method,
             action_repeat=cfg.action_repeat,
             ledger=self._ledger,
+            step_timeout_s=cfg.pool_step_timeout_s,
+            max_worker_failures=cfg.pool_max_worker_failures,
+            chaos=self._chaos,
         )
         self.has_pool = True
         # One N-wide writer: vectorized window append + ONE add_batch per
@@ -780,9 +822,28 @@ class Trainer:
                     obs2, rews, terms, truncs, pol_obs, _succ, _rep = (
                         self.pool.step(actions)
                     )
+            # Supervision aftermath: rows the pool masked out did not step
+            # (worker hung/crashed/quarantined — the batch SHAPE is
+            # compiled, so the effective batch shrinks via the mask);
+            # actors that failed mid-window get their in-flight n-step
+            # state dropped WHOLE so no torn transition reaches replay.
+            stepped = self.pool.stepped_mask
+            all_stepped = bool(stepped.all())
+            dropped = self.pool.take_dropped()
+            for i in dropped:
+                if cfg.her:
+                    # recreate the hindsight writer: its episode buffer
+                    # holds a torn episode that must never relabel/flush
+                    self.her_writers[i] = self._make_her_writer(
+                        self._her_reward_fn
+                    )
+                else:
+                    self.batched_writer.drop_actor(i)
             if cfg.her:
                 with self._timers.stage("replay_insert"):
                     for i in range(N):
+                        if not stepped[i]:
+                            continue
                         self.her_writers[i].add(
                             observation=g_prev[i][0],
                             achieved_goal=g_prev[i][1],
@@ -804,15 +865,21 @@ class Trainer:
                 with self._timers.stage("replay_insert"):
                     with self._buffer_lock:
                         self.batched_writer.add_batch(
-                            self._pool_obs, actions, rews, obs2, terms, truncs
+                            self._pool_obs, actions, rews, obs2, terms, truncs,
+                            active=None if all_stepped else stepped,
                         )
             done = terms | truncs
+            if dropped:
+                # Restarted/ dropped actors start a fresh episode: give
+                # them fresh exploration noise alongside the done rows.
+                done = done.copy()
+                done[dropped] = True
             if done.any():
                 self._pool_noise = self._pool_reset_noise(
                     self._pool_noise, np.asarray(done)
                 )
             self._pool_obs = pol_obs
-            self.env_steps += N
+            self.env_steps += int(stepped.sum()) if not all_stepped else N
 
     # ----------------------------------------------------------------- async
     def _publish_params(self):
@@ -890,6 +957,13 @@ class Trainer:
         try:
             while True:
                 item = self._wb_queue.get()
+                if self._chaos is not None:
+                    # Chaos wb_stall: a slow flusher must only SLOW the
+                    # guarded learner (hold pacing), never trip the ledger
+                    # or drop updates — this fault proves that.
+                    e = self._chaos.tick("wb_stall")
+                    if e is not None:
+                        time.sleep(e.arg if e.arg is not None else 0.5)
                 stop = item is None
                 items = [] if stop else [item]
                 while True:
@@ -1017,6 +1091,9 @@ class Trainer:
             reward_fn = env.compute_reward
         else:
             raise ValueError(f"--her needs a goal env, got {cfg.env}")
+        # Kept for supervised-pool recovery: a failed worker's hindsight
+        # writer is recreated (its buffered episode tore mid-flight).
+        self._her_reward_fn = reward_fn
         if getattr(env, "is_goal_env", False) and (
             cfg.num_envs > 1 or cfg.async_collect
         ):
@@ -1607,6 +1684,24 @@ class Trainer:
             self._drain_writeback()
             with annotate("host/replay_snapshot"):
                 self.buffer.snapshot(self._replay_snapshot_path())
+        # Commit record LAST (write-ordering mirrors the best_eval
+        # contract): the manifest digests everything this save produced, so
+        # a kill -9 anywhere above leaves the step unattested and
+        # restore_verified falls back to the previous intact one.
+        side = [trainer_meta_path(self.config.log_dir)]
+        if self.config.snapshot_replay:
+            side.append(self._replay_snapshot_path())
+        self.ckpt.write_manifest(self.grad_steps, side_files=side)
+        if self._chaos is not None:
+            e = self._chaos.tick("ckpt_truncate")
+            if e is not None:
+                # Corrupt the COMMITTED step: proves verify-on-restore
+                # catches bit-rot/truncation the manifest attests against.
+                from d4pg_tpu.chaos import truncate_checkpoint_step
+
+                sd = self.ckpt.step_dir(self.grad_steps)
+                if sd is not None:
+                    truncate_checkpoint_step(sd)
 
     def _write_back(self, pending) -> None:
         """Flush one dispatch's PER priorities: ([B] idx, [B] pri) for K=1,
@@ -1644,6 +1739,13 @@ class Trainer:
             )
         obs = self._eval_pool.reset_all()
         alive = np.ones(n, bool)
+        # An eval worker that crashes/hangs mid-episode is restarted by the
+        # pool's supervisor, but its episode is TORN (rewards from two
+        # different episodes must never sum into one return): mark it
+        # invalid and exclude it from the stats below, rather than the old
+        # behavior (wedge/raise) or the naive one (silently averaging a
+        # corrupt return into keep-best).
+        valid = np.ones(n, bool)
         rets = np.zeros(n, np.float64)
         ep_success = np.zeros(n, bool)
         any_reported = False
@@ -1653,6 +1755,18 @@ class Trainer:
         for _ in range(cfg.max_episode_steps or 1000):
             a = np.asarray(eval_act(eval_params, self._norm_obs(np.asarray(obs))))
             obs2, r, term, trunc, pol_obs, s, s_rep = self._eval_pool.step(a)
+            self._eval_pool.take_dropped()  # no writers here; keep it drained
+            failed_now = alive & ~self._eval_pool.stepped_mask
+            if failed_now.any():
+                valid &= ~failed_now
+                alive &= ~failed_now
+                print(
+                    f"[eval] dropped {int(failed_now.sum())} episode(s): "
+                    "eval worker failed mid-episode (restarted; torn "
+                    "returns excluded from the stats)"
+                )
+                if not alive.any():
+                    break
             rets += r * alive
             # final-step semantics, matching the single-env path: the
             # episode's success is is_success at its last step — ONLY where
@@ -1662,17 +1776,22 @@ class Trainer:
             # (VERDICT round-2 weak #1: Humanoid logged success 1.0).
             done_now = (term | trunc) & alive
             ep_success = np.where(done_now, s & s_rep, ep_success)
-            any_reported |= bool((done_now & s_rep).any())
+            any_reported |= bool((done_now & s_rep & valid).any())
             alive &= ~(term | trunc)
             obs = pol_obs
             if not alive.any():
                 break
+        if not valid.any():
+            raise RuntimeError(
+                "every eval episode was lost to eval-pool worker failures; "
+                "no return to report"
+            )
         out = {
-            "eval_return_mean": float(rets.mean()),
-            "eval_return_std": float(rets.std()),
+            "eval_return_mean": float(rets[valid].mean()),
+            "eval_return_std": float(rets[valid].std()),
         }
         if any_reported:
-            out["success_rate"] = float(ep_success.mean())
+            out["success_rate"] = float(ep_success[valid].mean())
         return out
 
     def _get_eval_act(self):
@@ -1956,6 +2075,18 @@ class Trainer:
                 "env_steps": self.env_steps,
             }
         )
+        # Self-healing observability: supervisor + chaos + fallback counters
+        # ride every row (docs/fault_tolerance.md has the event table).
+        if self.has_pool:
+            scalars["pool_worker_failures"] = float(self.pool.failures_total)
+            scalars["pool_worker_restarts"] = float(self.pool.restarts_total)
+            scalars["pool_workers_quarantined"] = float(
+                self.pool.num_quarantined()
+            )
+        if self._ckpt_fallbacks:
+            scalars["checkpoint_fallbacks"] = float(self._ckpt_fallbacks)
+        if self._chaos is not None:
+            scalars["chaos_injections"] = float(self._chaos.injections_total)
         if not self.is_jax_env and cfg.concurrent_eval:
             # Evaluator-thread path: hand off a param copy; logging/print
             # happen in _apply_eval when the eval completes. Return the

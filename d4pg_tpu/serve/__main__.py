@@ -45,6 +45,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--log-dir", default=None,
                    help="append serve metrics rows (metrics.jsonl) here")
     p.add_argument("--metrics-interval", type=float, default=30.0)
+    p.add_argument("--chaos", default=None, metavar="PLAN",
+                   help="deterministic fault injection (d4pg_tpu/chaos.py): "
+                        "e.g. 'sock_reset@5' force-resets the serving "
+                        "connection at its 5th frame — proves reader/reply "
+                        "paths survive abrupt client death")
     p.add_argument("--debug-guards", action="store_true",
                    help="runtime invariant guards (d4pg_tpu/analysis): "
                         "staging ledger on the batcher's slot rotation, "
@@ -59,6 +64,11 @@ def main(argv=None) -> None:
     from d4pg_tpu.serve.bundle import load_bundle
     from d4pg_tpu.serve.server import PolicyServer
 
+    chaos = None
+    if args.chaos:
+        from d4pg_tpu.chaos import ChaosInjector, ChaosPlan
+
+        chaos = ChaosInjector(ChaosPlan.parse(args.chaos))
     bundle = load_bundle(args.bundle)
     server = PolicyServer(
         bundle,
@@ -74,6 +84,7 @@ def main(argv=None) -> None:
         log_dir=args.log_dir,
         metrics_interval_s=args.metrics_interval,
         debug_guards=args.debug_guards,
+        chaos=chaos,
     )
 
     install_graceful_signals(

@@ -69,10 +69,10 @@ def load_best_actor_params(run_dir: str, config):
 
 class PolicyServer:
     # d4pglint shared-mutable-state: the reload watcher thread is the ONLY
-    # writer of all three after start() (check_reload is watcher-only);
+    # writer of all four after start() (check_reload is watcher-only);
     # readers (healthz, conn threads) take atomic reference snapshots and
     # tolerate being one reload stale.
-    _THREAD_SAFE = ("bundle", "_bundle_mtime", "_best_mtime")
+    _THREAD_SAFE = ("bundle", "_bundle_mtime", "_best_mtime", "_last_reload")
 
     def __init__(
         self,
@@ -90,6 +90,7 @@ class PolicyServer:
         log_dir: Optional[str] = None,
         metrics_interval_s: float = 30.0,
         debug_guards: bool = False,
+        chaos=None,
     ):
         self.bundle = bundle
         self.host = host
@@ -122,6 +123,15 @@ class PolicyServer:
             guard_transfers=debug_guards,
         )
         self.stats = self.batcher.stats
+        # Chaos harness (ChaosInjector or None): the sock_reset site ticks
+        # once per received frame and force-resets the connection — proves
+        # the reader/reply paths survive abrupt client death end-to-end.
+        self._chaos = chaos
+        # Degraded-state surface for healthz: outcome of the most recent
+        # hot-reload attempt (None until one happens). A failed reload
+        # means the server is healthy but serving older params — operators
+        # alert on it without grepping logs.
+        self._last_reload: Optional[str] = None
         self._watch_run = watch_run
         self._watch_bundle = watch_bundle and bundle.path is not None
         self._poll_interval_s = poll_interval_s
@@ -278,6 +288,7 @@ class PolicyServer:
                     self.batcher.set_obs_norm(fresh.obs_norm)
                     self.bundle = fresh
                     swapped = True
+                    self._last_reload = "ok: bundle"
                     print(f"[serve] reloaded bundle {self.bundle.path}")
                 except Exception as e:
                     # ANY load/validation failure (a malformed bundle.json
@@ -285,6 +296,7 @@ class PolicyServer:
                     # ValueError) means: keep serving the old params. The
                     # mtime bookmark still advances below, so a bad export
                     # logs once instead of retrying every poll forever.
+                    self._last_reload = f"failed: {e}"
                     print(f"[serve] bundle reload failed (serving old params): {e}")
                 self._bundle_mtime = m
         if self._watch_run:
@@ -300,10 +312,12 @@ class PolicyServer:
                     )
                     self.batcher.set_params(params)
                     swapped = True
+                    self._last_reload = "ok: best_actor.npz"
                     print(
                         f"[serve] reloaded best_actor.npz from {self._watch_run}"
                     )
                 except Exception as e:  # same contract as the bundle branch
+                    self._last_reload = f"failed: {e}"
                     print(f"[serve] run-dir reload failed (serving old params): {e}")
                 self._best_mtime = m
         return swapped
@@ -389,6 +403,23 @@ class PolicyServer:
                 frame = protocol.read_frame(rfile)
                 if frame is None:
                     return  # clean EOF
+                if self._chaos is not None:
+                    e = self._chaos.tick("sock_reset")
+                    if e is not None:
+                        # Abortive close (RST on real stacks): the peer —
+                        # and any reply in flight — sees a reset, exactly
+                        # the disconnect-mid-request fault class. The
+                        # OSError lands in the handler below; the server
+                        # must keep serving every other connection.
+                        try:
+                            conn.setsockopt(
+                                socket.SOL_SOCKET, socket.SO_LINGER,
+                                struct.pack("ii", 1, 0),
+                            )
+                        except OSError:
+                            pass
+                        conn.close()
+                        raise OSError("chaos: injected socket reset")
                 msg_type, req_id, payload = frame
                 if msg_type == protocol.HEALTHZ:
                     reply(
@@ -449,7 +480,23 @@ class PolicyServer:
     # ----------------------------------------------------------------- status
     def healthz(self) -> dict:
         snap = self.stats.snapshot()
-        snap["status"] = "draining" if self._shutdown.is_set() else "ok"
+        # Degraded-state contract: "draining" once shutdown is requested;
+        # "degraded" while healthy-but-stale (the last hot-reload attempt
+        # failed, so traffic is served on older params); "ok" otherwise.
+        # (No quarantined-worker field: serving has no worker pool — the
+        # single device thread either lives or the process is down.)
+        last_reload = self._last_reload
+        if self._shutdown.is_set():
+            status = "draining"
+        elif last_reload is not None and last_reload.startswith("failed"):
+            status = "degraded"
+        else:
+            status = "ok"
+        snap["status"] = status
+        snap["draining"] = self._shutdown.is_set()
+        snap["last_reload"] = last_reload
+        if self._chaos is not None:
+            snap["chaos_injections"] = self._chaos.injections_total
         snap["queue_depth"] = self.batcher.queue_depth
         snap["compile_count"] = self.batcher.compile_count
         snap["buckets"] = list(self.batcher.buckets)

@@ -1,0 +1,109 @@
+#!/usr/bin/env bash
+# Chaos soak: the self-healing contracts end to end through the REAL CLIs
+# (docs/fault_tolerance.md). The loop:
+#
+#   1. train under injected faults (env crash + worker SIGKILL + flusher
+#      stall) with --debug-guards — must exit 0 with restarts logged;
+#   2. start a checkpointing run, kill -9 it at a random instant;
+#   3. --resume — must come back rc 0 on the newest intact step (the
+#      crash-consistency manifest contract), finishing the step budget;
+#   4. export a bundle and serve it under an injected client socket
+#      reset — server must answer before AND after, then drain on
+#      SIGTERM with exit 0.
+#
+# Knobs (env vars): SOAK_DIR (default mktemp), SOAK_ENV (Pendulum-v1),
+# SOAK_STEPS (grad steps per leg, default 6), SOAK_HIDDEN (16,16),
+# SOAK_KILL_DELAY_MAX (seconds after first commit, default 2).
+# Exits non-zero on the first broken contract.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DIR=${SOAK_DIR:-$(mktemp -d /tmp/chaos_soak.XXXXXX)}
+ENV_ID=${SOAK_ENV:-Pendulum-v1}
+STEPS=${SOAK_STEPS:-6}
+HIDDEN=${SOAK_HIDDEN:-16,16}
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+
+common=(--env "$ENV_ID" --hidden-sizes "$HIDDEN" --warmup 24 --bsize 8
+        --rmsize 512 --eval-interval 100000 --num-envs 2
+        --pool-start-method fork --snapshot-replay)
+
+echo "[chaos-soak] dir: $DIR"
+
+# ---- leg 1: train THROUGH injected faults, guards on -----------------------
+python train.py "${common[@]}" --log-dir "$DIR/faulty" \
+  --total-steps "$STEPS" --checkpoint-interval "$STEPS" \
+  --debug-guards --async-writeback --pool-step-timeout 15 \
+  --chaos "seed=3;env_raise@5#0;worker_kill@9#1;wb_stall@1:0.2" \
+  | tee "$DIR/faulty.log"
+grep -q "worker_restart" "$DIR/faulty.log" \
+  || { echo "CHAOS_SOAK_FAIL: no worker restart under injected faults"; exit 1; }
+
+# ---- leg 2: kill -9 a checkpointing run at a random instant ----------------
+python train.py "${common[@]}" --log-dir "$DIR/killed" \
+  --total-steps 100000 --checkpoint-interval 4 \
+  > "$DIR/killed.log" 2>&1 &
+PID=$!
+CKPT="$DIR/killed/checkpoints"
+for _ in $(seq 1 600); do
+  compgen -G "$CKPT/manifest_*.json" > /dev/null && break
+  kill -0 "$PID" 2>/dev/null || { cat "$DIR/killed.log"; echo "CHAOS_SOAK_FAIL: run died before first commit"; exit 1; }
+  sleep 0.5
+done
+compgen -G "$CKPT/manifest_*.json" > /dev/null \
+  || { echo "CHAOS_SOAK_FAIL: no checkpoint committed"; exit 1; }
+# randomized instant within the next interval: mid-save, mid-snapshot, between
+sleep "0.$((RANDOM % 100))"; sleep "$((RANDOM % ${SOAK_KILL_DELAY_MAX:-2}))"
+kill -9 "$PID" || true
+wait "$PID" 2>/dev/null || true
+echo "[chaos-soak] killed training at a random instant"
+
+# ---- leg 3: resume must restore the newest intact step ---------------------
+NEWEST=$(ls "$CKPT"/manifest_*.json | sed 's/.*manifest_\([0-9]*\).json/\1/' | sort -n | tail -1)
+python train.py "${common[@]}" --log-dir "$DIR/killed" --resume \
+  --total-steps $((NEWEST + 4)) --checkpoint-interval 4 \
+  | tee "$DIR/resume.log"
+grep -q "\[checkpoint\] resumed from step" "$DIR/resume.log" \
+  || { echo "CHAOS_SOAK_FAIL: resume did not report its restored step"; exit 1; }
+
+# ---- leg 4: serve the survivor under an injected socket reset --------------
+python train.py --env "$ENV_ID" --hidden-sizes "$HIDDEN" \
+  --log-dir "$DIR/killed" --export-bundle "$DIR/bundle"
+python - "$DIR/bundle" <<'EOF'
+import signal, subprocess, sys, numpy as np
+bundle = sys.argv[1]
+proc = subprocess.Popen(
+    [sys.executable, "-m", "d4pg_tpu.serve", "--bundle", bundle,
+     "--port", "0", "--max-batch", "8", "--max-wait-us", "500",
+     "--chaos", "sock_reset@2"],
+    stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+)
+port = None
+for line in proc.stdout:
+    sys.stdout.write("[server] " + line)
+    if "listening on" in line:
+        port = int(line.split(":")[1].split()[0])
+        break
+assert port, "server never reported its port"
+from d4pg_tpu.serve.client import PolicyClient
+obs = np.array([0.1, -0.2, 0.05], np.float32)
+with PolicyClient("127.0.0.1", port) as c:
+    assert c.act(obs).shape == (1,)      # frame 1: served
+    try:
+        c.act(obs)                       # frame 2: injected reset
+        raise SystemExit("CHAOS_SOAK_FAIL: injected reset never fired")
+    except Exception:
+        pass
+with PolicyClient("127.0.0.1", port) as c:   # server survived the reset
+    assert c.act(obs).shape == (1,)
+    h = c.healthz()
+    assert h.get("chaos_injections") == 1, h
+proc.send_signal(signal.SIGTERM)
+tail = proc.stdout.read()
+sys.stdout.write("[server] " + tail)
+rc = proc.wait(timeout=120)
+assert rc == 0 and "drained" in tail, (rc, tail)
+print("CHAOS_SOAK_SERVE_OK")
+EOF
+
+echo "CHAOS_SOAK_OK"

@@ -6,6 +6,7 @@ interface, and the background-collector mode where the learner and actors
 run concurrently against published params.
 """
 
+import os
 import subprocess
 import sys
 
@@ -296,6 +297,49 @@ class TestTrainerPool:
                 t.train()
         finally:
             t.close()
+
+
+def test_orphaned_workers_exit_when_parent_dies():
+    """Satellite bugfix (ISSUE-5): pool workers used to block forever in
+    conn.recv() when the parent died, stranding N gymnasium children.
+    Now the worker polls with a timeout and exits once the parent is
+    gone. Simulated with a subprocess parent that os._exit()s without
+    closing — the hard-death path where no cleanup runs."""
+    import time
+
+    probe = (
+        "import os\n"
+        "from d4pg_tpu.runtime.actor_pool import HostActorPool\n"
+        "pool = HostActorPool('Pendulum-v1', 2, max_episode_steps=20,\n"
+        "                     seed=0, start_method='fork')\n"
+        "pool.reset_all(seed=0)\n"
+        "print(' '.join(str(p.pid) for p in pool._procs), flush=True)\n"
+        "os._exit(0)  # die without close(): workers must self-terminate\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", probe], capture_output=True, text=True,
+        timeout=180,
+    )
+    assert out.returncode == 0, out.stderr
+    pids = [int(x) for x in out.stdout.split()]
+    assert len(pids) == 2
+
+    def alive(pid):
+        try:
+            os.kill(pid, 0)
+            return True
+        except ProcessLookupError:
+            return False
+        except PermissionError:
+            return True
+
+    deadline = time.monotonic() + 30  # worker poll period is 1 s
+    while time.monotonic() < deadline and any(alive(p) for p in pids):
+        time.sleep(0.5)
+    leaked = [p for p in pids if alive(p)]
+    for p in leaked:  # clean up before failing the assertion
+        os.kill(p, 9)
+    assert not leaked, f"orphaned pool workers leaked: {leaked}"
 
 
 def test_gym_adapter_imports_without_jax():

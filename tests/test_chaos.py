@@ -1,0 +1,330 @@
+"""Chaos harness: deterministic plan parsing/injection, and the recovery
+paths each fault class proves (ISSUE-5 acceptance table):
+
+- ``env_raise`` / ``worker_kill`` → supervisor detects, restarts under
+  backoff, masks the rows, drops torn windows (fast pool smoke — the
+  tier-1 chaos gate);
+- ``env_hang`` → the monotonic step deadline fires, the hung worker is
+  killed and restarted (fast);
+- quarantine after K consecutive failures, all-quarantined → loud error
+  (fast);
+- the full train-loop integration (wb_stall + env_raise + worker_kill
+  under ``--debug-guards``: run completes, zero guard trips, zero leaked
+  holds, learner takes every budgeted step) is slow-marked.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from d4pg_tpu.chaos import (
+    ChaosEntry,
+    ChaosInjector,
+    ChaosPlan,
+    truncate_checkpoint_step,
+)
+
+gym = pytest.importorskip("gymnasium")
+
+ENV = "Pendulum-v1"
+
+
+# ------------------------------------------------------------------ the plan
+def test_plan_parse_full_syntax():
+    p = ChaosPlan.parse(
+        "seed=7; env_raise@40 ; env_hang@60:30#0, worker_kill@12#1;"
+        "ckpt_truncate@1;wb_stall@3:0.5;sock_reset@5"
+    )
+    assert p.seed == 7
+    sites = [e.site for e in p.entries]
+    assert sites == [
+        "env_raise", "env_hang", "worker_kill", "ckpt_truncate",
+        "wb_stall", "sock_reset",
+    ]
+    assert p.entries[1] == ChaosEntry("env_hang", 60, 30.0, 0)
+    assert p.entries[2] == ChaosEntry("worker_kill", 12, None, 1)
+
+
+@pytest.mark.parametrize(
+    "bad", ["boom@3", "env_raise@zero", "env_raise@0", "env_raise", "@3"]
+)
+def test_plan_parse_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        ChaosPlan.parse(bad)
+
+
+def test_plan_parse_rejects_duplicate_site_count():
+    """The injector keys on (site, count): a duplicate would silently
+    shadow one planned fault — the parse refuses instead."""
+    with pytest.raises(ValueError, match="duplicate"):
+        ChaosPlan.parse("worker_kill@5#0;worker_kill@5#1")
+    # same count at DIFFERENT sites is fine
+    ChaosPlan.parse("worker_kill@5#0;env_raise@5#1")
+
+
+def test_resolve_actors_deterministic_and_bounded():
+    p = ChaosPlan.parse("seed=7;env_raise@40;env_hang@9#1")
+    r1, r2 = p.resolve_actors(4), p.resolve_actors(4)
+    assert r1 == r2  # resolution is a pure function of (seed, count)
+    assert r1.entries[0].actor == (7 + 40) % 4
+    assert r1.entries[1].actor == 1  # explicit actor untouched
+    with pytest.raises(ValueError, match="targets actor"):
+        ChaosPlan.parse("env_raise@4#9").resolve_actors(2)
+
+
+def test_worker_entries_ship_only_that_workers_faults():
+    p = ChaosPlan.parse("env_raise@4#0;env_hang@6:2#1;worker_kill@2#0")
+    assert p.worker_entries(0) == (("env_raise", 4, None),)
+    assert p.worker_entries(1) == (("env_hang", 6, 2.0),)  # kill is parent-side
+
+
+def test_injector_fires_each_entry_exactly_once():
+    inj = ChaosInjector(ChaosPlan.parse("wb_stall@3;wb_stall@5:0.1"))
+    fired = [inj.tick("wb_stall") for _ in range(8)]
+    hits = [(i + 1) for i, e in enumerate(fired) if e is not None]
+    assert hits == [3, 5]
+    assert inj.injections_total == 2
+    assert inj.summary() == {"chaos_injections": 2, "chaos_pending": 0}
+    assert inj.tick("sock_reset") is None  # foreign sites never misfire
+
+
+def test_truncate_checkpoint_step_halves_largest_file(tmp_path):
+    d = tmp_path / "step"
+    (d / "sub").mkdir(parents=True)
+    (d / "small.bin").write_bytes(b"x" * 10)
+    (d / "sub" / "big.bin").write_bytes(b"y" * 1000)
+    victim = truncate_checkpoint_step(str(d))
+    assert victim.endswith("big.bin")
+    assert os.path.getsize(victim) == 500
+    assert os.path.getsize(d / "small.bin") == 10
+    assert truncate_checkpoint_step(str(tmp_path / "empty")) is None
+
+
+# --------------------------------------------------- fast pool chaos smoke
+def _drive(pool, steps, sleep_s=0.02, act_dim=1):
+    """Random-action stepping loop collecting supervision outcomes."""
+    rng = np.random.default_rng(0)
+    masked, dropped = 0, []
+    for _ in range(steps):
+        a = rng.uniform(-1, 1, (pool.num_actors, act_dim)).astype(np.float32)
+        pool.step(a)
+        if not pool.stepped_mask.all():
+            masked += 1
+        dropped += pool.take_dropped()
+        time.sleep(sleep_s)
+    return masked, dropped
+
+
+def test_chaos_smoke_worker_crash_and_kill_recover():
+    """The tier-1 chaos gate: an env exception and a SIGKILL both surface
+    as supervised failures — the pool masks the rows, drops the torn
+    windows, restarts both workers, quarantines neither, and keeps
+    stepping (no hang, no batch-shape change)."""
+    from d4pg_tpu.runtime.actor_pool import HostActorPool
+
+    inj = ChaosInjector(ChaosPlan.parse("seed=0;env_raise@3#0;worker_kill@6#1"))
+    pool = HostActorPool(
+        ENV, 2, max_episode_steps=50, seed=0, start_method="fork",
+        step_timeout_s=10.0, max_worker_failures=3, chaos=inj,
+    )
+    try:
+        obs = pool.reset_all(seed=0)
+        assert obs.shape == (2, 3)
+        masked, dropped = _drive(pool, 40)
+        assert inj.injections_total == 1  # worker_kill (env_raise is in-child)
+        assert pool.failures_total >= 2  # one crash + one kill, both detected
+        assert sorted(set(dropped)) == [0, 1]  # torn windows surfaced
+        assert masked >= 2  # rows were masked while workers were down
+        assert pool.restarts_total >= 2
+        assert pool.num_quarantined() == 0
+        # both workers rejoined: a late step is full-width again
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            _drive(pool, 1)
+            if pool.stepped_mask.all():
+                break
+        assert pool.stepped_mask.all(), "workers never rejoined the batch"
+    finally:
+        pool.close()
+
+
+def test_chaos_env_hang_hits_step_deadline():
+    """A hung env must not wedge the parent in conn.recv forever (the old
+    behavior): the monotonic step deadline declares the worker hung,
+    SIGKILLs it, and restarts it."""
+    from d4pg_tpu.runtime.actor_pool import HostActorPool
+
+    inj = ChaosInjector(ChaosPlan.parse("env_hang@2:600#0"))
+    pool = HostActorPool(
+        ENV, 2, max_episode_steps=50, seed=0, start_method="fork",
+        step_timeout_s=1.5, max_worker_failures=3, chaos=inj,
+    )
+    try:
+        pool.reset_all(seed=0)
+        t0 = time.monotonic()
+        masked, dropped = _drive(pool, 3, sleep_s=0.0)
+        assert time.monotonic() - t0 < 10  # bounded, not a 600 s hang
+        assert pool.failures_total == 1 and dropped == [0]
+        assert any(
+            "timeout" in e["detail"]
+            for e in pool.events
+            if e["event"] == "worker_failed"
+        )
+    finally:
+        pool.close()
+
+
+def test_quarantine_after_k_consecutive_failures_masks_forever():
+    from d4pg_tpu.runtime.actor_pool import HostActorPool
+
+    inj = ChaosInjector(ChaosPlan.parse("env_raise@2#0"))
+    pool = HostActorPool(
+        ENV, 2, max_episode_steps=50, seed=0, start_method="fork",
+        step_timeout_s=10.0, max_worker_failures=1, chaos=inj,
+    )
+    try:
+        pool.reset_all(seed=0)
+        _drive(pool, 6, sleep_s=0.0)
+        assert pool.num_quarantined() == 1
+        assert pool.restarts_total == 0  # quarantined before any restart
+        assert any(e["event"] == "worker_quarantine" for e in pool.events)
+        # the survivor keeps stepping; the quarantined row stays masked
+        _drive(pool, 2, sleep_s=0.0)
+        assert bool(pool.stepped_mask[1]) and not bool(pool.stepped_mask[0])
+    finally:
+        pool.close()
+
+
+def test_all_quarantined_raises_instead_of_spinning():
+    from d4pg_tpu.runtime.actor_pool import HostActorPool
+
+    inj = ChaosInjector(ChaosPlan.parse("env_raise@2#0"))
+    pool = HostActorPool(
+        ENV, 1, max_episode_steps=50, seed=0, start_method="fork",
+        step_timeout_s=10.0, max_worker_failures=1, chaos=inj,
+    )
+    try:
+        pool.reset_all(seed=0)
+        with pytest.raises(RuntimeError, match="quarantined"):
+            _drive(pool, 6, sleep_s=0.0)
+    finally:
+        pool.close()
+
+
+def test_pool_eval_excludes_torn_episodes():
+    """An eval worker failing mid-episode must not average rewards from
+    two different episodes (or frozen zeros) into keep-best: the torn
+    episode is excluded from the eval stats."""
+    from types import SimpleNamespace
+
+    from d4pg_tpu.runtime.trainer import Trainer
+
+    n = 3
+
+    class FakePool:
+        num_actors = n
+
+        def __init__(self):
+            self.t = 0
+            self.stepped_mask = np.ones(n, bool)
+
+        def reset_all(self):
+            return np.zeros((n, 2), np.float32)
+
+        def take_dropped(self):
+            return []
+
+        def step(self, a):
+            self.t += 1
+            self.stepped_mask = np.ones(n, bool)
+            r = np.ones(n, np.float32)
+            term = np.zeros(n, bool)
+            if self.t == 2:  # worker 0 dies mid-episode; row masked
+                self.stepped_mask[0] = False
+                r[0] = 0.0
+            if self.t >= 4:
+                term[:] = True
+            z = np.zeros((n, 2), np.float32)
+            f = np.zeros(n, bool)
+            return z, r, term, f, z, f, f
+
+    fake = SimpleNamespace(
+        config=SimpleNamespace(eval_episodes=n, max_episode_steps=6),
+        _eval_pool=FakePool(),
+        _get_eval_act=lambda: (lambda p, o: np.zeros((n, 1), np.float32)),
+        _eval_params=lambda: None,
+        _norm_obs=lambda x: x,
+    )
+    out = Trainer._pool_eval(fake)
+    # survivors accumulated r=1 for 4 steps; the torn episode (which would
+    # have contributed ~1.0) is excluded entirely
+    assert out["eval_return_mean"] == 4.0
+    assert "success_rate" not in out
+
+    class AllDeadPool(FakePool):
+        def step(self, a):
+            out = super().step(a)
+            self.stepped_mask[:] = False
+            return out
+
+    fake_dead = SimpleNamespace(
+        config=SimpleNamespace(eval_episodes=n, max_episode_steps=6),
+        _eval_pool=AllDeadPool(),
+        _get_eval_act=lambda: (lambda p, o: np.zeros((n, 1), np.float32)),
+        _eval_params=lambda: None,
+        _norm_obs=lambda x: x,
+    )
+    with pytest.raises(RuntimeError, match="every eval episode"):
+        Trainer._pool_eval(fake_dead)
+
+
+# ----------------------------------------------------- train-loop integration
+@pytest.mark.slow
+def test_chaos_train_run_completes_with_guards_green(tmp_path):
+    """The acceptance gate, in-process: a short pool training run under
+    env_raise + worker_kill + wb_stall with --debug-guards completes
+    every budgeted learner step, reports the injections in its metrics
+    rows, and ends with zero ledger trips and zero leaked holds."""
+    import json
+
+    from d4pg_tpu.config import TrainConfig, apply_env_preset
+    from d4pg_tpu.runtime.trainer import Trainer
+
+    cfg = apply_env_preset(
+        TrainConfig(
+            env=ENV,
+            num_envs=2,
+            total_steps=6,
+            warmup_steps=40,
+            batch_size=16,
+            replay_capacity=2_000,
+            eval_interval=6,
+            eval_episodes=1,
+            max_episode_steps=20,
+            checkpoint_interval=100_000,
+            pool_start_method="fork",
+            pool_step_timeout_s=10.0,
+            async_priority_writeback=True,
+            debug_guards=True,
+            chaos="seed=3;env_raise@5#0;worker_kill@9#1;wb_stall@1:0.2",
+            log_dir=str(tmp_path / "run"),
+        )
+    )
+    t = Trainer(cfg)
+    try:
+        out = t.train()
+        assert t.grad_steps == 6  # the learner took every budgeted step
+        assert np.isfinite(out["critic_loss"])
+        assert t.pool.failures_total >= 2 and t.pool.restarts_total >= 1
+        assert t._chaos.injections_total >= 2  # worker_kill + wb_stall fired
+    finally:
+        t.close()
+    stats = t._ledger.stats()
+    assert stats["trips"] == 0, stats
+    assert stats["active_holds"] == 0, stats  # no leaked holds after close
+    with open(tmp_path / "run" / "metrics.jsonl") as f:
+        rows = [json.loads(l) for l in f]
+    assert any("chaos_injections" in r for r in rows)
+    assert any(r.get("pool_worker_restarts", 0) >= 1 for r in rows)

@@ -217,6 +217,72 @@ FIXTURES = [
             return rng.uniform(size=n)
         """,
     ),
+    (
+        "unbounded-retry",
+        "d4pg_tpu/runtime/x.py",
+        """
+        import time
+
+        def connect_forever(mk):
+            while True:
+                try:
+                    return mk()
+                except OSError:
+                    time.sleep(1.0)
+
+        def connect_forever_while1(mk):
+            while 1:
+                try:
+                    return mk()
+                except OSError:
+                    time.sleep(1.0)
+        """,
+        """
+        import time
+
+        from d4pg_tpu.utils.retry import Backoff
+
+        def connect_bounded(mk):
+            for attempt in Backoff(max_attempts=5):
+                try:
+                    return mk()
+                except OSError:
+                    continue  # Backoff sleeps between bounded attempts
+            raise TimeoutError("gave up")
+
+        def connect_range(mk):
+            for attempt in range(5):
+                try:
+                    return mk()
+                except OSError:
+                    time.sleep(0.1)  # bounded by the range
+            raise TimeoutError("gave up")
+
+        def loop_that_escapes(mk):
+            while True:
+                try:
+                    return mk()
+                except OSError:
+                    raise  # no silent retry: escapes the loop
+
+        def condition_bounded(mk, stop):
+            while not stop.is_set():
+                try:
+                    return mk()
+                except OSError:
+                    time.sleep(0.1)  # terminates via the loop condition
+
+        def event_loop_with_inner_bounded_retry(q, send):
+            while True:  # long-lived event loop, not itself a retry
+                msg = q.get()
+                for attempt in range(3):
+                    try:
+                        send(msg)
+                        break
+                    except OSError:
+                        time.sleep(0.1)  # bounded by the INNER range
+        """,
+    ),
 ]
 
 assert {f[0] for f in FIXTURES} == set(ALL_CHECKS), "fixture per check"

@@ -325,6 +325,72 @@ class TestBatchedNStepWriter:
         assert len(buf) == 2
         np.testing.assert_allclose(buf.discount[:2], 0.9)
 
+    def test_drop_actor_plus_mask_matches_scalar_restart(self):
+        """Supervised-pool recovery semantics: on a worker failure the
+        actor's in-flight window is dropped WHOLE (drop_actor) and its
+        rows are masked (active=) until it rejoins — content-identical to
+        a scalar NStepWriter that resets at the failure and is fed only
+        the post-restart subsequence. No torn transition reaches replay."""
+        N, n, gamma, T = 2, 3, 0.9, 12
+        down = range(4, 7)  # actor 1 dark on these steps
+        rng = np.random.default_rng(5)
+        obs = (
+            np.arange(N)[None, :, None] * 1000.0
+            + np.arange(T + 1)[:, None, None]
+            + np.zeros((1, 1, 2))
+        ).astype(np.float32)
+        act = rng.uniform(-1, 1, (T, N, 1)).astype(np.float32)
+        rew = rng.normal(size=(T, N))
+        seq = ReplayBuffer(4096, 2, 1)
+        writers = [NStepWriter(seq, n, gamma) for _ in range(N)]
+        bat = ReplayBuffer(4096, 2, 1)
+        bw = BatchedNStepWriter(bat, N, n, gamma)
+        zeros = np.zeros(N, bool)
+        mask = np.array([True, False])
+        for t in range(T):
+            if t == min(down):  # the failure instant
+                bw.drop_actor(1)
+                writers[1].reset()
+            live = mask if t in down else None
+            bw.add_batch(obs[t], act[t], rew[t], obs[t + 1], zeros, zeros,
+                         active=live)
+            for i in range(N):
+                if live is not None and not live[i]:
+                    continue
+                writers[i].add(
+                    obs[t, i], act[t, i], float(rew[t, i]), obs[t + 1, i],
+                    terminated=False, truncated=False,
+                )
+        assert len(seq) == len(bat) > 0
+        np.testing.assert_array_equal(self._rows(seq), self._rows(bat))
+
+    def test_masked_add_with_episode_ends_matches(self):
+        """Mask + termination on the SAME step (the surviving actor's
+        episode ends while another is down) takes the degraded path —
+        emission must still match the scalar writers."""
+        N, n, gamma = 3, 3, 0.8
+        rng = np.random.default_rng(9)
+        seq = ReplayBuffer(4096, 2, 1)
+        writers = [NStepWriter(seq, n, gamma) for _ in range(N)]
+        bat = ReplayBuffer(4096, 2, 1)
+        bw = BatchedNStepWriter(bat, N, n, gamma)
+        mask = np.array([True, False, True])
+        for t in range(8):
+            obs = rng.normal(size=(N, 2)).astype(np.float32)
+            nxt = rng.normal(size=(N, 2)).astype(np.float32)
+            a = rng.uniform(-1, 1, (N, 1)).astype(np.float32)
+            r = rng.normal(size=N)
+            term = np.array([t == 5, False, False])
+            live = mask if t in (4, 5) else None
+            bw.add_batch(obs, a, r, nxt, term, np.zeros(N, bool), active=live)
+            for i in range(N):
+                if live is not None and not live[i]:
+                    continue
+                writers[i].add(obs[i], a[i], float(r[i]), nxt[i],
+                               terminated=bool(term[i]), truncated=False)
+        assert len(seq) == len(bat) > 0
+        np.testing.assert_array_equal(self._rows(seq), self._rows(bat))
+
 
 def test_stage_timers_accumulate_and_report():
     from d4pg_tpu.utils.profiling import StageTimers

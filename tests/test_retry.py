@@ -1,0 +1,88 @@
+"""The shared Backoff policy: bounded attempts, monotonic deadline,
+seeded jitter — the contract the d4pglint ``unbounded-retry`` check
+points every retry loop at."""
+
+import random
+
+import pytest
+
+from d4pg_tpu.utils.retry import Backoff, call_with_retry
+
+
+def _backoff(**kw):
+    base = dict(
+        base_s=1.0, factor=2.0, max_s=100.0, max_attempts=4, jitter=0.0,
+        rng=random.Random(0), sleep=lambda s: None,
+    )
+    base.update(kw)
+    return Backoff(**base)
+
+
+def test_exponential_growth_and_attempt_bound():
+    b = _backoff()
+    assert [b.next_delay() for _ in range(6)] == [1.0, 2.0, 4.0, 8.0, None, None]
+
+
+def test_caps_at_max_s():
+    b = _backoff(max_s=3.0, max_attempts=5)
+    assert [b.next_delay() for _ in range(5)] == [1.0, 2.0, 3.0, 3.0, 3.0]
+
+
+def test_reset_restarts_schedule():
+    b = _backoff()
+    assert b.next_delay() == 1.0 and b.next_delay() == 2.0
+    b.reset()  # a success makes failures non-consecutive
+    assert b.next_delay() == 1.0
+
+
+def test_jitter_bounded_and_deterministic():
+    delays_a = [
+        _backoff(jitter=0.5, rng=random.Random(7)).next_delay()
+        for _ in range(1)
+    ]
+    delays_b = [
+        _backoff(jitter=0.5, rng=random.Random(7)).next_delay()
+        for _ in range(1)
+    ]
+    assert delays_a == delays_b  # seeded rng → reproducible chaos runs
+    for _ in range(50):
+        d = _backoff(jitter=0.5, rng=random.Random()).next_delay()
+        assert 0.5 <= d <= 1.5  # nominal 1.0 ± 50%
+
+
+def test_monotonic_deadline_exhausts_budget():
+    now = [0.0]
+    b = _backoff(deadline_s=5.0, max_attempts=100, clock=lambda: now[0])
+    assert b.next_delay() is not None
+    now[0] = 5.0
+    assert b.next_delay() is None
+
+
+def test_iterator_sleeps_between_bounded_attempts():
+    slept = []
+    b = _backoff(max_attempts=3, sleep=slept.append)
+    attempts = list(b)
+    assert attempts == [0, 1, 2, 3]  # first attempt free + 3 retries
+    assert slept == [1.0, 2.0, 4.0]
+
+
+def test_call_with_retry_succeeds_midway_and_raises_at_exhaustion():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert call_with_retry(flaky, backoff=_backoff()) == "ok"
+    assert len(calls) == 3
+
+    retried = []
+    with pytest.raises(OSError, match="persistent"):
+        call_with_retry(
+            lambda: (_ for _ in ()).throw(OSError("persistent")),
+            backoff=_backoff(max_attempts=2),
+            on_retry=lambda attempt, e: retried.append(attempt),
+        )
+    assert retried == [0, 1, 2]

@@ -382,3 +382,77 @@ def test_submit_after_batcher_stop_raises_shed():
     srv.drain()
     with pytest.raises((ShedError, RuntimeError)):
         srv.batcher.submit(np.zeros(4, np.float32))
+
+
+def test_chaos_sock_reset_drops_one_conn_server_survives():
+    """Chaos sock_reset: the targeted connection is force-reset at its Nth
+    frame; every other client keeps being served, the reset shows in
+    healthz (chaos_injections), and the drain is clean."""
+    from d4pg_tpu.chaos import ChaosInjector, ChaosPlan
+
+    inj = ChaosInjector(ChaosPlan.parse("sock_reset@3"))
+    srv = PolicyServer(
+        _bundle(), port=0, max_batch=4, max_wait_us=500, watch_bundle=False,
+        chaos=inj,
+    )
+    srv.start()
+    try:
+        obs = np.zeros(4, np.float32)
+        with PolicyClient("127.0.0.1", srv.port) as victim:
+            assert victim.act(obs).shape == (2,)  # frames 1..2 fine
+            assert victim.act(obs).shape == (2,)
+            with pytest.raises(Exception):
+                victim.act(obs)  # frame 3: injected reset
+        assert inj.injections_total == 1
+        # the server keeps serving fresh connections at full health
+        with PolicyClient("127.0.0.1", srv.port) as c:
+            assert c.act(obs).shape == (2,)
+            h = c.healthz()
+        assert h["status"] == "ok"
+        assert h["chaos_injections"] == 1
+    finally:
+        srv.drain()
+
+
+def test_healthz_reports_degraded_after_failed_reload(tmp_path):
+    """Observability satellite: a failed hot-reload leaves the server
+    healthy-but-stale — healthz must say so (status=degraded,
+    last_reload=failed: ...) instead of burying it in logs."""
+    import os
+
+    run = tmp_path / "run"
+    (run / "checkpoints").mkdir(parents=True)
+    srv = PolicyServer(
+        _bundle(), port=0, max_batch=4, watch_bundle=False,
+        watch_run=str(run),
+    )
+    srv.start()
+    try:
+        h = srv.healthz()
+        assert h["status"] == "ok" and h["last_reload"] is None
+        assert h["draining"] is False
+        # best_eval.json moves but best_actor.npz is garbage → reload fails
+        (run / "best_eval.json").write_text('{"eval_return_mean": 1.0}')
+        (run / "checkpoints" / "best_actor.npz").write_bytes(b"not an npz")
+        assert srv.check_reload() is False
+        h = srv.healthz()
+        assert h["status"] == "degraded"
+        assert h["last_reload"].startswith("failed")
+        # a later successful reload clears the degraded state
+        import jax
+
+        from d4pg_tpu.serve.bundle import actor_template
+
+        leaves = jax.tree_util.tree_leaves(actor_template(CFG))
+        with open(run / "checkpoints" / "best_actor.npz", "wb") as f:
+            np.savez(
+                f,
+                **{f"leaf_{i:04d}": np.asarray(l) for i, l in enumerate(leaves)},
+            )
+        st = os.stat(run / "best_eval.json")
+        os.utime(run / "best_eval.json", (st.st_atime, st.st_mtime + 5))
+        assert srv.check_reload() is True
+        h = srv.healthz()
+        assert h["status"] == "ok" and h["last_reload"].startswith("ok")
+    finally:
+        srv.drain()

@@ -574,6 +574,75 @@ def thread_discipline(tree, src_lines, relpath):
     return out
 
 
+# ----------------------------------------------------------------- check 10
+@check("unbounded-retry")
+def unbounded_retry(tree, src_lines, relpath):
+    """A ``while True`` loop whose exception handler sleeps and goes
+    around again retries FOREVER: a persistent fault (dead worker,
+    unwritable disk, refused socket) becomes an infinite sleep-spin that
+    looks like a hang from the outside. Retry loops must be bounded —
+    iterate the shared ``d4pg_tpu.utils.retry.Backoff`` (bounded attempts
+    + monotonic deadline + jitter) or an explicit ``range(...)`` — so
+    exhaustion surfaces as an error instead of silence."""
+
+    def is_sleep(call: ast.Call) -> bool:
+        fn = call.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "sleep":
+            return True
+        return isinstance(fn, ast.Name) and fn.id == "sleep"
+
+    def handler_retries_with_sleep(h: ast.ExceptHandler) -> bool:
+        sleeps = False
+        for node in _walk_skip_nested_defs(h):
+            if isinstance(node, (ast.Raise, ast.Return, ast.Break)):
+                return False  # bounded: the handler escapes the loop
+            if isinstance(node, ast.Call) and is_sleep(node):
+                sleeps = True
+        return sleeps
+
+    def own_handlers(loop):
+        """ExceptHandlers belonging to THIS loop: skip nested defs AND
+        nested loops — an inner for-range/Backoff loop's sleep-on-error is
+        bounded by that loop, and an inner `while True` is analyzed on its
+        own when ast.walk reaches it."""
+        stack = list(ast.iter_child_nodes(loop))
+        while stack:
+            n = stack.pop()
+            yield n
+            if isinstance(
+                n,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                 ast.ClassDef, ast.For, ast.AsyncFor, ast.While),
+            ):
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.While):
+            continue
+        test = node.test
+        # `while True` / `while 1`: only constant-true loops — a real
+        # condition is the bound that makes the loop terminate.
+        if not (isinstance(test, ast.Constant) and (
+            test.value is True or test.value == 1
+        )):
+            continue
+        for sub in own_handlers(node):
+            if isinstance(sub, ast.ExceptHandler) and handler_retries_with_sleep(sub):
+                out.append(
+                    Finding(
+                        "unbounded-retry", relpath, sub.lineno,
+                        "sleep-and-retry inside `while True` has no attempt "
+                        "bound: a persistent fault spins forever — use "
+                        "d4pg_tpu.utils.retry.Backoff (bounded attempts, "
+                        "monotonic deadline, jitter) or a range(...)-bounded "
+                        "loop",
+                    )
+                )
+    return out
+
+
 # ------------------------------------------------------------------ check 9
 @check("global-rng")
 def global_rng(tree, src_lines, relpath):

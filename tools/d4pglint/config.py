@@ -19,6 +19,7 @@ ALL_CHECKS = (
     "hot-path-alloc",        # no per-step allocation in hot-path functions
     "thread-discipline",     # threads are named daemons
     "global-rng",            # seeded Generators only, no np.random module state
+    "unbounded-retry",       # retry loops use the bounded Backoff util
 )
 
 # What `python -m tools.d4pglint` lints when given no paths: the product
@@ -51,6 +52,8 @@ HOST_ONLY_MODULES = (
     "d4pg_tpu/serve/client.py",
     "d4pg_tpu/serve/stats.py",
     "d4pg_tpu/utils/signals.py",
+    "d4pg_tpu/utils/retry.py",
+    "d4pg_tpu/chaos.py",
     "d4pg_tpu/analysis/__init__.py",
     "d4pg_tpu/analysis/ledger.py",
 )

@@ -189,6 +189,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "python -m d4pg_tpu.serve, then exit")
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace of grad steps 10-60 here")
+    p.add_argument("--chaos", default=None, metavar="PLAN",
+                   help="deterministic fault injection (d4pg_tpu/chaos.py): "
+                        "';'-separated site@count[:arg][#actor] entries, "
+                        "e.g. 'seed=7;env_raise@40;worker_kill@12#1;"
+                        "ckpt_truncate@1;wb_stall@3:0.5' — proves the "
+                        "supervisor/restart/fallback paths on demand")
+    p.add_argument("--pool-step-timeout", dest="pool_step_timeout_s",
+                   type=float, default=60.0,
+                   help="supervised actor pool: seconds a worker may take "
+                        "to answer one step before it is declared hung and "
+                        "restarted (monotonic deadline)")
     p.add_argument("--debug-guards", action="store_true",
                    help="runtime invariant guards (d4pg_tpu/analysis): "
                         "recompile sentinel on every jitted entry point, "
@@ -297,6 +308,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         snapshot_replay=args.snapshot_replay,
         profile_dir=args.profile_dir,
         debug_guards=args.debug_guards,
+        chaos=args.chaos,
+        pool_step_timeout_s=args.pool_step_timeout_s,
         max_rss_gb=args.max_rss_gb,
         dp=args.dp,
         dp_hogwild=args.dp_hogwild,
@@ -494,6 +507,12 @@ def main(argv=None) -> None:
                 "--obs-norm is a host data-boundary feature; the on-device "
                 "path keeps observations inside jit (the flag would be "
                 "silently ignored)"
+            )
+        if args.chaos:
+            raise SystemExit(
+                "--chaos targets the host runtime's fault surfaces (pool "
+                "workers, flusher, checkpoint commit); the on-device path "
+                "has none of them (the flag would be silently ignored)"
             )
         from d4pg_tpu.runtime.on_device import run_on_device
 
