@@ -41,14 +41,23 @@ round 7, the per-stage host data-plane breakdown (sample / h2d_stage /
 train_dispatch / priority_writeback, ms per dispatch) for the legacy
 sampler vs the native batched ``sample_block`` path (docs/data_plane.md).
 
+Round 11 adds the megastep data plane to the line:
+``transfer_bytes_per_grad_step_{host,hybrid,megastep}`` (counted from the
+exact arrays staged/fetched per dispatch at the flagship K=32 shape) next
+to ``megastep_steps_per_sec`` — the device-resident-replay loop
+(``bench_megastep``) whose per-grad-step transfer count is zero by
+construction and enforced by the ``--debug-guards`` transfer budget.
+
 When the default backend fails to initialize (wedged tunnel), the output
 is ONE parseable ``{"error": "tpu_unreachable"}`` JSON line, never a raw
 traceback; ``--allow-cpu-fallback`` appends a second, clearly-marked
 CPU-backend host-pipeline line. The chip-independent regression guards are
 ``benchmarks/fused_microbench.py`` (committed
-``benchmarks/cpu_microbench.json``) and
+``benchmarks/cpu_microbench.json``),
 ``benchmarks/host_pipeline_microbench.py`` (committed
-``benchmarks/host_pipeline_microbench.json``).
+``benchmarks/host_pipeline_microbench.json``), and
+``benchmarks/megastep_microbench.py`` (committed
+``benchmarks/megastep_microbench.json``).
 """
 
 from __future__ import annotations
@@ -366,6 +375,11 @@ def bench_host_pipeline(
         )
     )
     timers = StageTimers(annotate_prefix=None)
+    # Per-dispatch link traffic, counted from the exact host arrays the
+    # loop stages H2D (batch fields + IS weights) and fetches D2H
+    # (priorities): the regression-checked transfer_bytes_per_grad_step
+    # the megastep data plane exists to zero out.
+    xfer = {"h2d": 0, "d2h": 0}
 
     def sample_staged(step):
         if sampler == "block":
@@ -376,6 +390,7 @@ def bench_host_pipeline(
                     indices = SampledIndices(indices.idx[0], indices.gen[0])
                     blk = {kk: v[0] for kk, v in blk.items()}
             with timers.stage("h2d_stage"):
+                xfer["h2d"] += sum(v.nbytes for v in blk.values())
                 dev = {kk: jnp.asarray(v) for kk, v in blk.items()}
         else:
             with timers.stage("sample"):
@@ -391,6 +406,7 @@ def bench_host_pipeline(
                         for kk in samples[0]
                     }
             with timers.stage("h2d_stage"):
+                xfer["h2d"] += sum(v.nbytes for v in host.values())
                 dev = {kk: jnp.asarray(v) for kk, v in host.items()}
         return indices, dev
 
@@ -398,6 +414,7 @@ def bench_host_pipeline(
         idx, pri_dev = pending
         with timers.stage("priority_writeback"):
             pri = np.asarray(pri_dev)
+            xfer["d2h"] += pri.nbytes
             if isinstance(idx, list):
                 for i, ix in enumerate(idx):
                     buf.update_priorities(ix, pri[i])
@@ -424,6 +441,7 @@ def bench_host_pipeline(
     state, staged, pending = run(5, 0, state, staged=None, pending=None)
     jax.block_until_ready(state.step)
     timers.reset()
+    xfer["h2d"] = xfer["d2h"] = 0
     t0 = time.perf_counter()
     state, staged, pending = run(steps, 5, state, staged, pending)
     jax.block_until_ready(state.step)
@@ -441,7 +459,182 @@ def bench_host_pipeline(
         "prefetch": bool(prefetch),
         "stage_ms_per_dispatch": {kk: round(v, 4) for kk, v in stage_ms.items()},
         "host_ms_per_dispatch": round(host_ms, 4),
+        # counted, not estimated: exactly the bytes this loop staged H2D
+        # and fetched D2H during the measured window, per grad step
+        "transfer_bytes_per_grad_step": round(
+            (xfer["h2d"] + xfer["d2h"]) / (steps * k), 1
+        ),
+        "h2d_bytes_per_grad_step": round(xfer["h2d"] / (steps * k), 1),
+        "d2h_bytes_per_grad_step": round(xfer["d2h"] / (steps * k), 1),
     }
+
+
+def bench_megastep(
+    *,
+    placement: str = "device",
+    steps: int = 30,
+    batch: int = BATCH,
+    k: int = 32,
+    hidden: int = HIDDEN,
+    obs_dim: int = OBS_DIM,
+    act_dim: int = ACT_DIM,
+    rows: int = 65_536,
+    compute_dtype: str = "float32",
+) -> dict:
+    """Device-resident replay + fused megastep: grad-steps/s and per-step
+    transfer bytes (``runtime/megastep.py`` + ``replay/device_ring.py``).
+
+    The apples-to-apples comparison point for :func:`bench_host_pipeline`
+    at the same (batch, k, model) shape: the host pipeline pays a full
+    batch upload + priority fetch per dispatch; the megastep pays ZERO
+    per-grad-step transfers on the ``device`` (uniform, in-kernel draw)
+    placement and only the [K, B] int32 index / f32 weight upload + [K, B]
+    priority fetch on ``hybrid`` (PER). Transfer bytes are counted from
+    the exact arrays staged/fetched, same accounting as the host bench.
+    The one-time ring fill is reported separately (``ingest_bytes_total``)
+    — it is experience ingest, not grad-step traffic.
+
+    ``steps`` counts DISPATCHES; grad-steps/s = steps·k / wall.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from d4pg_tpu.agent import D4PGConfig, create_train_state
+    from d4pg_tpu.models.critic import DistConfig
+    from d4pg_tpu.replay.device_ring import DeviceRingSync, device_ring_init
+    from d4pg_tpu.replay.per import PrioritizedReplayBuffer
+    from d4pg_tpu.replay.uniform import ReplayBuffer, Transition
+    from d4pg_tpu.runtime.megastep import (
+        make_megastep_hybrid,
+        make_megastep_uniform,
+    )
+    from d4pg_tpu.utils.profiling import StageTimers
+
+    if placement not in ("device", "hybrid"):
+        raise ValueError(f"placement must be device|hybrid, got {placement!r}")
+    config = D4PGConfig(
+        obs_dim=obs_dim,
+        action_dim=act_dim,
+        hidden_sizes=(hidden, hidden, hidden),
+        dist=DistConfig(kind="categorical", num_atoms=ATOMS, v_min=V_MIN, v_max=V_MAX),
+        compute_dtype=compute_dtype,
+    )
+    state = create_train_state(config, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    mk = PrioritizedReplayBuffer if placement == "hybrid" else ReplayBuffer
+    buf = mk(rows, obs_dim, act_dim)
+    buf.add_batch(
+        Transition(
+            rng.normal(size=(rows, obs_dim)).astype(np.float32),
+            rng.uniform(-1, 1, size=(rows, act_dim)).astype(np.float32),
+            rng.uniform(-1, 0, size=rows).astype(np.float32),
+            rng.normal(size=(rows, obs_dim)).astype(np.float32),
+            np.full(rows, 0.99, np.float32),
+        )
+    )
+    ring = device_ring_init(rows, obs_dim, act_dim)
+    sync = DeviceRingSync(buf)
+    ring = sync.flush(ring)  # one-time fill: ingest, not grad-step traffic
+    # FLOPs per grad step from XLA's cost model on the single-step program
+    # — the same honest unit bench_tpu uses (a scanned body counts once,
+    # not ×K), so megastep MFU numbers line up with the mfu_sweep rows.
+    flops_per_step = None
+    try:
+        from d4pg_tpu.agent import jit_train_step
+
+        single = jit_train_step(config)
+        ex_batch = {
+            "obs": jnp.zeros((batch, obs_dim), jnp.float32),
+            "action": jnp.zeros((batch, act_dim), jnp.float32),
+            "reward": jnp.zeros((batch,), jnp.float32),
+            "next_obs": jnp.zeros((batch, obs_dim), jnp.float32),
+            "discount": jnp.zeros((batch,), jnp.float32),
+            "weights": jnp.ones((batch,), jnp.float32),
+        }
+        cost = single.lower(state, ex_batch).compile().cost_analysis()
+        if isinstance(cost, list):
+            cost = cost[0]
+        flops_per_step = float(cost.get("flops", 0.0)) or None
+    except Exception:  # d4pglint: disable=broad-except  -- optional XLA
+        # cost-analysis probe; benchmark timings land without it
+        pass
+    timers = StageTimers(annotate_prefix=None)
+    xfer = {"h2d": 0, "d2h": 0}
+    if placement == "device":
+        mega = make_megastep_uniform(config, k, batch)
+        key = jax.device_put(jax.random.PRNGKey(1))
+
+        def one_dispatch(i, state, pending):
+            nonlocal key
+            with timers.stage("megastep_dispatch"):
+                state, key, metrics = mega(state, ring, key)
+            return state, None
+    else:
+        mega = make_megastep_hybrid(config)
+
+        def one_dispatch(i, state, pending):
+            with timers.stage("sample"):
+                idx, w, gen = buf.sample_block_indices(batch, k, rng, step=i)
+            with timers.stage("h2d_stage"):
+                idx32 = idx.astype(np.int32)
+                xfer["h2d"] += idx32.nbytes + w.nbytes
+                idx_dev = jax.device_put(idx32)
+                w_dev = jax.device_put(w)
+            with timers.stage("megastep_dispatch"):
+                state, metrics, pri = mega(state, ring, idx_dev, w_dev)
+            if pending is not None:  # one-dispatch-lag priority write-back
+                p_idx, p_gen, p_pri = pending
+                with timers.stage("priority_writeback"):
+                    p = np.asarray(p_pri)
+                    xfer["d2h"] += p.nbytes
+                    from d4pg_tpu.replay.per import SampledIndices
+
+                    buf.update_priorities(SampledIndices(p_idx, p_gen), p)
+            if hasattr(pri, "copy_to_host_async"):
+                pri.copy_to_host_async()
+            return state, (idx, gen, pri)
+
+    pending = None
+    for i in range(3):  # warmup (compile + first dispatches)
+        state, pending = one_dispatch(i, state, pending)
+    jax.block_until_ready(state.step)
+    timers.reset()
+    xfer["h2d"] = xfer["d2h"] = 0
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, pending = one_dispatch(3 + i, state, pending)
+    jax.block_until_ready(state.step)
+    dt = time.perf_counter() - t0
+    stage_ms = timers.summary_ms(per=steps)
+    host_ms = sum(
+        stage_ms.get(s, 0.0)
+        for s in ("sample", "h2d_stage", "priority_writeback")
+    )
+    out = {
+        "steps_per_sec": steps * k / dt,
+        "dispatches_per_sec": steps / dt,
+        "k": k,
+        "batch": batch,
+        "placement": placement,
+        "stage_ms_per_dispatch": {kk: round(v, 4) for kk, v in stage_ms.items()},
+        "host_ms_per_dispatch": round(host_ms, 4),
+        "transfer_bytes_per_grad_step": round(
+            (xfer["h2d"] + xfer["d2h"]) / (steps * k), 1
+        ),
+        "h2d_bytes_per_grad_step": round(xfer["h2d"] / (steps * k), 1),
+        "d2h_bytes_per_grad_step": round(xfer["d2h"] / (steps * k), 1),
+        "ingest_bytes_total": sync.bytes_ingested,
+        "ingest_chunks": sync.chunks_ingested,
+    }
+    if flops_per_step:
+        out["flops_per_grad_step"] = flops_per_step
+        achieved = flops_per_step * out["steps_per_sec"]
+        out["achieved_tflops"] = achieved / 1e12
+        peak = match_peak(PEAK_TFLOPS, jax.devices()[0].device_kind)
+        if peak is not None:
+            out["peak_tflops"] = peak
+            out["mfu"] = achieved / (peak * 1e12)
+    return out
 
 
 def bench_serve(
@@ -967,6 +1160,16 @@ def main(argv=None) -> None:
     pipe_off = bench_host_pipeline(prefetch=False)
     pipe_on = bench_host_pipeline(prefetch=True)
     pipe_block = bench_host_pipeline(prefetch=False, sampler="block")
+    # Device-resident replay + fused megastep at the flagship K=32 shape
+    # (runtime/megastep.py): the zero-transfer learner loop, next to the
+    # host pipeline it replaces — transfer bytes are counted, not prose.
+    mega_dev = bench_megastep(placement="device", k=32, steps=16)
+    mega_hyb = bench_megastep(placement="hybrid", k=32, steps=16)
+    # f32 on purpose: the megastep variants above run f32, and a bf16
+    # host line would fold the dtype speedup into the data-plane delta.
+    pipe_k32 = bench_host_pipeline(
+        prefetch=False, sampler="block", k=32, compute_dtype="float32"
+    )
     baseline = bench_torch_cpu_baseline()
     # The headline AND its utilization/roofline numbers come from the SAME
     # (winning) run — pairing a bf16 throughput with f32-program bytes/flops
@@ -1010,7 +1213,26 @@ def main(argv=None) -> None:
         "host_ms_per_dispatch_legacy": pipe_off["host_ms_per_dispatch"],
         "host_ms_per_dispatch_block": pipe_block["host_ms_per_dispatch"],
         "host_tree_backend": pipe_block["tree_backend"],
+        # Per-grad-step link traffic, counted from the exact arrays each
+        # loop stages H2D / fetches D2H (see docs/data_plane.md): the
+        # host path's number is what the megastep exists to zero out, so
+        # the zero-transfer claim is a regression-checked number here,
+        # not prose. All three at the flagship K=32 dispatch shape.
+        "transfer_bytes_per_grad_step_host": pipe_k32[
+            "transfer_bytes_per_grad_step"
+        ],
+        "transfer_bytes_per_grad_step_hybrid": mega_hyb[
+            "transfer_bytes_per_grad_step"
+        ],
+        "transfer_bytes_per_grad_step_megastep": mega_dev[
+            "transfer_bytes_per_grad_step"
+        ],
+        "megastep_steps_per_sec": round(mega_dev["steps_per_sec"], 2),
+        "hybrid_steps_per_sec": round(mega_hyb["steps_per_sec"], 2),
+        "host_k32_steps_per_sec": round(pipe_k32["steps_per_sec"], 2),
     }
+    if "mfu" in mega_dev:
+        line["megastep_mfu"] = round(mega_dev["mfu"], 5)
     if pipe_off["host_ms_per_dispatch"] > 0:
         line["host_ms_ratio_block_over_legacy"] = round(
             pipe_block["host_ms_per_dispatch"] / pipe_off["host_ms_per_dispatch"],

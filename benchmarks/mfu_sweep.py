@@ -12,14 +12,28 @@ claim checkable rather than asserted:
 2. the conv (pixel) critic config at 48x48x2 — convolutions carry far more
    FLOPs per byte than the tiny MLPs;
 3. a "wide" MLP variant (1024-wide hiddens, batch 4096) — MXU-saturating
-   matmul shapes with the same train-step machinery.
+   matmul shapes with the same train-step machinery;
+4. the MEGASTEP configuration (``--replay-placement device``): the fused
+   device-resident-replay training loop (``runtime/megastep.py``) at the
+   mlp256 / B >= 512 shapes where points 1-3 measured the 9% -> 53% MFU
+   headroom — the data plane that exists to close exactly that gap, with
+   ``transfer_bytes_per_grad_step`` 0 by construction and ``mfu`` from the
+   same single-step XLA cost model as every other row.
 
-Every point runs through ``bench.bench_tpu`` itself — the SAME pinned
-protocol as the flagship line (fused K-step scan with device-side random
-pool gather, donated state, value-transfer sync), parameterized rather
-than copied, so the two can never drift apart.
+Points 1-3 run through ``bench.bench_tpu`` (device-resident pool, fused
+K-step scan); point 4 through ``bench.bench_megastep`` (device ring +
+in-kernel draw) — the SAME pinned timing protocol (pipelined dispatches,
+donated state, value-transfer sync), parameterized rather than copied, so
+the rows can never drift apart.
 
-Run on the real chip:  python benchmarks/mfu_sweep.py
+Run on the real chip:        python benchmarks/mfu_sweep.py
+CPU-interpret megastep rows: JAX_PLATFORMS=cpu \
+                             python benchmarks/mfu_sweep.py --megastep-only
+(--megastep-only keeps the committed on-chip rows for points 1-3 — the
+TPU tunnel has been down since round 5 — and replaces only the megastep
+rows, each tagged with the backend that produced it; rerun WITHOUT the
+flag on the TPU VM to refresh everything on-chip.)
+
 Prints one JSON line per point and writes benchmarks/mfu_sweep_results.json.
 """
 
@@ -31,7 +45,11 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench import bench_tpu  # noqa: E402
+from bench import bench_megastep, bench_tpu  # noqa: E402
+
+RESULTS = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "mfu_sweep_results.json"
+)
 
 
 def bench_point(label: str, **kwargs) -> dict:
@@ -61,29 +79,84 @@ def bench_point(label: str, **kwargs) -> dict:
     return row
 
 
-def main() -> None:
+def megastep_point(batch: int, *, k_steps: int = 32, steps: int = 6) -> dict:
+    """One megastep row at the flagship mlp256 model: device placement,
+    in-kernel uniform draw, zero per-grad-step transfers. Tagged with the
+    backend so CPU-interpret placeholders are never mistaken for chip
+    numbers."""
+    import jax
+
+    out = bench_megastep(
+        placement="device", batch=batch, k=k_steps, steps=steps,
+    )
+    row = {
+        "bench": "mfu_sweep",
+        "config": "megastep_mlp256",
+        "batch": batch,
+        "compute_dtype": "float32",
+        "backend": jax.default_backend(),
+        "steps_per_sec": round(out["steps_per_sec"], 1),
+        "transfer_bytes_per_grad_step": out["transfer_bytes_per_grad_step"],
+    }
+    for k, nd in (
+        ("flops_per_grad_step", 0),
+        ("achieved_tflops", 3),
+        ("mfu", 5),
+    ):
+        if k in out:
+            row[k] = round(out[k], nd) if nd else round(out[k])
+    if jax.default_backend() == "cpu":
+        row["note"] = (
+            "CPU-interpret placeholder (TPU tunnel down); rerun "
+            "benchmarks/mfu_sweep.py on-chip for the real MFU"
+        )
+    return row
+
+
+def megastep_rows() -> list[dict]:
     rows = []
-    # 1. batch scaling on the flagship MLP
-    for batch in (256, 512, 1024, 2048, 4096):
-        rows.append(bench_point("mlp256", batch=batch, k_steps=256, measure=8))
+    # B >= 512 is where points 1-3 measured the MFU headroom opening up
+    # (0.092 -> 0.232 from batch alone); 256 anchors the flagship shape.
+    for batch in (256, 512, 1024):
+        rows.append(megastep_point(batch))
         print(json.dumps(rows[-1]), flush=True)
-    # 2. conv critic (pixel workload): fewer fused steps — each is ~100x
-    #    the MLP's FLOPs; smaller pool so pixel rows fit HBM comfortably
-    rows.append(
-        bench_point("conv48", batch=256, pixel=True, k_steps=32, measure=4,
-                    pool_rows=8_192)
-    )
-    print(json.dumps(rows[-1]), flush=True)
-    # 3. MXU-shaped MLP: 1024-wide, batch 4096
-    rows.append(
-        bench_point("mlp1024", batch=4096, hidden=1024, k_steps=64, measure=4)
-    )
-    print(json.dumps(rows[-1]), flush=True)
-    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                       "mfu_sweep_results.json")
-    with open(out, "w") as f:
+    return rows
+
+
+def main(argv=None) -> None:
+    argv = sys.argv[1:] if argv is None else argv
+    if "--megastep-only" in argv:
+        # Keep the committed on-chip rows (points 1-3) and replace only
+        # the megastep rows — the artifact stays a list of sweep rows.
+        with open(RESULTS) as f:
+            rows = [
+                r for r in json.load(f)
+                if not str(r.get("config", "")).startswith("megastep")
+            ]
+        rows.extend(megastep_rows())
+    else:
+        rows = []
+        # 1. batch scaling on the flagship MLP
+        for batch in (256, 512, 1024, 2048, 4096):
+            rows.append(bench_point("mlp256", batch=batch, k_steps=256, measure=8))
+            print(json.dumps(rows[-1]), flush=True)
+        # 2. conv critic (pixel workload): fewer fused steps — each is ~100x
+        #    the MLP's FLOPs; smaller pool so pixel rows fit HBM comfortably
+        rows.append(
+            bench_point("conv48", batch=256, pixel=True, k_steps=32, measure=4,
+                        pool_rows=8_192)
+        )
+        print(json.dumps(rows[-1]), flush=True)
+        # 3. MXU-shaped MLP: 1024-wide, batch 4096
+        rows.append(
+            bench_point("mlp1024", batch=4096, hidden=1024, k_steps=64, measure=4)
+        )
+        print(json.dumps(rows[-1]), flush=True)
+        # 4. the megastep data plane at the headroom shapes
+        rows.extend(megastep_rows())
+    with open(RESULTS, "w") as f:
         json.dump(rows, f, indent=1)
-    print(f"[mfu_sweep] wrote {out}", file=sys.stderr)
+    print(f"[mfu_sweep] wrote {RESULTS}", file=sys.stderr)
 
 
 if __name__ == "__main__":

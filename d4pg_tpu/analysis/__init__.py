@@ -32,6 +32,7 @@ _EXPORTS = {
     "RecompileSentinel": "d4pg_tpu.analysis.recompile",
     "RecompileBudgetError": "d4pg_tpu.analysis.recompile",
     "no_implicit_transfers": "d4pg_tpu.analysis.transfer",
+    "no_transfers": "d4pg_tpu.analysis.transfer",
     "explicit_transfer": "d4pg_tpu.analysis.transfer",
 }
 

@@ -37,6 +37,33 @@ def no_implicit_transfers(enabled: bool = True):
 
 
 @contextlib.contextmanager
+def no_transfers(enabled: bool = True):
+    """Zero-transfer phase budget for the device-resident megastep
+    dispatch (``replay_placement=device``/``hybrid``): the PR-4 budget —
+    "explicit staging only" — tightened to "none".  Inside this scope even
+    an *explicit* ``device_put`` raises (``disallow_explicit``), and any
+    device→host fetch raises too: the megastep's contract is that state,
+    ring, and key are already device-resident and nothing comes back but
+    the dispatch handle, so per-grad-step transfer count is exactly zero
+    — enforced, not asserted.  Explicit staging (ring ingest, hybrid's
+    [K, B] index upload) happens *outside* this scope, in its own
+    ``ingest_chunk``/``h2d_stage`` phase.
+
+    The first dispatch of a program must run under the looser
+    :func:`no_implicit_transfers` instead: compilation itself stages
+    trace-time constants, which is warmup, not steady state.
+    """
+    if not enabled:
+        yield
+        return
+    import jax
+
+    with jax.transfer_guard_host_to_device("disallow_explicit"):
+        with jax.transfer_guard_device_to_host("disallow"):
+            yield
+
+
+@contextlib.contextmanager
 def explicit_transfer():
     """Escape hatch for a deliberate transfer *inside* a guarded region
     (prefer restructuring so staging happens outside the guard)."""

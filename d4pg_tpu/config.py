@@ -114,6 +114,22 @@ class TrainConfig:
     # exactly this. Pure-JAX envs ignore it (their rollout IS the device).
     actor_device: str = "auto"
 
+    # Where sampled batches live (ROADMAP item 1 — the megastep data plane):
+    #   "host"   — the existing path: host PER/uniform sampling, per-dispatch
+    #              H2D batch upload + D2H priority fetch (the seeded oracle);
+    #   "device" — uniform replay mirrored into a device-resident HBM ring
+    #              (replay/device_ring.py); the fused megastep draws indices
+    #              in-kernel and trains with ZERO per-grad-step transfers
+    #              (runtime/megastep.py; implies uniform sampling — PER
+    #              needs the host trees, use "hybrid");
+    #   "hybrid" — PER: the host sum-tree computes indices + IS weights and
+    #              ships only the tiny [K, B] int32/f32 blocks; rows are
+    #              gathered on-device from the ring, priorities come back
+    #              as one [K, B] block per dispatch (same seeded index
+    #              stream as the host path — frozen-literal-tested).
+    # Host experience ingest streams into the ring in large infrequent
+    # chunks (the ingest_chunk stage), never per step.
+    replay_placement: str = "host"
     # replay. Capacity None = "unset": resolved to the env preset's cap if
     # any, else 1M (reference --rmsize default) — a sentinel, so an explicit
     # --rmsize 1000000 is distinguishable from the default and never

@@ -334,6 +334,33 @@ class PrioritizedReplayBuffer(ReplayBuffer):
             )
         return out
 
+    def sample_block_indices(
+        self, batch_size: int, k: int, rng: np.random.Generator, step: int = 0
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """The index half of :meth:`sample_block`, with NO row gather:
+        ``(idx [K, B] int64, weights [K, B] f32, gen [K, B] int64)``.
+
+        This is the hybrid ``replay_placement`` data plane (ROADMAP item
+        1): the host sum-tree still owns the PER descent, but only the
+        tiny index/weight blocks cross the link — rows are gathered
+        on-device from the HBM ring mirror by the megastep.
+
+        Determinism contract (frozen-literal-tested): consumes the
+        identical RNG stream as :meth:`sample_block` — one
+        ``Generator.uniform`` of size K·B over the equal-mass stratified
+        bounds — and deals draws to the identical round-robin block
+        layout, so flipping ``replay_placement`` between ``host`` and
+        ``hybrid`` moves no seeded run's index sequence. Returns fresh
+        arrays (no staging rotation: [K, B] index blocks are link-trivial
+        and must outlive the async priority flusher anyway).
+        """
+        n = batch_size * k
+        idx, weights, gen = self._draw(n, rng, step)
+        # Same dealing as sample_block: draw j lands at block[j % k, j // k].
+        order = np.arange(n).reshape(batch_size, k).T.reshape(-1)
+        block = lambda a: a[order].reshape(k, batch_size)
+        return block(idx), block(weights), block(gen)
+
     def _snapshot_arrays(self) -> dict:
         data = super()._snapshot_arrays()
         n = self._size

@@ -84,6 +84,12 @@ class ReplayBuffer:
         self._gen = np.zeros((capacity,), np.int64)
         self._pos = 0
         self._size = 0
+        # Monotone lifetime write counter (never wraps): the device-ring
+        # mirror (replay/device_ring.py) diffs it to find which slots
+        # changed since its last flush. Plain-int reads are safe off-lock
+        # (readers tolerate one-batch staleness: unmirrored rows simply
+        # ship on the next flush).
+        self._total_added = 0
         self._lock = threading.Lock()
 
     def _encode_obs(self, obs: np.ndarray) -> np.ndarray:
@@ -102,6 +108,11 @@ class ReplayBuffer:
     def __len__(self) -> int:
         return self._size
 
+    @property
+    def total_added(self) -> int:
+        """Monotone count of rows ever written (including overwrites)."""
+        return self._total_added
+
     def add_batch(self, t: Transition) -> np.ndarray:
         """Insert a batch of transitions; returns the slot indices written."""
         obs = self._encode_obs(t.obs)
@@ -116,6 +127,7 @@ class ReplayBuffer:
             self._gen[idx] += 1
             self._pos = int((self._pos + n) % self.capacity)
             self._size = int(min(self._size + n, self.capacity))
+            self._total_added += n
         return idx
 
     def add(self, obs, action, reward, next_obs, discount) -> np.ndarray:
@@ -201,6 +213,13 @@ class ReplayBuffer:
         # survives a wrapped ring; different capacity → data sits at [0, n).
         saved_pos = int(np.asarray(data["pos"]).item())
         self._pos = saved_pos if n == self.capacity else n % self.capacity
+        # Re-derive the lifetime counter so (total_added % capacity) ==
+        # _pos and min(total_added, capacity) == _size keep holding — the
+        # two invariants the device-ring mirror's slot math rests on. A
+        # fresh mirror (synced=0) then resyncs the whole restored buffer.
+        self._total_added = (
+            self._pos + self.capacity if n == self.capacity else n
+        )
         return n
 
     def restore(self, path: str) -> int:

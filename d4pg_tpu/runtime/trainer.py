@@ -221,7 +221,64 @@ class Trainer:
         if hasattr(self.env, "max_episode_steps") is False and config.max_episode_steps:
             self.env.max_episode_steps = config.max_episode_steps
         config = _reconcile_config(config, self.env)
+        # --- replay placement (ROADMAP item 1: the megastep data plane) ---
+        placement = config.replay_placement
+        if placement not in ("host", "device", "hybrid"):
+            raise ValueError(
+                f"replay_placement must be host|device|hybrid, got {placement!r}"
+            )
+        if placement == "device" and config.prioritized:
+            # device placement IS the uniform in-kernel-draw mode; PER needs
+            # the host sum-tree, which is exactly what hybrid keeps.
+            print(
+                "[replay] replay_placement=device draws uniformly in-kernel; "
+                "disabling PER for this run (replay_placement=hybrid keeps "
+                "prioritized replay with host-computed indices)"
+            )
+            config = dataclasses.replace(
+                config,
+                prioritized=False,
+                agent=dataclasses.replace(config.agent, prioritized=False),
+            )
+        if placement == "hybrid" and not config.prioritized:
+            raise ValueError(
+                "replay_placement=hybrid is the PER mode (host sum-tree "
+                "indices + on-device gather); use replay_placement=device "
+                "for uniform replay"
+            )
+        if placement != "host":
+            if config.agent.pixel_shape:
+                raise ValueError(
+                    "replay_placement=device/hybrid mirrors f32 rows into "
+                    "HBM; pixel (uint8-quantized) buffers are host-path only "
+                    "for now"
+                )
+            if config.obs_norm:
+                raise ValueError(
+                    "--obs-norm normalizes sampled batches on the host; "
+                    "it is incompatible with a device-resident ring "
+                    "(rows are gathered in-kernel)"
+                )
+            if config.transfer_dtype != "float32":
+                raise ValueError(
+                    "--transfer-dtype compresses the per-dispatch batch "
+                    "upload, which replay_placement=device/hybrid removes "
+                    "entirely; use float32"
+                )
+            if config.dp:
+                raise ValueError(
+                    "replay_placement=device/hybrid is single-device for "
+                    "now (a sharded ring is ROADMAP item 2 territory)"
+                )
+            if config.prefetch:
+                print(
+                    "[replay] --prefetch double-buffers the host batch "
+                    f"upload, which replay_placement={placement} removes; "
+                    "ignoring it"
+                )
+                config = dataclasses.replace(config, prefetch=False)
         self.config = config
+        self._placement = placement
         self.is_jax_env = not hasattr(self.env, "last_goal_obs")
         self.obs_norm = None
         if config.obs_norm:
@@ -363,6 +420,44 @@ class Trainer:
                 f"got {config.transfer_dtype!r}"
             )
 
+        # Device-resident replay + fused megastep (replay_placement !=
+        # "host"): the host buffer stays the write-side source of truth
+        # (writers/trees/snapshots unchanged) and mirrors into an HBM ring
+        # in large infrequent chunks; the steady-state grad-step dispatch
+        # then consumes only device-resident operands (runtime/megastep.py
+        # has the data-plane contract).
+        self._ring = None
+        self._ring_sync = None
+        self._megastep = None
+        self._megastep_warm = False  # first dispatch compiled (guards)
+        if self._placement != "host":
+            from d4pg_tpu.replay.device_ring import (
+                DeviceRingSync,
+                device_ring_init,
+            )
+            from d4pg_tpu.runtime.megastep import (
+                make_megastep_hybrid,
+                make_megastep_uniform,
+            )
+
+            self._ring = device_ring_init(
+                config.replay_capacity, obs_dim, act_dim
+            )
+            self._ring_sync = DeviceRingSync(self.buffer)
+            if self._placement == "device":
+                self._megastep = make_megastep_uniform(
+                    agent_cfg,
+                    max(1, config.steps_per_dispatch),
+                    config.batch_size,
+                )
+                # The megastep's index-draw key lives ON DEVICE and is
+                # split inside the jitted call — steady state has no host
+                # operand at all (this one device_put is setup, not loop).
+                self.key, mk = jax.random.split(self.key)
+                self._megastep_key = jax.device_put(mk)
+            else:
+                self._megastep = make_megastep_hybrid(agent_cfg)
+
         # Chaos harness (--chaos, d4pg_tpu/chaos): a seeded deterministic
         # fault plan. Sites owned by the trainer: wb_stall (flusher wake),
         # ckpt_truncate (after a save commits); the pool owns worker_kill
@@ -400,6 +495,12 @@ class Trainer:
             self.sentinel.track("train_step", self._train_step)
             if self._fused_step is not None:
                 self.sentinel.track("fused_step", self._fused_step)
+            if self._megastep is not None:
+                self.sentinel.track("megastep", self._megastep)
+                # One fixed chunk shape → exactly one ingest compile, ever.
+                self.sentinel.track(
+                    "ring_ingest", self._ring_sync.ingest_fn, budget=1
+                )
             self._dispatch_guard = no_implicit_transfers
             self._ledger = StagingLedger("trainer")
             if hasattr(self.buffer, "set_ledger"):
@@ -411,6 +512,16 @@ class Trainer:
         # shared by every thread and appended to each metrics.jsonl row —
         # the per-stage view bench_host_pipeline summarizes.
         self._timers = StageTimers()
+        if self._placement != "host":
+            # Pin the megastep stages into every row from the start, and —
+            # the device-placement contract — emit the structurally-absent
+            # per-dispatch host stages as explicit 0-counts rather than
+            # leaving readers to confuse absence with stale values.
+            self._timers.ensure("ingest_chunk")
+            self._timers.ensure("megastep_dispatch")
+            if self._placement == "device":
+                self._timers.ensure("sample")
+                self._timers.ensure("h2d_stage")
         self.ckpt = CheckpointManager(f"{config.log_dir}/checkpoints")
         self.grad_steps = 0
         self.env_steps = 0
@@ -1285,8 +1396,13 @@ class Trainer:
                     self.config.batch_size, self._rng, step=self.grad_steps
                 )
             else:
+                # No "weights" key on purpose: uniform IS weights are
+                # identically 1 and train_step supplies them as an
+                # in-program constant — the same program shape the uniform
+                # megastep compiles, which is what makes the two paths'
+                # seeded math byte-identical (see megastep_uniform_body;
+                # shipping a ones array as an input also wastes link bytes).
                 batch = dict(self.buffer.sample(self.config.batch_size, self._rng))
-                batch["weights"] = np.ones(self.config.batch_size, np.float32)
         if self.obs_norm is not None:
             # Normalize ONLY — statistics are ingested at collection time
             # (_ingest_obs), once per observed env step. Folding sampled
@@ -1402,6 +1518,66 @@ class Trainer:
                     for k in samples[0]
                 }
         return indices, dev_batch
+
+    def _megastep_guard(self):
+        """Transfer budget for the megastep dispatch site. Steady state
+        runs under the ZERO-transfer budget (``no_transfers``: even
+        explicit H2D and any D2H raise); the first dispatch runs under the
+        looser implicit-only guard because compilation itself stages
+        trace-time constants — warmup, not steady state."""
+        if not self._debug_guards:
+            return contextlib.nullcontext()
+        from d4pg_tpu.analysis import no_implicit_transfers, no_transfers
+
+        return no_transfers() if self._megastep_warm else no_implicit_transfers()
+
+    def _megastep_dispatch_once(self, K: int):
+        """One fused megastep dispatch (``replay_placement`` device|hybrid).
+
+        Returns ``(indices, metrics, priorities)`` — indices/priorities
+        are ``None`` on the uniform device path (no priorities to write
+        back, no host-visible index draw).
+
+        Ordering contract (hybrid): indices are sampled from the host
+        trees BEFORE the ring flush, so every slot carrying tree mass at
+        sample time is mirrored at least as fresh as the sample — the
+        device gather can never read an unmirrored (zero) row. A slot
+        recycled between sample and flush trains the newer row under the
+        older draw's IS weight — the same Hogwild-staleness class as
+        ``steps_per_dispatch``, and the generation stamp still drops its
+        priority write-back.
+        """
+        cfg = self.config
+        if self._placement == "device":
+            with self._timers.stage("ingest_chunk"):
+                self._ring = self._ring_sync.flush(self._ring)
+            with self._timers.stage("megastep_dispatch"):
+                with self._megastep_guard():
+                    self.state, self._megastep_key, metrics = self._megastep(
+                        self.state, self._ring, self._megastep_key
+                    )
+            self._megastep_warm = True
+            return None, metrics, None
+        with self._timers.stage("sample"):
+            with self._buffer_lock:
+                idx, weights, gen = self.buffer.sample_block_indices(
+                    cfg.batch_size, K, self._rng, step=self.grad_steps
+                )
+        with self._timers.stage("ingest_chunk"):
+            self._ring = self._ring_sync.flush(self._ring)
+        with self._timers.stage("h2d_stage"):
+            # The ONLY per-dispatch H2D of hybrid placement: [K, B] int32
+            # indices + f32 IS weights (explicit staging, outside the
+            # zero-transfer dispatch guard).
+            idx_dev = jax.device_put(idx.astype(np.int32))
+            w_dev = jax.device_put(weights)
+        with self._timers.stage("megastep_dispatch"):
+            with self._megastep_guard():
+                self.state, metrics, priorities = self._megastep(
+                    self.state, self._ring, idx_dev, w_dev
+                )
+        self._megastep_warm = True
+        return SampledIndices(idx, gen), metrics, priorities
 
     def _release_staging_holds(self, n: int = 1) -> None:
         """Release the oldest ``n`` staging-ledger holds: called at each
@@ -1525,40 +1701,60 @@ class Trainer:
                             self._host_collect_steps(n)
                             collect_budget -= n
 
-                # Double buffer: under --prefetch this dispatch consumes the
-                # batch staged while the PREVIOUS dispatch ran (its H2D copy
-                # is already done or in flight); first iteration primes it.
-                if staged is not None:
-                    indices, dev_batch = staged
-                    staged = None
+                if self._placement != "host":
+                    # Device-resident data plane: pending experience flushes
+                    # into the HBM ring (chunked, infrequent), then ONE
+                    # fused megastep dispatch — zero transfers (device) or
+                    # [K, B]-index-only (hybrid). No staged host batch
+                    # exists in this mode.
+                    indices, metrics, priorities = self._megastep_dispatch_once(K)
                 else:
-                    indices, dev_batch = self._sample_staged(K)
-                # dispatch is async: the TPU runs while we prefetch the next
-                # batch and write back the PREVIOUS step's priorities
-                with self._timers.stage("train_dispatch"):
-                    # _dispatch_guard (--debug-guards): the steady-state
-                    # dispatch may only consume device-resident operands —
-                    # an implicit host→device transfer (a numpy array or
-                    # python scalar smuggled into the batch) raises here
-                    # instead of silently re-uploading every step.
-                    with self._dispatch_guard():
-                        if K == 1:
-                            self.state, metrics, priorities = self._train_step(
-                                self.state, dev_batch
-                            )
-                        else:
-                            self.state, metrics_k, priorities = self._fused_step(
-                                self.state, dev_batch
-                            )
-                            metrics = jax.tree.map(
-                                lambda x: x.mean(), metrics_k
-                            )
+                    # Double buffer: under --prefetch this dispatch consumes
+                    # the batch staged while the PREVIOUS dispatch ran (its
+                    # H2D copy is already done or in flight); first
+                    # iteration primes it.
+                    if staged is not None:
+                        indices, dev_batch = staged
+                        staged = None
+                    else:
+                        indices, dev_batch = self._sample_staged(K)
+                    # dispatch is async: the TPU runs while we prefetch the
+                    # next batch and write back the PREVIOUS step's
+                    # priorities
+                    with self._timers.stage("train_dispatch"):
+                        # _dispatch_guard (--debug-guards): the steady-state
+                        # dispatch may only consume device-resident operands
+                        # — an implicit host→device transfer (a numpy array
+                        # or python scalar smuggled into the batch) raises
+                        # here instead of silently re-uploading every step.
+                        with self._dispatch_guard():
+                            if K == 1:
+                                self.state, metrics, priorities = self._train_step(
+                                    self.state, dev_batch
+                                )
+                            else:
+                                self.state, metrics_k, priorities = self._fused_step(
+                                    self.state, dev_batch
+                                )
+                                metrics = jax.tree.map(
+                                    lambda x: x.mean(), metrics_k
+                                )
                 if self.sentinel is not None and grad_steps_done == 0:
                     # First dispatch done: its compiles ARE the budget (one
                     # program per config). Any later growth is a traced arg
                     # degrading to a constant or a shape/dtype drift.
-                    name = "train_step" if K == 1 else "fused_step"
-                    self.sentinel.set_budget(name, self.sentinel.count(name))
+                    if self._placement != "host":
+                        # megastep only: ring_ingest keeps its track-time
+                        # budget of 1 (one fixed chunk shape = one compile,
+                        # EVER) — re-pinning it to the observed count here
+                        # would silently bless a phantom warmup-flush
+                        # recompile, the exact bug the budget exists for.
+                        self.sentinel.set_budget(
+                            "megastep", self.sentinel.count("megastep")
+                        )
+                    else:
+                        name = "train_step" if K == 1 else "fused_step"
+                        self.sentinel.set_budget(name, self.sentinel.count(name))
                 if cfg.prefetch and grad_steps_done + K < total:
                     # Sample batch N+1 and start its device_put NOW, under
                     # step N's device compute. The staged batch sees replay

@@ -66,6 +66,8 @@ class StageTimers:
         "h2d_stage",           # wire-format cast + device_put enqueue
         "train_dispatch",      # jitted train-step dispatch (async enqueue)
         "priority_writeback",  # D2H priority fetch + gen-filtered tree set
+        "ingest_chunk",        # device-ring mirror flush (chunked H2D)
+        "megastep_dispatch",   # device-resident megastep dispatch (enqueue)
     )
 
     def __init__(self, annotate_prefix: str | None = "host/"):
@@ -90,6 +92,19 @@ class StageTimers:
             with self._lock:
                 self._acc[name] = self._acc.get(name, 0.0) + dt
                 self._n[name] = self._n.get(name, 0) + 1
+
+    def ensure(self, name: str) -> None:
+        """Pin a stage into the scalars at an explicit 0s/0-call count.
+
+        Stages that a mode makes structurally impossible (``h2d_stage``
+        under ``replay_placement=device``: there IS no per-dispatch batch
+        upload) should read as an explicit zero in every metrics row, not
+        be absent — absence is indistinguishable from "telemetry broke",
+        and a reader diffing rows across placements would otherwise
+        carry the last host-mode value forward as if it were current."""
+        with self._lock:
+            self._acc.setdefault(name, 0.0)
+            self._n.setdefault(name, 0)
 
     def scalars(self) -> dict:
         """Flat metrics row: ``stage_<name>_s`` cumulative seconds plus

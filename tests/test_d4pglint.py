@@ -283,6 +283,32 @@ FIXTURES = [
                         time.sleep(0.1)  # bounded by the INNER range
         """,
     ),
+    (
+        "device-loop-transfer",
+        "d4pg_tpu/runtime/megastep.py",
+        """
+        import numpy as np
+
+        def megastep_uniform_body(config, k, batch, state, ring, key):
+            idx = np.arange(4)
+            return ring.size.item()
+        """,
+        """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def megastep_uniform_body(config, k, batch, state, ring, key):
+            idx = jnp.arange(4)
+
+            def loss(p):  # nested closures trace too — but this is clean
+                return jnp.sum(p[idx])
+
+            return loss
+
+        def host_helper(x):
+            return np.asarray(x).item()  # not in the manifest: fine
+        """,
+    ),
 ]
 
 assert {f[0] for f in FIXTURES} == set(ALL_CHECKS), "fixture per check"

@@ -1,0 +1,86 @@
+"""Tier-1 smokes for the megastep-vs-host data-plane microbench.
+
+Two halves, mirroring the other benchmark smokes:
+
+- the GENERATOR runs end-to-end at tiny shapes (so a refactor that breaks
+  ``bench_megastep``/``run_microbench`` fails here, not at artifact-regen
+  time) — timing ratios are NOT asserted at this scale (CPU noise);
+- the COMMITTED artifact (``benchmarks/megastep_microbench.json``) keeps
+  its schema and the acceptance headline: megastep >= host-path steps/s
+  on the committed run, and strictly lower per-grad-step transfer bytes
+  (zero for the device placement — the whole point of the data plane).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+pytest.importorskip("jax")
+
+ARTIFACT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "benchmarks",
+    "megastep_microbench.json",
+)
+
+
+def test_generator_runs_at_small_shape(tmp_path):
+    from benchmarks.megastep_microbench import run_microbench
+
+    out_path = str(tmp_path / "megastep_microbench.json")
+    out = run_microbench(
+        out_path, batch=16, k=4, hidden=32, rows=1024, steps=3, repeats=1
+    )
+    assert os.path.exists(out_path)
+    for name in ("host_block_k32", "hybrid_k32", "device_k32"):
+        row = out[name]
+        assert row["steps_per_sec"] > 0
+        assert row["transfer_bytes_per_grad_step"] >= 0
+    # the structural (not timing) halves of the claim hold at ANY shape:
+    assert out["device_k32"]["transfer_bytes_per_grad_step"] == 0.0
+    assert (
+        out["hybrid_k32"]["transfer_bytes_per_grad_step"]
+        < out["host_block_k32"]["transfer_bytes_per_grad_step"]
+    )
+    # hybrid per-grad-step H2D is exactly the [K, B] int32 idx + f32
+    # weights upload amortized over K: B·(4+4) bytes per grad step
+    assert out["hybrid_k32"]["h2d_bytes_per_grad_step"] == 16 * 8
+    with open(out_path) as f:
+        json.load(f)  # artifact is valid JSON
+
+
+def test_committed_artifact_schema_and_headline():
+    with open(ARTIFACT) as f:
+        doc = json.load(f)
+    assert doc["metric"] == "megastep_microbench"
+    assert "backend" in doc and "on_chip_recipe" in doc
+    for name in ("host_block_k32", "hybrid_k32", "device_k32"):
+        row = doc[name]
+        assert row["steps_per_sec"] > 0
+        assert "transfer_bytes_per_grad_step" in row
+        assert "steps_per_sec_repeats" in row
+    # the acceptance headline: megastep >= host steps/s on the committed
+    # run, strictly lower transfer bytes (0 on the device placement)
+    assert doc["device_k32_steps_ratio"] >= 1.0
+    assert doc["hybrid_k32_steps_ratio"] >= 1.0
+    assert doc["device_k32"]["transfer_bytes_per_grad_step"] == 0.0
+    assert (
+        doc["hybrid_k32"]["transfer_bytes_per_grad_step"]
+        < doc["host_block_k32"]["transfer_bytes_per_grad_step"]
+    )
+
+
+def test_committed_mfu_sweep_has_megastep_rows():
+    sweep = os.path.join(os.path.dirname(ARTIFACT), "mfu_sweep_results.json")
+    with open(sweep) as f:
+        rows = json.load(f)
+    mega = [r for r in rows if str(r.get("config", "")).startswith("megastep")]
+    assert mega, "mfu_sweep_results.json lost its megastep rows"
+    for r in mega:
+        assert r["bench"] == "mfu_sweep"
+        assert "backend" in r  # CPU placeholders must be distinguishable
+        assert r["transfer_bytes_per_grad_step"] == 0.0
+        assert r["steps_per_sec"] > 0

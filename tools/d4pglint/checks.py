@@ -18,6 +18,7 @@ from tools.d4pglint.config import (
     HOST_ONLY_MODULES,
     HOT_PATH_FUNCTIONS,
     JAX_FAMILY,
+    MEGASTEP_FUNCTIONS,
     JIT_WRAPPER_CALLS,
     RNG_OK,
 )
@@ -668,4 +669,70 @@ def global_rng(tree, src_lines, relpath):
                     "np.random.Generator (default_rng) instead",
                 )
             )
+    return out
+
+
+# ----------------------------------------------------------------- check 11
+@check("device-loop-transfer")
+def device_loop_transfer(tree, src_lines, relpath):
+    """The MEGASTEP_FUNCTIONS manifest names the jit-traced bodies of the
+    device-resident data plane (megastep + ring ingest). Host numpy calls
+    inside them bake trace-time constants or smuggle an implicit H2D
+    upload into the zero-transfer dispatch; ``.item()`` / ``__array__``
+    coercions force a blocking D2H sync per call. Unlike hot-path-alloc,
+    nested defs are scanned too — loss closures trace with the body."""
+    wanted = {}
+    for entry in MEGASTEP_FUNCTIONS:
+        suffix, qual = entry.split("::")
+        if relpath.endswith(suffix):
+            wanted[qual] = entry
+    if not wanted:
+        return []
+    out = []
+
+    def scan_fn(fn: ast.FunctionDef, qual: str):
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Call):
+                dotted = _dotted(sub.func) or ""
+                if dotted.split(".")[0] in ("np", "numpy"):
+                    out.append(
+                        Finding(
+                            "device-loop-transfer", relpath, sub.lineno,
+                            f"`{dotted}` inside jit-traced megastep body "
+                            f"`{qual}`: host numpy bakes a trace-time "
+                            "constant or forces an implicit H2D transfer "
+                            "into the zero-transfer loop — use jnp",
+                        )
+                    )
+                elif (
+                    isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "item"
+                ):
+                    out.append(
+                        Finding(
+                            "device-loop-transfer", relpath, sub.lineno,
+                            f"`.item()` inside jit-traced megastep body "
+                            f"`{qual}`: forces a blocking device→host sync "
+                            "per call (and fails under the zero-transfer "
+                            "guard)",
+                        )
+                    )
+            elif isinstance(sub, ast.Attribute) and sub.attr == "__array__":
+                out.append(
+                    Finding(
+                        "device-loop-transfer", relpath, sub.lineno,
+                        f"`__array__` coercion inside jit-traced megastep "
+                        f"body `{qual}`: implicit device→host materialization",
+                    )
+                )
+
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef) and node.name in wanted:
+            scan_fn(node, node.name)
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        for m in cls.body:
+            if isinstance(m, ast.FunctionDef):
+                qual = f"{cls.name}.{m.name}"
+                if qual in wanted:
+                    scan_fn(m, qual)
     return out

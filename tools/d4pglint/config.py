@@ -20,6 +20,7 @@ ALL_CHECKS = (
     "thread-discipline",     # threads are named daemons
     "global-rng",            # seeded Generators only, no np.random module state
     "unbounded-retry",       # retry loops use the bounded Backoff util
+    "device-loop-transfer",  # no host numpy / .item() in megastep bodies
 )
 
 # What `python -m tools.d4pglint` lints when given no paths: the product
@@ -74,6 +75,22 @@ HOT_PATH_FUNCTIONS = (
     "d4pg_tpu/serve/batcher.py::DynamicBatcher._device_loop",
     "d4pg_tpu/serve/batcher.py::DynamicBatcher._reply_loop",
     "d4pg_tpu/serve/batcher.py::DynamicBatcher.submit",
+)
+
+# The jit-traced bodies of the device-resident data plane (the megastep
+# and the ring ingest — `module suffix::qualname` keys, same convention
+# as HOT_PATH_FUNCTIONS, nested defs INCLUDED since loss closures trace
+# too). Inside them, `np.*` calls bake trace-time constants or force an
+# implicit H2D upload per dispatch, and `.item()`/`__array__` coercions
+# force a blocking D2H sync — each one silently breaks the megastep's
+# zero-transfer contract that `--debug-guards` enforces at runtime
+# (analysis/transfer.py:no_transfers). The lint catches it at review
+# time, on every code path, not just the ones a guarded run executes.
+MEGASTEP_FUNCTIONS = (
+    "d4pg_tpu/runtime/megastep.py::megastep_uniform_body",
+    "d4pg_tpu/runtime/megastep.py::megastep_hybrid_body",
+    "d4pg_tpu/runtime/megastep.py::draw_uniform_indices",
+    "d4pg_tpu/replay/device_ring.py::ingest_body",
 )
 
 # numpy allocators flagged inside hot-path functions (np.asarray is

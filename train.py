@@ -146,6 +146,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="grad steps fused into one device dispatch (K>1 "
                         "amortizes dispatch latency; PER priorities update "
                         "once per dispatch)")
+    p.add_argument("--replay-placement", choices=["host", "device", "hybrid"],
+                   default="host",
+                   help="where sampled batches live: host = per-dispatch "
+                        "H2D batch upload (the seeded oracle); device = "
+                        "HBM-resident ring + fused megastep with in-kernel "
+                        "uniform draws and ZERO per-grad-step transfers; "
+                        "hybrid = PER indices/IS-weights from the host "
+                        "sum-tree ([K,B] int32 up, [K,B] priorities back), "
+                        "rows gathered on-device (docs/data_plane.md)")
     p.add_argument("--prefetch", action="store_true",
                    help="double-buffered replay->device pipeline: batch N+1 "
                         "is host-sampled and its device_put started while "
@@ -289,6 +298,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         batch_size=args.batch_size,
         steps_per_dispatch=args.steps_per_dispatch,
         prefetch=args.prefetch,
+        replay_placement=args.replay_placement,
         env_steps_per_train_step=args.env_steps_per_train_step,
         pool_start_method=args.pool_start_method,
         actor_device=args.actor_device,
@@ -513,6 +523,12 @@ def main(argv=None) -> None:
                 "--chaos targets the host runtime's fault surfaces (pool "
                 "workers, flusher, checkpoint commit); the on-device path "
                 "has none of them (the flag would be silently ignored)"
+            )
+        if args.replay_placement != "host":
+            raise SystemExit(
+                "--replay-placement configures the HOST trainer's data "
+                "plane; --on-device already keeps rollout+replay+learn in "
+                "one XLA program (the flag would be silently ignored)"
             )
         from d4pg_tpu.runtime.on_device import run_on_device
 
