@@ -18,6 +18,10 @@ Spec syntax (entries separated by ``;`` or ``,``)::
     ckpt_truncate@2       truncate the 2nd checkpoint after it commits
     wb_stall@3:0.5        stall the priority flusher 0.5 s at wake 3
     sock_reset@5          force-reset the serve conn at its 5th frame
+    partition@7           abortive-close the fleet ingest conn, frame 7
+    reconnect_flap@2      fleet actor: drop its 2nd connection post-HELLO
+    stale_bundle@1        fleet actor: skip its 1st bundle hot-swap
+    slow_link@3:250       fleet actor: stall its 3rd frame send 250 ms
 
 ``count`` is 1-based and counted *at the site* (a worker counts its own
 env steps; the pool counts pool steps; the flusher counts wakes), which
@@ -41,6 +45,15 @@ site                  tick location               recovery proven
 ``ckpt_truncate``     trainer, per checkpoint     verify-on-restore fallback
 ``wb_stall``          writeback flusher, per wake  hold pacing (guards green)
 ``sock_reset``        serve conn, per frame       reader survives, drop count
+``partition``         ingest conn, per frame      actor Backoff reconnect,
+                                                  unacked windows dropped
+``reconnect_flap``    fleet actor, per connect    bounded Backoff, no dup
+                                                  windows after the flap
+``stale_bundle``      fleet actor, per hot-swap   stale-gen windows counted
+                                                  + discarded at ingest
+``slow_link``         fleet actor, per frame      flow control absorbs the
+                                                  stall; read deadline
+                                                  tolerates live-but-slow
 ====================  ==========================  =========================
 """
 
@@ -60,6 +73,14 @@ KNOWN_SITES = WORKER_SITES + (
     "ckpt_truncate",
     "wb_stall",
     "sock_reset",
+    # fleet sites (d4pg_tpu/fleet): partition ticks in the learner's
+    # ingest reader (server-side abortive close mid-stream); the other
+    # three tick inside the fleet actor CLI's own injector (--chaos on
+    # python -m d4pg_tpu.fleet.actor).
+    "partition",
+    "reconnect_flap",
+    "stale_bundle",
+    "slow_link",
 )
 
 

@@ -104,6 +104,30 @@ class TrainConfig:
     # None = no injection (production). The plan is deterministic in
     # per-site event counts, so a chaos run replays exactly.
     chaos: Optional[str] = None
+    # Networked collection fleet (d4pg_tpu/fleet, docs/fleet.md): when
+    # fleet_listen is set, the trainer runs an experience-ingest server on
+    # that port (0 = ephemeral, printed at startup) and remote actor hosts
+    # (python -m d4pg_tpu.fleet.actor) stream complete n-step windows into
+    # the replay buffer — alongside local collection, or INSTEAD of it when
+    # num_envs == 0 (the learner then paces against ingested windows the
+    # way async_collect paces against the pool).
+    fleet_listen: Optional[int] = None
+    # Ingest bind address: 0.0.0.0 so remote actor hosts can actually
+    # reach it (the point of a NETWORKED fleet); set 127.0.0.1 for a
+    # loopback-only fleet (the smoke/soak scripts' localhost topology
+    # works either way).
+    fleet_host: str = "0.0.0.0"
+    # Weight distribution for fleet actors: the trainer re-exports the
+    # serving bundle into this directory (atomic params-first/json-second —
+    # the same attestation serve hot-reload keys on) every
+    # fleet_publish_interval grad steps, bumping the bundle GENERATION;
+    # ingest drops windows older than generation − fleet_max_gen_lag.
+    fleet_bundle: Optional[str] = None
+    fleet_publish_interval: int = 200
+    fleet_max_gen_lag: int = 1
+    # Bounded ingest admission queue (frames): past it the ingest answers
+    # OVERLOADED(queue_full) — the serve batcher's explicit-shed contract.
+    fleet_queue_limit: int = 64
     # Where host-env collection/eval forwards run: "cpu" jits the actor on
     # the host CPU backend against published numpy params, "default" uses
     # the accelerator, "auto" picks cpu whenever the default backend is an

@@ -1,0 +1,25 @@
+"""Networked collection fleet: remote actor hosts → experience-ingest.
+
+The Ape-X/SEED-RL input-side decomposition (Horgan et al. 2018; Espeholt
+et al. 2020) applied to this trainer: many actor hosts run env + a NumPy
+policy locally against a periodically-synced serving bundle and stream
+complete n-step windows to the learner's replay writer over the same
+length-prefixed framed protocol the policy server speaks
+(``d4pg_tpu/serve/protocol.py``).
+
+Pieces (docs/fleet.md has the full contract):
+
+- :mod:`d4pg_tpu.fleet.wire`   — payload codecs for the fleet frames;
+- :mod:`d4pg_tpu.fleet.policy` — NumPy-only bundle loader + MLP forward
+  (the actor host's hot path never imports JAX);
+- :mod:`d4pg_tpu.fleet.ingest` — learner-side ingest server: bounded-queue
+  admission with explicit shed, generation-tagged stale drops, writer
+  thread feeding ``ReplayBuffer.add_batch``;
+- :mod:`d4pg_tpu.fleet.actor`  — the remote actor host CLI
+  (``python -m d4pg_tpu.fleet.actor``).
+
+This package is deliberately import-light: every module here is JAX-free
+(d4pglint ``host-jax-import`` manifest) so an actor host never pulls the
+JAX runtime, and the learner can construct the ingest server before any
+backend decision.
+"""

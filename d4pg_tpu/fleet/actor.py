@@ -1,0 +1,791 @@
+"""Remote actor host: env + NumPy policy → windows over the wire.
+
+``python -m d4pg_tpu.fleet.actor --connect HOST:PORT --bundle DIR``
+
+One process per actor host, **provably JAX-free on the hot path** (the
+d4pglint ``host-jax-import`` manifest covers this module, and a tier-1
+subprocess test asserts no ``jax*`` module ever loads): the policy is a
+:class:`~d4pg_tpu.fleet.policy.NumpyPolicy` evaluated from a serving
+bundle directory, the env is the shared host adapter
+(``envs/gym_adapter.make_host_env``), and the n-step collapse is the
+repo's own :class:`~d4pg_tpu.replay.nstep_writer.NStepWriter` pointed at
+a local spool — so the windows that cross the wire are column-for-column
+what the in-process writer path would have inserted (parity-tested).
+
+Weight distribution IS the bundle attestation: the trainer re-exports the
+bundle (params first, json second, each atomic) at every publish
+interval; this host polls ``bundle.json``'s mtime and hot-swaps the whole
+policy — params, obs-norm stats, and the bundle **generation** — between
+env steps, exactly like the serve reload watcher. Windows are tagged with
+the generation of the policy that produced them, so the ingest server can
+drop stale experience with an honest count.
+
+Failure semantics (docs/fleet.md has the full table):
+
+- **reconnect** under the shared bounded ``utils/retry.py:Backoff``;
+  **resume-safe**: frames unacknowledged at disconnect are dropped, never
+  resent (at-most-once — a duplicate window silently double-weights a
+  transition, a dropped one just costs a little data), and the spool of
+  not-yet-sent windows survives the reconnect;
+- **flow control**: at most ``max_inflight`` unacked frames (server-
+  advertised in HELLO_OK); when credits run out the env loop blocks —
+  collection backpressure, not unbounded buffering. While DISCONNECTED
+  the bounded spool drops its oldest windows instead (a dead learner
+  must not grow this host's memory without limit);
+- **explicit shed**: an ``OVERLOADED(queue_full)`` ack counts the frame's
+  windows shed and moves on — mirroring the serve client contract.
+
+SIGTERM/SIGINT drain: stop stepping, flush the spool's complete windows,
+wait briefly for acks, print the final counter summary, exit 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+import sys
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from d4pg_tpu.fleet import wire
+from d4pg_tpu.fleet.policy import NumpyPolicy, bundle_meta_mtime, load_numpy_policy
+from d4pg_tpu.replay.nstep_writer import NStepWriter
+from d4pg_tpu.serve import protocol
+from d4pg_tpu.serve.protocol import ProtocolError
+from d4pg_tpu.utils.retry import Backoff
+
+STAT_KEYS = (
+    "env_steps",
+    "episodes",
+    "windows_emitted",
+    "windows_sent",
+    "windows_acked",
+    "windows_shed",
+    "windows_stale",
+    "windows_dropped_reconnect",
+    "windows_dropped_spool",
+    "frames_sent",
+    "reconnects",
+    "bundle_reloads",
+    "generation",
+)
+
+
+class _Spool:
+    """Bounded FIFO of complete windows, each row tagged with the bundle
+    generation that produced it. ``add`` is the duck-typed buffer target
+    :class:`NStepWriter` emits into. Single-threaded (the env loop owns
+    it); bounded so a long disconnection cannot grow host memory — the
+    oldest windows go first (they are the stalest anyway)."""
+
+    def __init__(self, limit: int):
+        self.limit = int(limit)
+        self.rows: deque = deque()
+        self.dropped = 0
+        self.generation = 0  # stamped by the actor at every policy swap
+
+    def add(self, obs, action, reward, next_obs, discount) -> None:
+        if len(self.rows) >= self.limit:
+            self.rows.popleft()
+            self.dropped += 1
+        self.rows.append(
+            (
+                self.generation,
+                np.asarray(obs, np.float32),
+                np.asarray(action, np.float32),
+                float(reward),
+                np.asarray(next_obs, np.float32),
+                float(discount),
+            )
+        )
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    def take_frame(self, max_rows: int):
+        """Pop the longest same-generation prefix up to ``max_rows`` →
+        ``(generation, columns)`` or None when empty. Same-generation so a
+        frame's single gen tag is honest across a mid-spool policy swap."""
+        if not self.rows:
+            return None
+        gen = self.rows[0][0]
+        rows = []
+        while self.rows and len(rows) < max_rows and self.rows[0][0] == gen:
+            rows.append(self.rows.popleft())
+        return gen, {
+            "obs": np.stack([r[1] for r in rows]),
+            "action": np.stack([r[2] for r in rows]),
+            "reward": np.asarray([r[3] for r in rows], np.float32),
+            "next_obs": np.stack([r[4] for r in rows]),
+            "discount": np.asarray([r[5] for r in rows], np.float32),
+        }
+
+
+class FleetLink:
+    """One connection to the ingest server: synchronous HELLO handshake,
+    then pipelined WINDOWS frames acked on a reader thread, bounded by the
+    server-advertised in-flight window."""
+
+    # d4pglint shared-mutable-state: single transition None→exception by
+    # the reader thread; senders check-then-fail (PolicyClient pattern)
+    _THREAD_SAFE = ("_dead",)
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        hello: dict,
+        *,
+        on_ack,
+        connect_timeout_s: float = 10.0,
+    ):
+        import socket
+
+        self._on_ack = on_ack  # (kind, n) kind ∈ accepted|stale|shed|dropped
+        self._sock = socket.create_connection(
+            (host, port), timeout=connect_timeout_s
+        )
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            protocol.write_frame(
+                self._sock, protocol.HELLO, 0, wire.encode_hello(**hello)
+            )
+            frame = protocol.read_frame(self._sock)  # timeout still armed
+            if frame is None:
+                raise ProtocolError("server closed during handshake")
+            msg_type, _req_id, payload = frame
+            if msg_type == protocol.ERROR:
+                raise RuntimeError(
+                    f"ingest refused handshake: {payload.decode('utf-8', 'replace')}"
+                )
+            if msg_type != protocol.HELLO_OK:
+                raise ProtocolError(f"unexpected handshake reply {msg_type}")
+            ok = wire.decode_hello_ok(payload)
+        except BaseException:
+            self._sock.close()
+            raise
+        self.server_generation = int(ok["generation"])
+        self.max_windows = int(ok["max_windows_per_frame"])
+        self.max_inflight = int(ok["max_inflight"])
+        # Reader blocks between acks indefinitely — the handshake timeout
+        # must not kill an idle-but-healthy connection.
+        self._sock.settimeout(None)
+        self._rfile = self._sock.makefile("rb")
+        self._credits = threading.Semaphore(self.max_inflight)
+        self._pending: dict = {}  # req_id -> window count
+        self._pending_lock = threading.Lock()
+        self._next_id = 0
+        self._dead: Optional[Exception] = None
+        self._closed = False
+        self._reader = threading.Thread(
+            target=self._read_loop, name="fleet-link-reader", daemon=True
+        )
+        self._reader.start()
+
+    @property
+    def dead(self) -> Optional[Exception]:
+        return self._dead
+
+    def acquire_credit(self, timeout: float) -> bool:
+        """Flow control: returns once an in-flight slot frees (True) or the
+        timeout lapses / the link died (False)."""
+        if self._dead is not None:
+            return False
+        return self._credits.acquire(timeout=timeout)
+
+    def release_credit(self) -> None:
+        """Hand back an acquired-but-unused credit (nothing was sent)."""
+        self._credits.release()
+
+    def send_windows(self, generation: int, cols: dict) -> int:
+        """Ship one frame (caller holds a credit). Returns its window
+        count; raises OSError on a dead/broken socket. Drop accounting for
+        a failed send lives HERE, exactly once: either this thread pops
+        the pending entry (and counts it), or the reader's death sweep
+        already did — never both."""
+        n = len(cols["reward"])
+        with self._pending_lock:
+            self._next_id = (self._next_id + 1) & 0xFFFFFFFF
+            req_id = self._next_id
+            self._pending[req_id] = n
+        if self._dead is not None:
+            self._fail_send(req_id)
+            raise OSError("link is dead")
+        try:
+            protocol.write_frame(
+                self._sock,
+                protocol.WINDOWS,
+                req_id,
+                wire.encode_windows(
+                    generation,
+                    cols["obs"],
+                    cols["action"],
+                    cols["reward"],
+                    cols["next_obs"],
+                    cols["discount"],
+                ),
+            )
+        except OSError:
+            self._fail_send(req_id)
+            raise
+        return n
+
+    def _fail_send(self, req_id: int) -> None:
+        """A registered frame never made it out: count its windows dropped
+        — unless the reader's death sweep got there first (pop tells us)."""
+        with self._pending_lock:
+            n = self._pending.pop(req_id, None)
+        if n is not None:
+            self._on_ack("dropped", n)
+
+    def _read_loop(self) -> None:
+        err: Exception = ConnectionError("server closed the connection")
+        try:
+            while True:
+                frame = protocol.read_frame(self._rfile)
+                if frame is None:
+                    break
+                msg_type, req_id, payload = frame
+                with self._pending_lock:
+                    n = self._pending.pop(req_id, None)
+                if n is None:
+                    if msg_type == protocol.ERROR:
+                        err = RuntimeError(
+                            payload.decode("utf-8", "replace")
+                        )
+                        break
+                    continue
+                if msg_type == protocol.WINDOWS_OK:
+                    accepted, stale = wire.decode_windows_ok(payload)
+                    if accepted:
+                        self._on_ack("accepted", accepted)
+                    if stale:
+                        self._on_ack("stale", stale)
+                elif msg_type == protocol.OVERLOADED:
+                    self._on_ack("shed", n)  # explicit queue_full shed
+                elif msg_type == protocol.ERROR:
+                    # the frame died server-side with the connection
+                    self._on_ack("dropped", n)
+                    err = RuntimeError(payload.decode("utf-8", "replace"))
+                    break
+                self._credits.release()
+        except (OSError, ProtocolError) as e:
+            if not self._closed:
+                err = ConnectionError(str(e))
+        finally:
+            # mark dead FIRST, then sweep: a racing send either lands in
+            # the swept dict (counted dropped here) or sees _dead after
+            # registering and fails itself
+            self._dead = err
+            with self._pending_lock:
+                pending, self._pending = list(self._pending.values()), {}
+            # in-flight at disconnect: dropped, never resent (at-most-once)
+            for n in pending:
+                self._on_ack("dropped", n)
+                self._credits.release()
+
+    def inflight(self) -> int:
+        with self._pending_lock:
+            return len(self._pending)
+
+    def abort(self) -> None:
+        """Abortive close (chaos ``reconnect_flap``): RST the server so
+        both sides see the flap."""
+        protocol.abortive_close(self._sock)
+        self.close()
+
+    def close(self) -> None:
+        import socket
+
+        self._closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._reader.join(timeout=5)
+        try:
+            self._rfile.close()
+        except OSError:
+            pass
+
+
+class FleetActor:
+    """The env + policy + uplink loop. Construct, then :meth:`run`."""
+
+    def __init__(
+        self,
+        *,
+        connect: str,
+        bundle_dir: str,
+        env_id: Optional[str] = None,
+        num_envs: int = 1,
+        seed: int = 0,
+        noise_sigma: float = 0.3,
+        batch_windows: int = 16,
+        spool_limit: int = 1024,
+        poll_interval_s: float = 2.0,
+        max_env_steps: int = 0,
+        stats_interval_s: float = 10.0,
+        reconnect_attempts: int = 60,
+        connect_timeout_s: float = 10.0,
+        stop_event: Optional[threading.Event] = None,
+        chaos=None,
+        actor_id: Optional[str] = None,
+    ):
+        host, _, port = connect.rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(f"--connect must be HOST:PORT, got {connect!r}")
+        self.host, self.port = host, int(port)
+        self.bundle_dir = bundle_dir
+        self.policy: NumpyPolicy = load_numpy_policy(bundle_dir)
+        self.env_id = env_id or self.policy.env
+        if not self.env_id:
+            raise ValueError(
+                "bundle carries no env id; pass --env explicitly"
+            )
+        self.num_envs = int(num_envs)
+        if self.num_envs < 1:
+            raise ValueError(
+                f"--num-envs must be >= 1, got {num_envs} (a fleet actor "
+                "host exists to run envs; 0 envs is the learner-side "
+                "train.py --num-envs 0 flag, not this one)"
+            )
+        self.seed = int(seed)
+        self.noise_sigma = float(noise_sigma)
+        self.batch_windows = int(batch_windows)
+        self.poll_interval_s = float(poll_interval_s)
+        self.max_env_steps = int(max_env_steps)
+        self.stats_interval_s = float(stats_interval_s)
+        self.reconnect_attempts = int(reconnect_attempts)
+        self.connect_timeout_s = float(connect_timeout_s)
+        self._stop = stop_event if stop_event is not None else threading.Event()
+        self._chaos = chaos
+        self.actor_id = actor_id or f"{self.env_id}-actor"
+        self._rng = np.random.default_rng(seed)
+        self.spool = _Spool(spool_limit)
+        self.spool.generation = self.policy.generation
+        self._bundle_mtime = self.policy.mtime
+        self._link: Optional[FleetLink] = None
+        # Paced-reconnect state: while disconnected the env loop keeps
+        # collecting (the bounded spool absorbs) and _ensure_link makes at
+        # most one non-blocking attempt whenever _retry_at has passed.
+        self._backoff: Optional[Backoff] = None
+        self._retry_at = 0.0
+        self._stats = dict.fromkeys(STAT_KEYS, 0)
+        self._stats["generation"] = self.policy.generation
+        self._stats_lock = threading.Lock()  # reader thread acks vs main
+
+        from d4pg_tpu.envs.gym_adapter import make_host_env
+
+        self.envs = [make_host_env(self.env_id) for _ in range(self.num_envs)]
+        self.writers = [
+            NStepWriter(self.spool, self.policy.n_step, self.policy.gamma)
+            for _ in range(self.num_envs)
+        ]
+        self._obs = np.stack(
+            [
+                env.reset(seed=self.seed + 1000 * i)
+                for i, env in enumerate(self.envs)
+            ]
+        ).astype(np.float32)
+        if self._obs.shape[1] != self.policy.obs_dim:
+            raise ValueError(
+                f"env {self.env_id!r} observations are "
+                f"{self._obs.shape[1]}-dim, bundle policy expects "
+                f"{self.policy.obs_dim}"
+            )
+
+    # ---------------------------------------------------------------- stats
+    def _inc(self, key: str, n: int = 1) -> None:
+        with self._stats_lock:
+            self._stats[key] += n
+
+    def stats(self) -> dict:
+        with self._stats_lock:
+            out = dict(self._stats)
+        out["windows_dropped_spool"] = self.spool.dropped
+        out["spool_depth"] = len(self.spool)
+        return out
+
+    def _on_ack(self, kind: str, n: int) -> None:
+        self._inc(
+            {
+                "accepted": "windows_acked",
+                "stale": "windows_stale",
+                "shed": "windows_shed",
+                "dropped": "windows_dropped_reconnect",
+            }[kind],
+            n,
+        )
+
+    def request_stop(self) -> None:
+        """Signal-safe: just set the event (install_graceful_signals)."""
+        self._stop.set()
+
+    # ----------------------------------------------------------------- link
+    def _hello(self) -> dict:
+        """The HELLO handshake payload — single source for every connect
+        path (_ensure_link and the drain reconnect) so the two can never
+        drift on a field."""
+        return dict(
+            actor_id=self.actor_id,
+            env=self.env_id,
+            obs_dim=self.policy.obs_dim,
+            action_dim=self.policy.action_dim,
+            n_step=self.policy.n_step,
+            gamma=self.policy.gamma,
+            generation=self.policy.generation,
+        )
+
+    def _ensure_link(self) -> bool:
+        """Connected, or ONE non-blocking paced reconnect attempt under the
+        bounded Backoff schedule. False while disconnected — the caller's
+        env loop keeps collecting and the bounded spool absorbs (dropping
+        its oldest past the limit: windows_dropped_spool) instead of this
+        host blocking through the whole reconnect budget. Raises
+        RuntimeError once the attempt budget is spent."""
+        if self._link is not None and self._link.dead is None:
+            return True
+        if self._link is not None:
+            self._link.close()  # sweeps unacked → windows_dropped_reconnect
+            self._link = None
+            self._inc("reconnects")
+        if self._backoff is None:
+            self._backoff = Backoff(
+                base_s=0.2,
+                max_s=5.0,
+                max_attempts=self.reconnect_attempts,
+                rng=random.Random(self.seed),  # deterministic jitter (chaos)
+            )
+            self._retry_at = time.monotonic()  # first attempt is free
+        if self._stop.is_set() or time.monotonic() < self._retry_at:
+            return False
+        try:
+            link = FleetLink(
+                self.host,
+                self.port,
+                self._hello(),
+                on_ack=self._on_ack,
+                connect_timeout_s=self.connect_timeout_s,
+            )
+        except (OSError, ProtocolError) as e:
+            return self._retry_later(e)
+        if self._chaos is not None:
+            e = self._chaos.tick("reconnect_flap")
+            if e is not None:
+                # Injected flap: abortive close right after a good
+                # handshake — the next attempt runs under the same
+                # (reset-on-success is NOT reached) backoff schedule.
+                link.abort()
+                self._inc("reconnects")
+                return self._retry_later(RuntimeError("chaos reconnect_flap"))
+        self._backoff = None
+        self._link = link
+        if link.server_generation > self.policy.generation:
+            # HELLO_OK just told us our bundle is already stale
+            # (reconnect into a long-running learner): reload NOW
+            # instead of streaming up-to-a-poll-interval of windows
+            # the ingest would drop wholesale as stale.
+            self._maybe_reload_bundle()
+        return True
+
+    def _retry_later(self, err: Exception) -> bool:
+        delay = self._backoff.next_delay()
+        if delay is None:
+            raise RuntimeError(
+                f"could not reach ingest server {self.host}:{self.port} "
+                f"after {self.reconnect_attempts} bounded retries: {err}"
+            )
+        self._retry_at = time.monotonic() + delay
+        return False
+
+    def _flush_once(self, deadline: Optional[float] = None) -> bool:
+        """Ship one frame from the spool. False when nothing was sent
+        (empty spool, stopping, or the link died — caller re-enters).
+        ``deadline`` (a ``time.monotonic`` instant) marks the drain path:
+        no reconnect Backoff (a mid-drain link death means the rest
+        counts dropped, never a 60-attempt budget past the 5 s bound),
+        and the credit wait gives up at the deadline instead of blocking
+        on a stalled server."""
+        if not self.spool.rows:
+            return False
+        if deadline is not None:
+            if self._link is None or self._link.dead is not None:
+                return False
+        elif not self._ensure_link():
+            return False
+        link = self._link
+        # Flow control: block until an in-flight slot frees — this IS the
+        # collection backpressure (the env loop pauses with us).
+        while not link.acquire_credit(timeout=0.5):
+            if link.dead is not None:
+                return False
+            if deadline is not None:
+                # Drain path: _stop is ALWAYS set here (SIGTERM is the
+                # normal drain trigger), so only the deadline may end the
+                # wait — a slow-acking but live server still gets the full
+                # drain budget to free a credit.
+                if time.monotonic() >= deadline:
+                    return False
+            elif self._stop.is_set():
+                return False
+        frame = self.spool.take_frame(link.max_windows)
+        if frame is None:
+            link.release_credit()
+            return False
+        gen, cols = frame
+        if self._chaos is not None:
+            e = self._chaos.tick("slow_link")
+            if e is not None:
+                # slow_link@N:ms — stall this send; proves the server's
+                # read deadline tolerates a slow-but-live peer and flow
+                # control (not queue growth) absorbs the stall.
+                stall = e.arg if e.arg is not None else 100.0
+                self._stop.wait(stall / 1e3)
+        try:
+            n = link.send_windows(gen, cols)
+        except OSError:
+            # in flight at the disconnect: dropped whole (send_windows /
+            # the reader's death sweep counted it — exactly one of them)
+            return False
+        self._inc("windows_sent", n)
+        self._inc("frames_sent")
+        return True
+
+    # --------------------------------------------------------------- bundle
+    def _maybe_reload_bundle(self) -> None:
+        m = bundle_meta_mtime(self.bundle_dir)
+        if m is None or m == self._bundle_mtime:
+            return
+        if self._chaos is not None:
+            e = self._chaos.tick("stale_bundle")
+            if e is not None:
+                # Injected stale bundle: skip this swap AND advance the
+                # bookmark — this host keeps acting on the old generation
+                # until the NEXT export, so its windows age out server-side
+                # (windows_dropped_stale_gen proves the drop path).
+                self._bundle_mtime = m
+                print(
+                    "[fleet-actor] chaos stale_bundle: skipping hot-swap, "
+                    f"staying on generation {self.policy.generation}",
+                    flush=True,
+                )
+                return
+        try:
+            fresh = load_numpy_policy(self.bundle_dir)
+        except (OSError, ValueError, KeyError) as e:
+            # torn/malformed export: keep acting on the old policy; the
+            # bookmark advances so a bad export logs once, not every poll
+            self._bundle_mtime = m
+            print(
+                f"[fleet-actor] bundle reload failed (keeping old): {e}",
+                flush=True,
+            )
+            return
+        self._bundle_mtime = fresh.mtime
+        self.policy = fresh
+        self.spool.generation = fresh.generation
+        with self._stats_lock:
+            self._stats["generation"] = fresh.generation
+            self._stats["bundle_reloads"] += 1
+        print(
+            f"[fleet-actor] hot-swapped bundle generation={fresh.generation}",
+            flush=True,
+        )
+
+    # ------------------------------------------------------------- env loop
+    def _step_envs(self) -> None:
+        a = self.policy.act(self._obs)
+        if self.noise_sigma > 0.0:
+            a = a + self.noise_sigma * self._rng.standard_normal(
+                a.shape
+            ).astype(np.float32)
+        np.clip(a, -1.0, 1.0, out=a)
+        for i, env in enumerate(self.envs):
+            obs2, r, term, trunc, _info = env.step(a[i])
+            # .copy(): NStepWriter stores obs WITHOUT copying, and the
+            # `self._obs[i] = ...` below assigns INTO this row — without
+            # the copy every emitted window's obs would silently read the
+            # row's FUTURE value (regression-tested)
+            self.writers[i].add(
+                self._obs[i].copy(), a[i], r, obs2,
+                terminated=term, truncated=trunc,
+            )
+            if term or trunc:
+                self._obs[i] = env.reset()
+                self._inc("episodes")
+            else:
+                self._obs[i] = obs2
+        self._inc("env_steps", self.num_envs)
+
+    # ------------------------------------------------------------------ run
+    def run(self) -> dict:
+        """The main loop; returns the final stats dict. Blocks until
+        ``max_env_steps`` (0 = until stopped) or :meth:`request_stop`."""
+        emitted_base = 0
+        next_poll = time.monotonic() + self.poll_interval_s
+        next_stats = time.monotonic() + self.stats_interval_s
+        try:
+            while not self._stop.is_set():
+                if (
+                    self.max_env_steps
+                    and self._stats["env_steps"] >= self.max_env_steps
+                ):
+                    break
+                now = time.monotonic()
+                if now >= next_poll:
+                    self._maybe_reload_bundle()
+                    next_poll = now + self.poll_interval_s
+                if now >= next_stats:
+                    print(f"[fleet-actor] {self.stats()}", flush=True)
+                    next_stats = now + self.stats_interval_s
+                before = len(self.spool) + self.spool.dropped
+                self._step_envs()
+                emitted_base += (len(self.spool) + self.spool.dropped) - before
+                with self._stats_lock:
+                    self._stats["windows_emitted"] = emitted_base
+                while (
+                    len(self.spool) >= self.batch_windows
+                    and not self._stop.is_set()
+                ):
+                    if not self._flush_once():
+                        break
+            self._drain()
+        finally:
+            if self._link is not None:
+                self._link.close()
+                self._link = None
+            for env in self.envs:
+                if hasattr(env, "close"):
+                    env.close()
+        out = self.stats()
+        print(f"[fleet-actor] drained: {out}", flush=True)
+        return out
+
+    def _drain(self) -> None:
+        """Best-effort final flush: ship the spool's complete windows and
+        wait briefly for acks. A dead/unreachable server just means those
+        windows count dropped — the drain must never hang a SIGTERM."""
+        deadline = time.monotonic() + 5.0
+        if self._link is None or self._link.dead is not None:
+            # ONE bounded connect attempt, even when stopping (SIGTERM is
+            # the normal drain path) — never the full Backoff budget,
+            # which could block the exit minutes past the deadline.
+            if self._link is not None:
+                self._link.close()
+                self._link = None
+                self._inc("reconnects")
+            try:
+                self._link = FleetLink(
+                    self.host, self.port, self._hello(),
+                    on_ack=self._on_ack,
+                    connect_timeout_s=min(2.0, self.connect_timeout_s),
+                )
+            except (OSError, ProtocolError, RuntimeError):
+                return  # unreachable: whatever is spooled counts dropped
+        while self.spool.rows and time.monotonic() < deadline:
+            if self._link.dead is not None:
+                break
+            if not self._flush_once(deadline=deadline):
+                break
+        link = self._link
+        if link is not None:
+            while link.inflight() > 0 and time.monotonic() < deadline:
+                if link.dead is not None:
+                    break
+                time.sleep(0.02)
+        # anything still spooled is dropped by exit (counted implicitly via
+        # spool_depth in the final stats line)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m d4pg_tpu.fleet.actor", description=__doc__
+    )
+    p.add_argument("--connect", required=True, metavar="HOST:PORT",
+                   help="learner's ingest endpoint (train.py --fleet-listen)")
+    p.add_argument("--bundle", required=True,
+                   help="bundle directory the trainer publishes "
+                        "(--fleet-bundle); polled for hot-swaps")
+    p.add_argument("--env", default=None,
+                   help="host env id (default: the bundle's provenance env)")
+    p.add_argument("--num-envs", type=int, default=1,
+                   help="envs in this host process (one batched numpy "
+                        "forward per step)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--noise-sigma", type=float, default=0.3,
+                   help="gaussian exploration noise scale in canonical "
+                        "(-1,1) action space (0 = deterministic)")
+    p.add_argument("--batch-windows", type=int, default=16,
+                   help="windows accumulated before a frame ships")
+    p.add_argument("--spool-limit", type=int, default=1024,
+                   help="bounded local window spool; past it the oldest "
+                        "windows drop (counted) while disconnected")
+    p.add_argument("--poll-interval", type=float, default=2.0,
+                   help="bundle.json mtime poll seconds (hot-swap cadence)")
+    p.add_argument("--max-steps", type=int, default=0,
+                   help="stop after this many env steps (0 = until signal)")
+    p.add_argument("--stats-interval", type=float, default=10.0)
+    p.add_argument("--reconnect-attempts", type=int, default=60,
+                   help="bounded Backoff budget per disconnection; "
+                        "exhausting it exits 1 (supervisor restarts)")
+    p.add_argument("--chaos", default=None, metavar="PLAN",
+                   help="deterministic fault injection (d4pg_tpu/chaos.py): "
+                        "actor-side sites reconnect_flap@N, stale_bundle@N, "
+                        "slow_link@N:ms")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    chaos = None
+    if args.chaos:
+        from d4pg_tpu.chaos import ChaosInjector, ChaosPlan
+
+        chaos = ChaosInjector(ChaosPlan.parse(args.chaos))
+    actor = FleetActor(
+        connect=args.connect,
+        bundle_dir=args.bundle,
+        env_id=args.env,
+        num_envs=args.num_envs,
+        seed=args.seed,
+        noise_sigma=args.noise_sigma,
+        batch_windows=args.batch_windows,
+        spool_limit=args.spool_limit,
+        poll_interval_s=args.poll_interval,
+        max_env_steps=args.max_steps,
+        stats_interval_s=args.stats_interval,
+        reconnect_attempts=args.reconnect_attempts,
+        chaos=chaos,
+    )
+    from d4pg_tpu.utils.signals import install_graceful_signals
+
+    install_graceful_signals(
+        actor.request_stop,
+        "[signal] {sig}: draining spool and exiting "
+        "(second signal hard-kills)",
+    )
+    print(
+        f"[fleet-actor] {actor.actor_id}: env={actor.env_id} "
+        f"x{actor.num_envs} -> {actor.host}:{actor.port} "
+        f"(bundle generation {actor.policy.generation})",
+        flush=True,
+    )
+    try:
+        actor.run()
+    except RuntimeError as e:
+        print(f"[fleet-actor] fatal: {e}", flush=True)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,522 @@
+"""Experience-ingest server: remote actor windows → the replay writer path.
+
+The learner-side half of the collection fleet. Remote actor hosts
+(``python -m d4pg_tpu.fleet.actor``) connect over the serve framing,
+handshake with ``HELLO`` (dims / n-step / gamma validated against the
+replay config — a mismatch would silently corrupt training, so it is
+refused before any window lands), then stream ``WINDOWS`` frames of
+COMPLETE n-step transitions. Threading shape mirrors the policy server:
+
+- one accept thread;
+- one reader thread per connection, with **deadline-bounded reads**
+  (``read_timeout_s`` on the socket): a half-open peer is detected and
+  closed instead of pinning a thread forever — the actor reconnects
+  under its Backoff;
+- one **writer thread** draining a bounded frame queue into
+  ``ReplayBuffer.add_batch`` — the exact call the in-process
+  ``BatchedNStepWriter`` path lands on, which is what makes fleet and
+  in-process replay content identical (parity-tested).
+
+Admission control is the serve batcher's contract, applied per frame:
+
+- **bounded queue, explicit shed** — a full queue answers ``OVERLOADED``
+  (``queue_full``) immediately; the actor counts the shed windows and
+  keeps its latency honest instead of diverging;
+- **generation-tagged drops** — every frame carries the bundle
+  generation its windows were produced under; frames older than
+  ``current − max_gen_lag`` are counted (``windows_dropped_stale_gen``)
+  and discarded, never written. The trainer bumps the generation at
+  every bundle publish (``--fleet-bundle`` / ``--fleet-publish-interval``);
+- **torn windows never reach replay** — the actor ships only complete
+  windows, frames are atomic at the protocol layer (a disconnect
+  mid-frame is a ``ProtocolError``, the partial frame is dropped whole),
+  and unacknowledged frames are dropped client-side on reconnect —
+  mirroring the pool's ``take_dropped`` contract end to end.
+
+``--debug-guards``: the writer thread's two rotating staging slots are
+generation-tagged in the trainer's :class:`StagingLedger` (write before
+fill, hold across the ``add_batch`` copy), so any future async consumer
+of the staging memory trips the same reuse guard as every other rotated
+slot in the repo.
+
+Deliberately JAX-free (numpy + stdlib): constructible before any backend
+decision, importable by tests that never touch a device.
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import socket
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from d4pg_tpu.analysis.ledger import NULL_LEDGER
+from d4pg_tpu.fleet import wire
+from d4pg_tpu.replay.uniform import Transition
+from d4pg_tpu.serve import protocol
+from d4pg_tpu.serve.protocol import ProtocolError
+
+# counter keys, in the order they appear in metrics rows / healthz
+COUNTER_KEYS = (
+    "windows_ingested",
+    "windows_dropped_stale_gen",
+    "windows_shed",
+    "frames_total",
+    "bytes_total",
+    "connections",
+    "connections_total",
+    "protocol_errors",
+    "generation",
+)
+
+
+class IngestServer:
+    """Bounded-queue experience ingest in front of a replay buffer.
+
+    ``buffer`` needs only ``add_batch(Transition)`` (uniform and PER both
+    qualify); the buffer's own lock makes the write thread-safe against
+    the learner's sampling and any local collection running alongside.
+    """
+
+    # d4pglint shared-mutable-state:
+    # _thread_error — single transition None→exception (writer stores,
+    #   check_alive readers check-then-raise);
+    # _staging_flip — writer thread is the ONLY writer (single-writer-
+    #   thread design; readers never touch the rotation)
+    _THREAD_SAFE = ("_thread_error", "_staging_flip")
+
+    def __init__(
+        self,
+        buffer,
+        *,
+        obs_dim: int,
+        action_dim: int,
+        n_step: int,
+        gamma: float,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        queue_limit: int = 64,
+        read_timeout_s: float = 120.0,
+        max_gen_lag: int = 1,
+        max_inflight: int = 8,
+        ledger=None,
+        chaos=None,
+    ):
+        assert queue_limit >= 1 and max_inflight >= 1
+        self.buffer = buffer
+        self.obs_dim = int(obs_dim)
+        self.action_dim = int(action_dim)
+        self.n_step = int(n_step)
+        self.gamma = float(gamma)
+        self.host = host
+        self._requested_port = port
+        self.port: Optional[int] = None
+        self.queue_limit = int(queue_limit)
+        self.read_timeout_s = float(read_timeout_s)
+        self.max_gen_lag = int(max_gen_lag)
+        self.max_inflight = int(max_inflight)
+        self.max_windows = wire.max_windows_per_frame(obs_dim, action_dim)
+        self._chaos = chaos
+
+        # Frame queue: reader threads append decoded column dicts, the
+        # writer thread drains. Bounded — admission past queue_limit sheds
+        # at the reader with an explicit OVERLOADED reply.
+        self._queue: deque = deque()
+        self._cond = threading.Condition()
+        self._stop = False  # guarded by _cond
+
+        # Writer staging: two rotating sets of preallocated column arrays,
+        # generation-tagged in the ledger (--debug-guards). add_batch
+        # copies synchronously, so the hold spans exactly the copy — the
+        # discipline matters the day a consumer goes async, and the tag
+        # makes a leak visible at close.
+        cap = self.max_windows * 2
+        self._staging = [
+            {
+                "obs": np.zeros((cap, obs_dim), np.float32),
+                "action": np.zeros((cap, action_dim), np.float32),
+                "reward": np.zeros(cap, np.float32),
+                "next_obs": np.zeros((cap, obs_dim), np.float32),
+                "discount": np.zeros(cap, np.float32),
+            }
+            for _ in range(2)
+        ]
+        self._staging_cap = cap
+        self._staging_flip = 0  # writer-thread-only
+        self._ledger = ledger if ledger is not None else NULL_LEDGER
+        self._staging_group = "fleet.ingest"
+
+        self._counters = dict.fromkeys(COUNTER_KEYS, 0)
+        self._counters_lock = threading.Lock()
+
+        self._listen_sock: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._writer_thread: Optional[threading.Thread] = None
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        self._shutdown = threading.Event()
+        self._thread_error: Optional[BaseException] = None
+        self._started = False
+
+    # -------------------------------------------------------------- lifecycle
+    def start(self) -> "IngestServer":
+        if self._started:
+            raise RuntimeError("ingest server already started")
+        self._started = True
+        self._listen_sock = socket.create_server(
+            (self.host, self._requested_port)
+        )
+        self.port = self._listen_sock.getsockname()[1]
+        self._writer_thread = threading.Thread(
+            target=self._writer_loop, name="fleet-ingest-writer", daemon=True
+        )
+        self._writer_thread.start()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="fleet-ingest-accept", daemon=True
+        )
+        self._accept_thread.start()
+        return self
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Drain and stop: no new connections, every frame already admitted
+        to the queue is written to replay, then tear down."""
+        self._shutdown.set()
+        if self._listen_sock is not None:
+            # shutdown() + self-connect: close() alone does not wake a
+            # thread blocked in accept() (same dance as PolicyServer.drain)
+            try:
+                self._listen_sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            wake = "127.0.0.1" if self.host in ("0.0.0.0", "") else self.host
+            try:
+                with socket.create_connection((wake, self.port), timeout=1):
+                    pass
+            except OSError:
+                pass
+            try:
+                self._listen_sock.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5)
+            self._accept_thread = None
+        # Reader threads block in recv with a timeout; closing their
+        # sockets unblocks them immediately.
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._writer_thread is not None:
+            self._writer_thread.join(timeout=timeout)
+            if self._writer_thread.is_alive():
+                raise RuntimeError("ingest writer thread failed to drain")
+            self._writer_thread = None
+
+    def check_alive(self) -> None:
+        if self._thread_error is not None:
+            raise RuntimeError(
+                "fleet ingest thread died"
+            ) from self._thread_error
+
+    # --------------------------------------------------------------- counters
+    def _inc(self, key: str, n: int = 1) -> None:
+        with self._counters_lock:
+            self._counters[key] += n
+
+    def set_generation(self, generation: int) -> None:
+        """Called by the trainer at every bundle publish: windows produced
+        against generations older than ``generation − max_gen_lag`` are
+        dropped from here on."""
+        with self._counters_lock:
+            self._counters["generation"] = int(generation)
+
+    @property
+    def generation(self) -> int:
+        with self._counters_lock:
+            return self._counters["generation"]
+
+    def counters(self) -> dict:
+        """Snapshot of the fleet counters (one lock hop); the trainer
+        prefixes these ``fleet_`` into every metrics.jsonl row."""
+        with self._counters_lock:
+            return dict(self._counters)
+
+    # ------------------------------------------------------------ connections
+    def _accept_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                conn, _addr = self._listen_sock.accept()
+            except OSError as e:
+                if self._shutdown.is_set():
+                    return  # listen socket closed: draining
+                if e.errno in (errno.EBADF, errno.EINVAL):
+                    # the listen socket died under us WITHOUT a drain:
+                    # surface it (check_alive) instead of silently never
+                    # accepting again while the learner paces forever
+                    self._thread_error = e
+                    return
+                # transient (ECONNABORTED from a client RST between SYN
+                # and accept — the chaos partition/flap traffic shape —
+                # or a brief EMFILE): keep accepting
+                time.sleep(0.05)
+                continue
+            if self._shutdown.is_set():
+                try:
+                    conn.close()  # the close()'s own wake-up connection
+                except OSError:
+                    pass
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # Deadline-bounded reads: a peer that stops sending (half-open
+            # TCP after an actor-host power loss) is detected here instead
+            # of pinning this reader thread forever. Live actors stream
+            # continuously or reconnect, so a generous timeout only bounds
+            # the zombie case.
+            conn.settimeout(self.read_timeout_s)
+            with self._conns_lock:
+                self._conns.add(conn)
+            self._inc("connections_total")
+            self._inc("connections")
+            threading.Thread(
+                target=self._serve_conn,
+                args=(conn,),
+                name="fleet-ingest-conn",
+                daemon=True,
+            ).start()
+
+    def _handshake(self, conn, rfile) -> bool:
+        """First non-HEALTHZ frame must be a valid HELLO; reply HELLO_OK
+        or ERROR. Returns True when the connection may stream windows.
+        HEALTHZ is answered pre-handshake so monitoring probes work the
+        same way they do against the serve port (docs/fleet.md)."""
+        while True:
+            frame = protocol.read_frame(rfile)
+            if frame is None:
+                return False
+            msg_type, req_id, payload = frame
+            if msg_type != protocol.HEALTHZ:
+                break
+            protocol.write_frame(
+                conn,
+                protocol.HEALTHZ_OK,
+                req_id,
+                json.dumps(self.counters()).encode(),
+            )
+        if msg_type != protocol.HELLO:
+            raise ProtocolError(
+                f"expected HELLO as the first frame, got type {msg_type}"
+            )
+        # decode_hello is the single coercion point: the numeric fields
+        # arrive already int/float-typed (malformed ones raised there)
+        hello = wire.decode_hello(payload)
+        problems = []
+        if hello["obs_dim"] != self.obs_dim:
+            problems.append(f"obs_dim {hello['obs_dim']} != {self.obs_dim}")
+        if hello["action_dim"] != self.action_dim:
+            problems.append(
+                f"action_dim {hello['action_dim']} != {self.action_dim}"
+            )
+        if hello["n_step"] != self.n_step:
+            problems.append(f"n_step {hello['n_step']} != {self.n_step}")
+        if abs(hello["gamma"] - self.gamma) > 1e-9:
+            problems.append(f"gamma {hello['gamma']} != {self.gamma}")
+        if problems:
+            # A mis-configured actor must fail loudly at connect, not
+            # stream windows that silently train the wrong MDP.
+            protocol.write_frame(
+                conn,
+                protocol.ERROR,
+                req_id,
+                ("handshake refused: " + "; ".join(problems)).encode(),
+            )
+            return False
+        protocol.write_frame(
+            conn,
+            protocol.HELLO_OK,
+            req_id,
+            wire.encode_hello_ok(
+                generation=self.generation,
+                max_windows=self.max_windows,
+                max_inflight=self.max_inflight,
+            ),
+        )
+        return True
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        rfile = conn.makefile("rb")
+        try:
+            if not self._handshake(conn, rfile):
+                return
+            while True:
+                frame = protocol.read_frame(rfile)
+                if frame is None:
+                    return  # clean EOF: actor drained and closed
+                if self._chaos is not None:
+                    e = self._chaos.tick("partition")
+                    if e is not None:
+                        # Abortive close (RST on real stacks) mid-stream:
+                        # the actor sees a reset with frames in flight —
+                        # exactly the network-partition fault class. Its
+                        # contract: drop unacked windows, reconnect under
+                        # Backoff, never resend (at-most-once).
+                        protocol.abortive_close(conn)
+                        raise OSError("chaos: injected partition")
+                msg_type, req_id, payload = frame
+                if msg_type == protocol.HEALTHZ:
+                    protocol.write_frame(
+                        conn,
+                        protocol.HEALTHZ_OK,
+                        req_id,
+                        json.dumps(self.counters()).encode(),
+                    )
+                    continue
+                if msg_type != protocol.WINDOWS:
+                    raise ProtocolError(f"unexpected message type {msg_type}")
+                self._inc("frames_total")
+                self._inc("bytes_total", len(payload))
+                gen, cols = wire.decode_windows(
+                    payload, self.obs_dim, self.action_dim
+                )
+                n = len(cols["reward"])
+                if gen < self.generation - self.max_gen_lag:
+                    # Stale-bundle drop: these windows were produced by a
+                    # policy the learner has long moved past (Ape-X keeps
+                    # them; SEED-RL-style on-policy-ish ingest drops them —
+                    # we drop, count, and TELL the actor so it can fix its
+                    # bundle sync instead of wasting uplink).
+                    self._inc("windows_dropped_stale_gen", n)
+                    protocol.write_frame(
+                        conn,
+                        protocol.WINDOWS_OK,
+                        req_id,
+                        wire.encode_windows_ok(0, n),
+                    )
+                    continue
+                with self._cond:
+                    full = len(self._queue) >= self.queue_limit
+                    if not full:
+                        self._queue.append(cols)
+                        self._cond.notify()
+                if full:
+                    # Explicit shed at the bounded queue (the batcher's
+                    # queue_full semantics): the learner's writer is behind;
+                    # the actor sees an honest no and applies backpressure.
+                    self._inc("windows_shed", n)
+                    protocol.write_frame(
+                        conn, protocol.OVERLOADED, req_id, b"queue_full"
+                    )
+                    continue
+                protocol.write_frame(
+                    conn,
+                    protocol.WINDOWS_OK,
+                    req_id,
+                    wire.encode_windows_ok(n, 0),
+                )
+        except ProtocolError as e:
+            # Malformed frame: framing is unrecoverable — ERROR once, close.
+            # Any partially-received WINDOWS frame died inside read_frame,
+            # so its windows never reached the queue (torn frames whole-drop).
+            self._inc("protocol_errors")
+            try:
+                protocol.write_frame(conn, protocol.ERROR, 0, str(e).encode())
+            except OSError:
+                pass
+        except OSError:
+            pass  # peer reset / read deadline / socket closed by close()
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+            self._inc("connections", -1)
+            try:
+                rfile.close()
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # ---------------------------------------------------------------- writer
+    def _writer_loop(self) -> None:
+        try:
+            while True:
+                frames = []
+                with self._cond:
+                    while not self._queue and not self._stop:
+                        self._cond.wait(0.2)
+                    if not self._queue and self._stop:
+                        return
+                    # Drain multiple frames per wake, up to the staging
+                    # capacity — one add_batch per wake however many
+                    # frames accumulated (the PR-2 drain-and-batch shape).
+                    rows = 0
+                    while self._queue:
+                        n = len(self._queue[0]["reward"])
+                        if frames and rows + n > self._staging_cap:
+                            break
+                        frames.append(self._queue.popleft())
+                        rows += n
+                self._write_frames(frames)
+        except BaseException as e:
+            self._thread_error = e
+            raise
+
+    def _write_frames(self, frames: list) -> None:
+        total = sum(len(f["reward"]) for f in frames)
+        if total == 0:
+            return
+        flip = self._staging_flip
+        self._staging_flip = 1 - flip
+        self._ledger.write(
+            self._staging_group, flip, writer="fleet-ingest-writer"
+        )
+        staging = self._staging[flip]
+        # an oversize single frame (> staging cap) falls back to a direct
+        # unstaged write below rather than overrunning the slot
+        if total <= self._staging_cap:
+            pos = 0
+            for f in frames:
+                n = len(f["reward"])
+                for k in ("obs", "action", "reward", "next_obs", "discount"):
+                    staging[k][pos : pos + n] = f[k]
+                pos += n
+            cols = {k: staging[k][:total] for k in staging}
+        else:
+            cols = {
+                k: np.concatenate([f[k] for f in frames])
+                for k in frames[0]
+            }
+        hold = self._ledger.hold(
+            self._staging_group, flip, holder="fleet-ingest-add_batch"
+        )
+        try:
+            self.buffer.add_batch(
+                Transition(
+                    cols["obs"],
+                    cols["action"],
+                    cols["reward"],
+                    cols["next_obs"],
+                    cols["discount"],
+                )
+            )
+        finally:
+            # add_batch copies synchronously under the buffer lock; the
+            # staging slot is free the moment it returns.
+            hold.release()
+        self._inc("windows_ingested", total)

@@ -1,0 +1,189 @@
+"""NumPy-only policy evaluation from a serving bundle.
+
+The fleet actor host's contract is that its hot path NEVER imports JAX —
+an actor host is a cheap CPU box running gymnasium + numpy, and pulling
+the JAX runtime there costs memory, import seconds, and (on spawn'd
+children) outright unsafety. So instead of ``serve.bundle.load_bundle``
+(whose param restore goes through ``jax.tree_util``), this module reads
+the SAME bundle directory with numpy + stdlib only:
+
+- ``bundle.json`` is plain JSON (config, bounds, obs-norm stats, meta);
+- ``actor_params.npz`` stores the actor leaves under zero-padded
+  ``leaf_%05d`` keys in ``tree_flatten`` order. For the MLP actor that
+  order is fully determined: flax dict keys flatten sorted, so leaves
+  arrive as ``(bias, kernel)`` pairs per layer, layers in name order
+  (``hidden_0 < hidden_1 < … < out``). The loader re-derives the layer
+  structure from the declared ``hidden_sizes`` and validates every leaf
+  shape against the chain — a scrambled order or a config/params
+  mismatch is a hard load error, never a silently-garbage policy.
+
+Pixel bundles (conv encoder) are refused: the fleet path is for flat
+observation vectors (the conv forward belongs on an accelerator; a pixel
+actor host would be serving-shaped, not fleet-shaped).
+
+The forward is the exact acting-time data path the server runs —
+normalize → MLP(relu) → tanh — in float32 numpy. Parity with the jitted
+``act_deterministic`` is tested to ~1e-5 (XLA may reassociate float
+reductions; exploration noise dwarfs that).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+# serve/bundle.py's layout constants, restated: importing that module pulls
+# D4PGConfig (and with it the JAX runtime) at top level, which this module
+# must never do. tests/test_fleet.py pins the two copies equal.
+BUNDLE_VERSION = 1
+PARAMS_FILE = "actor_params.npz"
+META_FILE = "bundle.json"
+
+
+class NumpyPolicy:
+    """A loaded bundle evaluated in numpy. ``act`` maps ``[N, obs_dim]``
+    observations to canonical (−1, 1) actions — the space host envs step
+    in (``GymAdapter`` applies the affine to env bounds itself, so the
+    bundle's bounds are carried for provenance, not applied here)."""
+
+    def __init__(
+        self,
+        *,
+        layers: List[Tuple[np.ndarray, np.ndarray]],
+        obs_dim: int,
+        action_dim: int,
+        n_step: int,
+        gamma: float,
+        env: Optional[str],
+        generation: int,
+        obs_norm: Optional[Tuple[np.ndarray, np.ndarray]],
+        obs_clip: float = 5.0,
+        mtime: Optional[float] = None,
+        path: Optional[str] = None,
+    ):
+        self._layers = layers            # [(kernel [in, out], bias [out])]
+        self.obs_dim = obs_dim
+        self.action_dim = action_dim
+        self.n_step = n_step
+        self.gamma = gamma
+        self.env = env
+        self.generation = generation
+        self._obs_norm = obs_norm        # (mean_f32, std_f32_floored) | None
+        self._obs_clip = obs_clip
+        self.mtime = mtime               # bundle.json mtime at load
+        self.path = path
+
+    def act(self, obs: np.ndarray) -> np.ndarray:
+        """Deterministic forward: ``[N, obs_dim]`` → ``[N, action_dim]``
+        in (−1, 1)."""
+        x = np.asarray(obs, np.float32)
+        if self._obs_norm is not None:
+            mean, std = self._obs_norm
+            x = np.clip((x - mean) / std, -self._obs_clip, self._obs_clip)
+        last = len(self._layers) - 1
+        for i, (kernel, bias) in enumerate(self._layers):
+            x = x @ kernel + bias
+            if i < last:
+                np.maximum(x, 0.0, out=x)  # relu
+        return np.tanh(x)
+
+
+def _derive_obs_norm(
+    stats: Optional[dict], obs_dim: int, eps: float = 1e-2
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """(mean_f32, std_f32 floored at eps) from persisted Welford stats —
+    the same derivation the serve batcher and RunningObsNorm apply."""
+    if stats is None:
+        return None
+    count = float(stats["count"])
+    mean = np.asarray(stats["mean"], np.float64)
+    if mean.shape != (obs_dim,):
+        raise ValueError(
+            f"obs_norm stats are {mean.shape}-shaped, obs_dim is {obs_dim}"
+        )
+    m2 = np.asarray(stats["m2"], np.float64)
+    std = (
+        np.sqrt(np.maximum(m2 / count, 0.0)) if count > 0 else np.ones_like(mean)
+    )
+    return mean.astype(np.float32), np.maximum(std, eps).astype(np.float32)
+
+
+def load_numpy_policy(bundle_dir: str) -> NumpyPolicy:
+    """Load a serving bundle into a :class:`NumpyPolicy` without JAX.
+
+    Raises ``ValueError`` on pixel bundles, unsupported layer counts,
+    leaf-count/shape mismatches, or a bundle-version skew — the same
+    fail-loudly contract as ``serve.bundle.load_bundle``.
+    """
+    meta_path = os.path.join(bundle_dir, META_FILE)
+    mtime = os.stat(meta_path).st_mtime
+    with open(meta_path) as f:
+        doc = json.load(f)
+    if doc.get("bundle_version") != BUNDLE_VERSION:
+        raise ValueError(
+            f"bundle_version {doc.get('bundle_version')!r} unsupported "
+            f"(this code reads {BUNDLE_VERSION})"
+        )
+    agent = doc["agent"]
+    if agent.get("pixel_shape"):
+        raise ValueError(
+            "pixel bundles (conv encoder) are not supported by the fleet "
+            "actor's numpy policy; fleet hosts serve flat observations only"
+        )
+    obs_dim = int(agent["obs_dim"])
+    action_dim = int(agent["action_dim"])
+    hidden = [int(h) for h in agent.get("hidden_sizes", (256, 256, 256))]
+    if len(hidden) > 9:
+        # tree_flatten sorts layer names as STRINGS; hidden_10 would sort
+        # before hidden_2 and scramble the leaf order this loader assumes.
+        raise ValueError(
+            f"{len(hidden)} hidden layers: the numpy loader supports at "
+            "most 9 (flax name-sort order becomes ambiguous past that)"
+        )
+    with np.load(os.path.join(bundle_dir, PARAMS_FILE)) as z:
+        leaves = [z[k] for k in sorted(z.files)]
+    widths = hidden + [action_dim]
+    if len(leaves) != 2 * len(widths):
+        raise ValueError(
+            f"bundle has {len(leaves)} param leaves, config implies "
+            f"{2 * len(widths)} (MLP {hidden} → {action_dim})"
+        )
+    layers: List[Tuple[np.ndarray, np.ndarray]] = []
+    prev = obs_dim
+    for i, width in enumerate(widths):
+        bias, kernel = leaves[2 * i], leaves[2 * i + 1]
+        if bias.shape != (width,) or kernel.shape != (prev, width):
+            raise ValueError(
+                f"layer {i}: bundle leaves are bias{bias.shape} / "
+                f"kernel{kernel.shape}, config implies bias({width},) / "
+                f"kernel({prev}, {width}) — config/params mismatch"
+            )
+        layers.append(
+            (np.asarray(kernel, np.float32), np.asarray(bias, np.float32))
+        )
+        prev = width
+    meta = doc.get("meta") or {}
+    return NumpyPolicy(
+        layers=layers,
+        obs_dim=obs_dim,
+        action_dim=action_dim,
+        n_step=int(agent.get("n_step", 1)),
+        gamma=float(agent.get("gamma", 0.99)),
+        env=meta.get("env"),
+        generation=int(meta.get("generation", 0)),
+        obs_norm=_derive_obs_norm(doc.get("obs_norm"), obs_dim),
+        mtime=mtime,
+        path=os.path.abspath(bundle_dir),
+    )
+
+
+def bundle_meta_mtime(bundle_dir: str) -> Optional[float]:
+    """mtime of ``bundle.json`` (the hot-swap watch key — the exporter
+    moves it into place LAST); None when absent."""
+    try:
+        return os.stat(os.path.join(bundle_dir, META_FILE)).st_mtime
+    except FileNotFoundError:
+        return None

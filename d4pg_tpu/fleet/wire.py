@@ -1,0 +1,226 @@
+"""Payload codecs for the fleet frames (HELLO / WINDOWS / WINDOWS_OK).
+
+The frame layout itself — magic, version, type, req_id, length — is the
+policy server's (``d4pg_tpu/serve/protocol.py``); this module only defines
+what goes INSIDE the fleet frames:
+
+``HELLO`` (JSON)
+    The actor's opening handshake: ``{actor_id, env, obs_dim, action_dim,
+    n_step, gamma, generation}``. The ingest server validates the data
+    shape against its replay config — a dims/n-step/gamma mismatch is a
+    config error that would silently corrupt training, so it is refused
+    with ``ERROR`` before any window is accepted.
+
+``HELLO_OK`` (JSON)
+    ``{generation, max_windows_per_frame, max_inflight}`` — the learner's
+    current bundle generation (so a freshly-connected actor knows whether
+    its bundle is already stale) and the flow-control window: at most
+    ``max_inflight`` unacknowledged WINDOWS frames per connection, each
+    carrying at most ``max_windows_per_frame`` windows.
+
+``WINDOWS`` (binary)
+    ``u32 generation, u32 count`` then ``count`` rows of float32:
+    ``obs[obs_dim] · action[action_dim] · reward · next_obs[obs_dim] ·
+    discount`` — a COMPLETE n-step window per row, exactly the columns
+    :class:`~d4pg_tpu.replay.uniform.Transition` stores (reward is the
+    collapsed n-step return, discount is γ^m·(1−terminal)). Rewards are
+    shipped f32 because the replay ring stores f32: the actor-side
+    float64 accumulation rounds at exactly the same point the in-process
+    writer path rounds (``ReplayBuffer.add_batch``'s cast), which is what
+    makes fleet vs in-process replay content byte-identical.
+
+``WINDOWS_OK`` (struct)
+    ``u32 accepted, u32 dropped_stale`` — the per-frame account. A frame
+    shed at admission (bounded queue full) is answered ``OVERLOADED``
+    with reason ``queue_full`` instead, mirroring the serve batcher's
+    explicit shed contract.
+
+Deliberately JAX-free (numpy + stdlib): imported by actor hosts.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Tuple
+
+import numpy as np
+
+from d4pg_tpu.serve.protocol import MAX_PAYLOAD, ProtocolError
+
+_WINDOWS_HEAD = struct.Struct("<II")   # generation, count
+_WINDOWS_OK = struct.Struct("<II")     # accepted, dropped_stale
+
+
+def window_row_floats(obs_dim: int, action_dim: int) -> int:
+    """float32 slots per window row: obs + action + reward + next_obs +
+    discount."""
+    return 2 * obs_dim + action_dim + 2
+
+
+def max_windows_per_frame(obs_dim: int, action_dim: int, cap: int = 256) -> int:
+    """Largest window count per frame that fits ``MAX_PAYLOAD``, capped —
+    a frame is also the shed/ack granularity, so unboundedly large frames
+    would make admission control coarse."""
+    fit = (MAX_PAYLOAD - _WINDOWS_HEAD.size) // (
+        4 * window_row_floats(obs_dim, action_dim)
+    )
+    if fit < 1:
+        raise ValueError(
+            f"one window row (obs_dim={obs_dim}, action_dim={action_dim}) "
+            f"exceeds MAX_PAYLOAD={MAX_PAYLOAD}; the fleet path is for flat "
+            "observation vectors"
+        )
+    return max(1, min(cap, fit))
+
+
+# ------------------------------------------------------------------ HELLO
+def encode_hello(
+    *,
+    actor_id: str,
+    env: str,
+    obs_dim: int,
+    action_dim: int,
+    n_step: int,
+    gamma: float,
+    generation: int,
+) -> bytes:
+    return json.dumps(
+        {
+            "actor_id": actor_id,
+            "env": env,
+            "obs_dim": int(obs_dim),
+            "action_dim": int(action_dim),
+            "n_step": int(n_step),
+            "gamma": float(gamma),
+            "generation": int(generation),
+        }
+    ).encode()
+
+
+def decode_hello(payload: bytes) -> dict:
+    try:
+        doc = json.loads(payload.decode())
+        # coerce the required numeric keys so a missing one (KeyError) or
+        # a wrong-typed one (TypeError: {"obs_dim": null}) fails HERE,
+        # with a ProtocolError the reader answers, not deep in validation
+        for k in ("obs_dim", "action_dim", "n_step"):
+            doc[k] = int(doc[k])
+        doc["gamma"] = float(doc["gamma"])
+        doc["generation"] = int(doc.get("generation", 0))
+        return doc
+    except (ValueError, KeyError, TypeError, UnicodeDecodeError) as e:
+        raise ProtocolError(f"malformed HELLO payload: {e}") from e
+
+
+def encode_hello_ok(
+    *, generation: int, max_windows: int, max_inflight: int
+) -> bytes:
+    return json.dumps(
+        {
+            "generation": int(generation),
+            "max_windows_per_frame": int(max_windows),
+            "max_inflight": int(max_inflight),
+        }
+    ).encode()
+
+
+def decode_hello_ok(payload: bytes) -> dict:
+    try:
+        doc = json.loads(payload.decode())
+        for k in ("generation", "max_windows_per_frame", "max_inflight"):
+            doc[k] = int(doc[k])
+        return doc
+    except (ValueError, KeyError, TypeError, UnicodeDecodeError) as e:
+        raise ProtocolError(f"malformed HELLO_OK payload: {e}") from e
+
+
+# ---------------------------------------------------------------- WINDOWS
+def encode_windows(
+    generation: int,
+    obs: np.ndarray,
+    action: np.ndarray,
+    reward: np.ndarray,
+    next_obs: np.ndarray,
+    discount: np.ndarray,
+) -> bytes:
+    """Pack ``n`` complete windows into one WINDOWS payload. Inputs are
+    ``[n, obs_dim] / [n, action_dim] / [n] / [n, obs_dim] / [n]``."""
+    obs = np.ascontiguousarray(obs, np.float32)
+    action = np.ascontiguousarray(action, np.float32)
+    n, obs_dim = obs.shape
+    rowf = window_row_floats(obs_dim, action.shape[1])
+    rows = np.empty((n, rowf), np.float32)
+    c = 0
+    rows[:, c : c + obs_dim] = obs
+    c += obs_dim
+    rows[:, c : c + action.shape[1]] = action
+    c += action.shape[1]
+    rows[:, c] = np.asarray(reward, np.float32)
+    c += 1
+    rows[:, c : c + obs_dim] = np.asarray(next_obs, np.float32)
+    c += obs_dim
+    rows[:, c] = np.asarray(discount, np.float32)
+    payload = _WINDOWS_HEAD.pack(int(generation), n) + rows.tobytes()
+    if len(payload) > MAX_PAYLOAD:
+        raise ProtocolError(
+            f"WINDOWS payload {len(payload)} bytes > max {MAX_PAYLOAD}; "
+            "send fewer windows per frame"
+        )
+    return payload
+
+
+def decode_windows(
+    payload: bytes, obs_dim: int, action_dim: int
+) -> Tuple[int, dict]:
+    """→ ``(generation, columns)`` where columns maps the Transition field
+    names to fresh arrays. ProtocolError on any size inconsistency (the
+    truncated/oversized-frame fault path)."""
+    if len(payload) < _WINDOWS_HEAD.size:
+        raise ProtocolError(
+            f"WINDOWS payload {len(payload)} bytes < header "
+            f"{_WINDOWS_HEAD.size}"
+        )
+    generation, count = _WINDOWS_HEAD.unpack_from(payload)
+    rowf = window_row_floats(obs_dim, action_dim)
+    want = _WINDOWS_HEAD.size + 4 * rowf * count
+    if len(payload) != want:
+        raise ProtocolError(
+            f"WINDOWS payload is {len(payload)} bytes, header declares "
+            f"{count} rows of {rowf} float32 = {want}"
+        )
+    rows = np.frombuffer(
+        payload, np.float32, offset=_WINDOWS_HEAD.size
+    ).reshape(count, rowf)
+    c = 0
+    obs = rows[:, c : c + obs_dim].copy()
+    c += obs_dim
+    action = rows[:, c : c + action_dim].copy()
+    c += action_dim
+    reward = rows[:, c].copy()
+    c += 1
+    next_obs = rows[:, c : c + obs_dim].copy()
+    c += obs_dim
+    discount = rows[:, c].copy()
+    return int(generation), {
+        "obs": obs,
+        "action": action,
+        "reward": reward,
+        "next_obs": next_obs,
+        "discount": discount,
+    }
+
+
+# ------------------------------------------------------------- WINDOWS_OK
+def encode_windows_ok(accepted: int, dropped_stale: int = 0) -> bytes:
+    return _WINDOWS_OK.pack(int(accepted), int(dropped_stale))
+
+
+def decode_windows_ok(payload: bytes) -> Tuple[int, int]:
+    if len(payload) != _WINDOWS_OK.size:
+        raise ProtocolError(
+            f"WINDOWS_OK payload is {len(payload)} bytes, "
+            f"expected {_WINDOWS_OK.size}"
+        )
+    accepted, dropped_stale = _WINDOWS_OK.unpack(payload)
+    return accepted, dropped_stale

@@ -546,6 +546,7 @@ class Trainer:
         # watchdog: train.py exits 75 so a supervisor --resumes.
         self._preempt_requested = threading.Event()
         self._replay_restored = False
+        self._restored_meta: dict = {}
         if config.resume and self.ckpt.latest_step() is not None:
             # Verified restore: the newest INTACT step wins. A kill -9 that
             # landed mid-save (no manifest) or corruption caught by the
@@ -554,12 +555,19 @@ class Trainer:
             self.state, restored_step, fallbacks = self.ckpt.restore_verified(
                 self.state
             )
+            if not config.dp:
+                # Orbax hands back host-resident leaves; commit them to the
+                # device HERE (setup, not loop) so the first guarded
+                # dispatch doesn't see an implicit host->device transfer of
+                # the restored state (--debug-guards + --resume). dp keeps
+                # its replicated restore as-is.
+                self.state = jax.device_put(self.state)
             self._ckpt_fallbacks = len(fallbacks)
             for fb in fallbacks:
                 print(f"[checkpoint] fallback: {fb}")
             print(f"[checkpoint] resumed from step {restored_step}")
             self.grad_steps = int(jax.device_get(self.state.step))
-            m = load_trainer_meta(config.log_dir)
+            m = self._restored_meta = load_trainer_meta(config.log_dir)
             # env_steps drives the noise-decay schedule; without it a
             # resumed run would re-explore at full scale
             self.env_steps = int(m.get("env_steps", 0))
@@ -605,6 +613,85 @@ class Trainer:
                         f"({e}); resuming with an empty buffer (warmup "
                         "will be repaid)"
                     )
+
+        # Networked collection fleet (--fleet-listen, d4pg_tpu/fleet,
+        # docs/fleet.md): an experience-ingest server in front of
+        # self.buffer — remote actor hosts stream complete n-step windows
+        # into the same add_batch path local collection uses. Runs
+        # alongside local collection, or INSTEAD of it when num_envs == 0
+        # (self._fleet_only: the learner then paces against ingested
+        # windows exactly as async_collect paces against the pool).
+        # Placed after the resume restore so the initially-published
+        # bundle carries the restored params, not the fresh init.
+        self._fleet = None
+        # Restore the published-bundle generation alongside the other meta
+        # counters (same gating: only when a checkpoint actually restored):
+        # restarting at 0 would regress below generations connected actors
+        # already hold, disarming the stale-window drop at ingest until the
+        # counter caught back up (~generation × publish_interval grad
+        # steps of arbitrarily stale windows accepted).
+        self._fleet_gen = int(self._restored_meta.get("fleet_generation", 0))
+        self._fleet_only = (
+            config.fleet_listen is not None and config.num_envs == 0
+        )
+        if config.fleet_bundle and config.fleet_listen is None:
+            # The publish crossing is gated on the ingest server existing —
+            # without --fleet-listen no bundle would ever be written, so
+            # refuse loudly instead of silently ignoring the flag (the
+            # --on-device --fleet-listen refusal's convention).
+            raise ValueError(
+                "--fleet-bundle does nothing without --fleet-listen: the "
+                "bundle is published at ingest generation bumps (use "
+                "--export-bundle for a one-shot export)"
+            )
+        if config.fleet_listen is not None:
+            if config.her:
+                raise ValueError(
+                    "--fleet-listen is incompatible with --her: hindsight "
+                    "relabeling is episode-local in the trainer, and fleet "
+                    "actors ship already-collapsed n-step windows"
+                )
+            if config.obs_norm:
+                raise ValueError(
+                    "--fleet-listen is incompatible with --obs-norm: the "
+                    "normalizer's statistics fold at the trainer's local "
+                    "collection boundary, which remote windows bypass"
+                )
+            if agent_cfg.pixel_shape:
+                raise ValueError(
+                    "--fleet-listen serves flat observation vectors; pixel "
+                    "envs are collection-local (the conv forward belongs "
+                    "on the accelerator, not a numpy actor host)"
+                )
+            if self._fleet_only and config.async_collect:
+                # The steady-state loop paces the async_collect branch
+                # against a collector thread that does not exist in
+                # fleet-only mode — it would spin forever on a frozen
+                # env_steps counter. Refuse instead of deadlocking.
+                raise ValueError(
+                    "--async-collect needs local envs; with --num-envs 0 "
+                    "the fleet is the only collector (drop --async-collect)"
+                )
+            from d4pg_tpu.fleet.ingest import IngestServer
+
+            self._fleet = IngestServer(
+                self.buffer,
+                obs_dim=agent_cfg.obs_dim,
+                action_dim=agent_cfg.action_dim,
+                n_step=config.n_step,
+                gamma=agent_cfg.gamma,
+                host=config.fleet_host,
+                port=config.fleet_listen,
+                queue_limit=config.fleet_queue_limit,
+                max_gen_lag=config.fleet_max_gen_lag,
+                ledger=self._ledger,
+                chaos=self._chaos,
+            ).start()
+            print(f"[fleet] ingest listening on :{self._fleet.port}", flush=True)
+            self._fleet_stall_mark = -1  # first check records the baseline
+            self._fleet_stall_t = time.monotonic()
+            if config.fleet_bundle:
+                self._fleet_publish()
 
         self._rng = np.random.default_rng(config.seed)
         self._noise_init, self._noise_sample, self._noise_reset = make_noise(agent_cfg)
@@ -675,7 +762,9 @@ class Trainer:
         # dwarfed ratio·learner_steps, so the collector slept forever and
         # the learner trained off the frozen restored buffer).
         self._env_steps_origin = self.env_steps
-        if config.her:
+        if self._fleet_only:
+            pass  # no local collection: the fleet is the experience source
+        elif config.her:
             self._setup_her()
         elif self.is_jax_env:
             self._setup_sync_collect()
@@ -1009,6 +1098,75 @@ class Trainer:
             )
         else:
             self._actor_pub = jax.tree.map(jnp.copy, self.state.actor_params)
+
+    # ----------------------------------------------------------------- fleet
+    def _fleet_publish(self) -> None:
+        """Export the acting bundle for fleet actors and advance the ingest
+        generation — the weight-distribution leg of the collection fleet.
+        The atomic params-first/json-second export IS the sync mechanism:
+        actor hosts poll bundle.json's mtime and hot-swap (the serve
+        reload-watcher contract), and windows produced against bundles
+        older than ``generation − fleet_max_gen_lag`` are dropped at
+        ingest with an explicit count."""
+        from d4pg_tpu.serve.bundle import export_bundle
+
+        cfg = self.config
+        norm = getattr(self.env, "_normalize", None)
+        export_bundle(
+            cfg.fleet_bundle,
+            cfg.agent,
+            jax.device_get(self.state.actor_params),
+            action_low=None if norm is None else norm.low,
+            action_high=None if norm is None else norm.high,
+            obs_norm_state=None,  # fleet + --obs-norm is refused in __init__
+            meta={
+                "generation": self._fleet_gen,
+                "env": cfg.env,
+                "grad_steps": self.grad_steps,
+                "log_dir": os.path.abspath(cfg.log_dir),
+                "source": "fleet_publish",
+            },
+        )
+        if self._fleet is not None:
+            self._fleet.set_generation(self._fleet_gen)
+        print(
+            f"[fleet] published bundle generation {self._fleet_gen} "
+            f"-> {cfg.fleet_bundle}",
+            flush=True,
+        )
+
+    def _fleet_env_steps(self) -> int:
+        """Fleet-only mode: ingested windows ARE the experience counter
+        (steady state emits one window per env step; episode tails emit a
+        burst for the final partial windows — close enough for pacing and
+        the noise/meta schedules)."""
+        self.env_steps = (
+            self._env_steps_origin
+            + self._fleet.counters()["windows_ingested"]
+        )
+        return self.env_steps
+
+    def _fleet_stall_check(self) -> None:
+        """Fleet-only pacing observability: the learner must outlive actor
+        churn (remote hosts reconnect, supervisors restart them), so a
+        starved wait never raises — but an all-actors-dead fleet would
+        otherwise stall this loop in total silence (check_alive only sees
+        LEARNER-side thread death). Log a heartbeat with the live
+        connection count whenever no window has arrived for a while."""
+        c = self._fleet.counters()
+        now = time.monotonic()
+        if c["windows_ingested"] != self._fleet_stall_mark:
+            self._fleet_stall_mark = c["windows_ingested"]
+            self._fleet_stall_t = now
+        elif now - self._fleet_stall_t >= 30.0:
+            print(
+                "[fleet] WARNING: no windows ingested for "
+                f"{now - self._fleet_stall_t:.0f}s "
+                f"({c['connections']} live actor connections) — the "
+                "learner is paced by remote actors and will wait",
+                flush=True,
+            )
+            self._fleet_stall_t = now  # re-warn each interval, don't spam
 
     def _collector_loop(self):
         cfg = self.config
@@ -1371,7 +1529,14 @@ class Trainer:
                 # loop's top-of-loop check will checkpoint; just stop
                 # collecting promptly.
                 return
-            if self.has_pool:  # pool mode handles HER internally
+            if self._fleet_only:
+                # Remote hosts supply the warmup: wait for ingested
+                # windows, surfacing a dead ingest thread immediately.
+                self._fleet.check_alive()
+                self._fleet_env_steps()
+                self._fleet_stall_check()
+                time.sleep(0.01)
+            elif self.has_pool:  # pool mode handles HER internally
                 self._pool_collect_steps(self.config.num_envs * 8, noise_scale=3.0)
             elif cfg.her:
                 self._her_collect_episode(noise_scale=3.0)
@@ -1677,6 +1842,23 @@ class Trainer:
                         time.sleep(0.001)
                     if self._preempt_requested.is_set():
                         continue  # loop top checkpoints and exits
+                elif self._fleet_only:
+                    # Fleet is the sole experience source: pace exactly the
+                    # async_collect way, against ingested windows — never
+                    # outrun the remote actors' env:train ratio, never
+                    # sample a buffer that can't serve a batch.
+                    while (
+                        self._fleet_env_steps() - self._env_steps_origin
+                        < self._effective_warmup()
+                        + cfg.env_steps_per_train_step * self._learner_steps
+                    ) or len(self.buffer) < cfg.batch_size:
+                        self._fleet.check_alive()
+                        self._fleet_stall_check()
+                        if self._preempt_requested.is_set():
+                            break
+                        time.sleep(0.002)
+                    if self._preempt_requested.is_set():
+                        continue  # loop top checkpoints and exits
                 else:
                     # interleave collection to hold the env:train ratio (sync modes)
                     collect_budget += cfg.env_steps_per_train_step * K
@@ -1789,6 +1971,16 @@ class Trainer:
 
                 if cfg.async_collect and crossed(cfg.publish_interval):
                     self._publish_params()
+                if (
+                    self._fleet is not None
+                    and cfg.fleet_bundle
+                    and crossed(cfg.fleet_publish_interval)
+                ):
+                    # Weight distribution to the fleet: re-export the
+                    # bundle (atomic, mtime-attested) and bump the
+                    # generation so stale windows age out at ingest.
+                    self._fleet_gen += 1
+                    self._fleet_publish()
                 if self.sentinel is not None and crossed(cfg.eval_interval):
                     self.sentinel.check(f"eval crossing @ step {self.grad_steps}")
                 if crossed(cfg.eval_interval) or step >= total:
@@ -1864,15 +2056,20 @@ class Trainer:
         # Host-side counters the device TrainState doesn't carry: env_steps
         # drives the noise-decay schedule, so without it every --resume
         # would restart exploration at full scale.
+        extra = {}
+        if self.obs_norm is not None:
+            extra["obs_norm"] = self.obs_norm.state_dict()
+        if self._fleet is not None:
+            # The bundle generation must survive --resume: restarting at 0
+            # would regress below generations connected actors already
+            # hold, disarming the stale-window drop until the counter
+            # catches back up.
+            extra["fleet_generation"] = self._fleet_gen
         save_trainer_meta(
             self.config.log_dir,
             self.env_steps,
             self.ewma_return,
-            extra=(
-                {"obs_norm": self.obs_norm.state_dict()}
-                if self.obs_norm is not None
-                else None
-            ),
+            extra=extra or None,
         )
         if self.config.snapshot_replay:
             # Apply in-flight async priority updates first, else the snapshot
@@ -2283,6 +2480,19 @@ class Trainer:
             scalars["checkpoint_fallbacks"] = float(self._ckpt_fallbacks)
         if self._chaos is not None:
             scalars["chaos_injections"] = float(self._chaos.injections_total)
+        if self._fleet is not None:
+            # Fleet observability rides every row: ingested/dropped/shed
+            # window accounting plus the live generation (docs/fleet.md
+            # metrics schema). In fleet-only mode env_steps above IS the
+            # ingested-window counter (_fleet_env_steps). check_alive here
+            # covers the mixed mode (--fleet-listen with local envs), where
+            # no pacing loop consults the ingest server — a dead writer or
+            # accept thread must fail the run loudly, not shed forever.
+            self._fleet.check_alive()
+            if self._fleet_only:
+                scalars["env_steps"] = float(self._fleet_env_steps())
+            for k, v in self._fleet.counters().items():
+                scalars[f"fleet_{k}"] = float(v)
         if not self.is_jax_env and cfg.concurrent_eval:
             # Evaluator-thread path: hand off a param copy; logging/print
             # happen in _apply_eval when the eval completes. Return the
@@ -2308,6 +2518,12 @@ class Trainer:
         self._stop_collector()
         self._stop_eval_thread()
         self._stop_writeback()
+        if self._fleet is not None:
+            # Drain: frames already admitted to the ingest queue land in
+            # replay (and release their ledger holds) before teardown, so
+            # a guarded run ends zero-leaked-holds.
+            self._fleet.close()
+            self._fleet = None
         if self.sentinel is not None:
             self.sentinel.stop()
         if not self._eval_leaked:

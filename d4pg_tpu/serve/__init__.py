@@ -16,28 +16,30 @@ Barth-Maron et al. 2018 §deployment):
 - :mod:`~d4pg_tpu.serve.stats`    — p50/p95/p99, batch/queue histograms.
 
 Run it: ``python -m d4pg_tpu.serve --bundle <dir>`` (docs/serving.md).
+
+Lazy re-exports (the `_lazy.py` contract): the protocol, client, and
+stats submodules are host-only — thin clients and the JAX-free fleet
+actor hosts (``d4pg_tpu/fleet``) import them — so an eager
+``from .batcher import DynamicBatcher`` here would make ANY
+``d4pg_tpu.serve.*`` import pay the full JAX import.
 """
 
-from d4pg_tpu.serve.batcher import DynamicBatcher, ShedError, default_buckets
-from d4pg_tpu.serve.bundle import PolicyBundle, export_bundle, load_bundle
-from d4pg_tpu.serve.client import (
-    ConnectionClosed,
-    Overloaded,
-    PolicyClient,
-    ServerError,
-)
-from d4pg_tpu.serve.server import PolicyServer
+from d4pg_tpu._lazy import lazy_exports
 
-__all__ = [
-    "ConnectionClosed",
-    "DynamicBatcher",
-    "Overloaded",
-    "PolicyBundle",
-    "PolicyClient",
-    "PolicyServer",
-    "ServerError",
-    "ShedError",
-    "default_buckets",
-    "export_bundle",
-    "load_bundle",
-]
+_EXPORTS = {
+    "DynamicBatcher": "d4pg_tpu.serve.batcher",
+    "ShedError": "d4pg_tpu.serve.batcher",
+    "default_buckets": "d4pg_tpu.serve.batcher",
+    "PolicyBundle": "d4pg_tpu.serve.bundle",
+    "export_bundle": "d4pg_tpu.serve.bundle",
+    "load_bundle": "d4pg_tpu.serve.bundle",
+    "ConnectionClosed": "d4pg_tpu.serve.client",
+    "Overloaded": "d4pg_tpu.serve.client",
+    "PolicyClient": "d4pg_tpu.serve.client",
+    "ServerError": "d4pg_tpu.serve.client",
+    "PolicyServer": "d4pg_tpu.serve.server",
+}
+
+__getattr__, __dir__ = lazy_exports(__name__, _EXPORTS)
+
+__all__ = sorted(_EXPORTS)

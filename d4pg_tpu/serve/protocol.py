@@ -30,6 +30,19 @@ Message types and payloads:
 - ``HEALTHZ_OK``   ← utf-8 JSON: server stats snapshot (see
                      docs/serving.md for the schema).
 
+The experience-ingest service (``d4pg_tpu/fleet``) speaks the SAME frame
+layout on its own port with four more message types (payload codecs in
+``d4pg_tpu/fleet/wire.py``; full table in docs/fleet.md):
+
+- ``HELLO``        → utf-8 JSON: actor handshake (dims, n_step, gamma,
+                     bundle generation). First frame on every connection.
+- ``HELLO_OK``     ← utf-8 JSON: accepted; carries the learner's current
+                     generation and the flow-control window.
+- ``WINDOWS``      → binary batch of complete n-step windows, tagged with
+                     the producing bundle generation.
+- ``WINDOWS_OK``   ← per-frame ack: (accepted, dropped_stale) counts. A
+                     shed frame is answered ``OVERLOADED`` instead.
+
 ``read_frame`` returns ``None`` on clean EOF (peer closed between frames)
 and raises :class:`ProtocolError` on anything malformed — oversized
 declared length, bad magic, version mismatch, or EOF mid-frame.
@@ -52,18 +65,42 @@ MAX_PAYLOAD = 1 << 20
 HEADER = struct.Struct("<2sBBII")
 _DEADLINE = struct.Struct("<I")
 
-# message types
+# message types (one id space across serving AND fleet ingest: the framing
+# layer is shared, so a frame routed at the wrong port fails loudly on type)
 ACT = 1
 ACT_OK = 2
 OVERLOADED = 3
 ERROR = 4
 HEALTHZ = 5
 HEALTHZ_OK = 6
+HELLO = 7         # fleet actor handshake (d4pg_tpu/fleet/wire.py)
+HELLO_OK = 8
+WINDOWS = 9       # batch of complete n-step windows
+WINDOWS_OK = 10
 
 
 class ProtocolError(Exception):
     """Malformed frame — the connection is unrecoverable past this point
     (framing is lost), so handlers reply ERROR once and close."""
+
+
+def abortive_close(sock) -> None:
+    """Close with SO_LINGER 0 — an RST on real stacks, so the peer (and
+    any frame in flight) sees an immediate reset instead of an orderly
+    FIN. The shared teardown for the chaos fault sites (serve
+    ``sock_reset``, ingest ``partition``) and ``FleetLink.abort``."""
+    import socket as _socket
+
+    try:
+        sock.setsockopt(
+            _socket.SOL_SOCKET, _socket.SO_LINGER, struct.pack("ii", 1, 0)
+        )
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
 
 
 def recv_exact(stream, n: int) -> Optional[bytes]:

@@ -411,14 +411,7 @@ class PolicyServer:
                         # the disconnect-mid-request fault class. The
                         # OSError lands in the handler below; the server
                         # must keep serving every other connection.
-                        try:
-                            conn.setsockopt(
-                                socket.SOL_SOCKET, socket.SO_LINGER,
-                                struct.pack("ii", 1, 0),
-                            )
-                        except OSError:
-                            pass
-                        conn.close()
+                        protocol.abortive_close(conn)
                         raise OSError("chaos: injected socket reset")
                 msg_type, req_id, payload = frame
                 if msg_type == protocol.HEALTHZ:

@@ -1,22 +1,25 @@
-"""Shared utilities: profiling, offline plotting/run analysis."""
+"""Shared utilities: profiling, retry/backoff, signals, offline plotting.
 
-from d4pg_tpu.utils.profiling import annotate, profile_trace
+Lazy re-exports (the `_lazy.py` contract): ``utils.retry`` and
+``utils.signals`` are host-only — the JAX-free fleet actor hosts
+(``d4pg_tpu/fleet``) import them — so an eager
+``from .profiling import annotate`` here (profiling imports jax at top
+level) would make ANY ``d4pg_tpu.utils.*`` import pay the full JAX
+import and break the actor-host contract.
+"""
 
-__all__ = [
-    "annotate",
-    "profile_trace",
-    "compare_runs",
-    "ewma",
-    "load_run",
-    "plot_run",
-]
+from d4pg_tpu._lazy import lazy_exports
 
+_EXPORTS = {
+    "annotate": "d4pg_tpu.utils.profiling",
+    "profile_trace": "d4pg_tpu.utils.profiling",
+    # matplotlib-adjacent, kept off the training path
+    "compare_runs": "d4pg_tpu.utils.plotting",
+    "ewma": "d4pg_tpu.utils.plotting",
+    "load_run": "d4pg_tpu.utils.plotting",
+    "plot_run": "d4pg_tpu.utils.plotting",
+}
 
-def __getattr__(name):
-    # Lazy: keeps `python -m d4pg_tpu.utils.plotting` clean and the training
-    # path free of any matplotlib-adjacent imports.
-    if name in ("compare_runs", "ewma", "load_run", "plot_run"):
-        from d4pg_tpu.utils import plotting
+__getattr__, __dir__ = lazy_exports(__name__, _EXPORTS)
 
-        return getattr(plotting, name)
-    raise AttributeError(name)
+__all__ = sorted(_EXPORTS)

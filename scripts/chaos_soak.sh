@@ -9,7 +9,15 @@
 #      crash-consistency manifest contract), finishing the step budget;
 #   4. export a bundle and serve it under an injected client socket
 #      reset — server must answer before AND after, then drain on
-#      SIGTERM with exit 0.
+#      SIGTERM with exit 0;
+#   5. 2-process collection fleet under faults (docs/fleet.md): a
+#      --fleet-listen learner with NO local collection + a remote actor
+#      under partition / reconnect_flap / stale_bundle / slow_link
+#      injections, then kill -9 the learner mid-run and --resume it on
+#      the same port — the actor must reconnect under its bounded
+#      Backoff and feed the resumed run to completion, with every
+#      emitted window accounted for (acked/stale/shed/dropped — the
+#      zero-torn-windows contract) and guards green throughout.
 #
 # Knobs (env vars): SOAK_DIR (default mktemp), SOAK_ENV (Pendulum-v1),
 # SOAK_STEPS (grad steps per leg, default 6), SOAK_HIDDEN (16,16),
@@ -19,6 +27,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 DIR=${SOAK_DIR:-$(mktemp -d /tmp/chaos_soak.XXXXXX)}
+mkdir -p "$DIR"
 ENV_ID=${SOAK_ENV:-Pendulum-v1}
 STEPS=${SOAK_STEPS:-6}
 HIDDEN=${SOAK_HIDDEN:-16,16}
@@ -104,6 +113,91 @@ sys.stdout.write("[server] " + tail)
 rc = proc.wait(timeout=120)
 assert rc == 0 and "drained" in tail, (rc, tail)
 print("CHAOS_SOAK_SERVE_OK")
+EOF
+
+# ---- leg 5: collection fleet under faults + learner kill/resume ------------
+FLEET_PORT=$((20000 + RANDOM % 20000))
+FLEET_STEPS=${SOAK_FLEET_STEPS:-40}
+fleet_learner=(--env "$ENV_ID" --hidden-sizes "$HIDDEN" --warmup 24 --bsize 8
+               --rmsize 512 --eval-interval 100000 --num-envs 0
+               --fleet-listen "$FLEET_PORT" --fleet-bundle "$DIR/fleet_bundle"
+               --fleet-publish-interval 10 --debug-guards --snapshot-replay
+               --log-dir "$DIR/fleet")
+
+python train.py "${fleet_learner[@]}" --total-steps 100000 \
+  --checkpoint-interval 8 --chaos "seed=5;partition@6" \
+  > "$DIR/fleet_learner1.log" 2>&1 &
+FLEARNER=$!
+for _ in $(seq 1 600); do
+  [ -f "$DIR/fleet_bundle/bundle.json" ] \
+    && grep -q "ingest listening" "$DIR/fleet_learner1.log" && break
+  kill -0 "$FLEARNER" 2>/dev/null \
+    || { cat "$DIR/fleet_learner1.log"; echo "CHAOS_SOAK_FAIL: fleet learner died at startup"; exit 1; }
+  sleep 0.2
+done
+
+python -m d4pg_tpu.fleet.actor --connect "127.0.0.1:$FLEET_PORT" \
+  --bundle "$DIR/fleet_bundle" --batch-windows 8 --poll-interval 0.3 \
+  --stats-interval 5 --seed 13 --reconnect-attempts 400 \
+  --chaos "seed=7;reconnect_flap@1;stale_bundle@1;slow_link@3:150" \
+  > "$DIR/fleet_actor.log" 2>&1 &
+FACTOR=$!
+
+# wait for the first committed checkpoint, then kill -9 the learner with
+# the actor live — its link dies with frames in flight (dropped whole,
+# never resent) and it must reconnect to the resumed run
+FCKPT="$DIR/fleet/checkpoints"
+for _ in $(seq 1 600); do
+  compgen -G "$FCKPT/manifest_*.json" > /dev/null && break
+  kill -0 "$FLEARNER" 2>/dev/null \
+    || { cat "$DIR/fleet_learner1.log"; echo "CHAOS_SOAK_FAIL: fleet learner died before first commit"; exit 1; }
+  sleep 0.5
+done
+sleep "0.$((RANDOM % 100))"
+kill -9 "$FLEARNER" || true
+wait "$FLEARNER" 2>/dev/null || true
+echo "[chaos-soak] killed the fleet learner mid-ingest"
+
+FNEWEST=$(ls "$FCKPT"/manifest_*.json | sed 's/.*manifest_\([0-9]*\).json/\1/' | sort -n | tail -1)
+# metrics.jsonl is opened in APPEND mode across --resume: record where the
+# first leg's rows end so the ingest assertion below can only be satisfied
+# by rows the RESUMED learner wrote (else a pre-kill row passes it vacuously)
+FPRE_ROWS=$(wc -l < "$DIR/fleet/metrics.jsonl" 2>/dev/null || echo 0)
+python train.py "${fleet_learner[@]}" --resume \
+  --total-steps $((FNEWEST + FLEET_STEPS)) --checkpoint-interval 100000 \
+  --chaos "seed=5;partition@6" \
+  > "$DIR/fleet_learner2.log" 2>&1 \
+  || { cat "$DIR/fleet_learner2.log"; echo "CHAOS_SOAK_FAIL: resumed fleet learner exited non-zero"; exit 1; }
+grep -q "\[checkpoint\] resumed from step" "$DIR/fleet_learner2.log" \
+  || { cat "$DIR/fleet_learner2.log"; echo "CHAOS_SOAK_FAIL: fleet resume did not report its restored step"; exit 1; }
+
+kill -TERM "$FACTOR"
+wait "$FACTOR" \
+  || { cat "$DIR/fleet_actor.log"; echo "CHAOS_SOAK_FAIL: fleet actor drain exited non-zero"; exit 1; }
+
+# every emitted window accounted (torn windows never half-land: they are
+# either acked, counted stale/shed/dropped, or still spooled), the actor
+# reconnected at least once (the kill -9 guarantees it), and the resumed
+# learner ingested real windows with guards green (its rc 0 above).
+python - "$DIR" "$FPRE_ROWS" <<'EOF'
+import ast, json, sys
+d, pre_rows = sys.argv[1], int(sys.argv[2])
+drained = [l for l in open(f"{d}/fleet_actor.log") if "drained:" in l][-1]
+s = ast.literal_eval(drained.split("drained:", 1)[1].strip())
+acct = (s["windows_acked"] + s["windows_stale"] + s["windows_shed"]
+        + s["windows_dropped_reconnect"] + s["windows_dropped_spool"]
+        + s["spool_depth"])
+assert acct == s["windows_emitted"], (acct, s)
+assert s["reconnects"] >= 1, s
+# only rows APPENDED by the resumed leg count — a surviving pre-kill row
+# must not satisfy the ingest assertion vacuously
+rows = [json.loads(l) for l in open(f"{d}/fleet/metrics.jsonl")][pre_rows:]
+fleet = [r for r in rows if "fleet_windows_ingested" in r]
+assert fleet, "resumed learner wrote no fleet metrics rows"
+assert fleet[-1]["fleet_windows_ingested"] > 0, "resumed learner ingested nothing"
+print("CHAOS_SOAK_FLEET_OK", {k: s[k] for k in
+      ("windows_emitted", "windows_acked", "windows_dropped_reconnect",
+       "reconnects", "bundle_reloads")})
 EOF
 
 echo "CHAOS_SOAK_OK"

@@ -1,0 +1,103 @@
+#!/usr/bin/env bash
+# Fleet smoke: the 2-process collection fleet end to end through the REAL
+# CLIs (docs/fleet.md). Wired into tier-1 via tests/test_fleet_smoke.py;
+# also runnable by hand:
+#
+#   scripts/fleet_smoke.sh                 # throwaway run dir
+#   FLEET_SMOKE_DIR=/tmp/x scripts/fleet_smoke.sh
+#
+# The flow:
+#   1. train.py --fleet-listen 0 --fleet-bundle --num-envs 0 --debug-guards:
+#      the learner runs the experience-ingest server and publishes the
+#      acting bundle — it has NO local collection, so it can only finish
+#      if the fleet supplies real windows (the pacing proves ingest);
+#   2. python -m d4pg_tpu.fleet.actor connects, streams windows, and
+#      hot-swaps the bundle as the trainer re-publishes generations
+#      mid-run (the mtime-attested weight-distribution path);
+#   3. learner completes rc 0 (guards green — a sentinel/ledger/transfer
+#      trip would have raised); the actor is then SIGTERM'd and must
+#      drain rc 0 with every emitted window accounted for.
+#
+# Knobs (env vars): FLEET_SMOKE_DIR, FLEET_SMOKE_STEPS (default 60),
+# FLEET_SMOKE_HIDDEN (default 16,16).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+RUN=${FLEET_SMOKE_DIR:-$(mktemp -d /tmp/fleet_smoke.XXXXXX)}
+mkdir -p "$RUN"
+STEPS=${FLEET_SMOKE_STEPS:-60}
+HIDDEN=${FLEET_SMOKE_HIDDEN:-16,16}
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+
+echo "[fleet-smoke] run dir: $RUN"
+
+python train.py --env Pendulum-v1 --hidden-sizes "$HIDDEN" \
+  --total-steps "$STEPS" --warmup 24 --bsize 8 --rmsize 512 \
+  --eval-interval "$STEPS" --eval-episodes 2 \
+  --checkpoint-interval "$STEPS" --num-envs 0 \
+  --fleet-listen 0 --fleet-bundle "$RUN/bundle" \
+  --fleet-publish-interval 20 --debug-guards \
+  --log-dir "$RUN" > "$RUN/learner.log" 2>&1 &
+LEARNER=$!
+
+PORT=
+for _ in $(seq 1 600); do
+  PORT=$(sed -n 's/.*ingest listening on :\([0-9][0-9]*\).*/\1/p' "$RUN/learner.log" | head -1)
+  if [ -n "$PORT" ] && [ -f "$RUN/bundle/bundle.json" ]; then break; fi
+  kill -0 "$LEARNER" 2>/dev/null \
+    || { cat "$RUN/learner.log"; echo "FLEET_SMOKE_FAIL: learner died before listening"; exit 1; }
+  sleep 0.2
+done
+[ -n "$PORT" ] || { cat "$RUN/learner.log"; echo "FLEET_SMOKE_FAIL: no ingest port"; exit 1; }
+echo "[fleet-smoke] ingest on :$PORT"
+
+python -m d4pg_tpu.fleet.actor --connect "127.0.0.1:$PORT" \
+  --bundle "$RUN/bundle" --batch-windows 8 --poll-interval 0.3 \
+  --stats-interval 5 --seed 11 > "$RUN/actor.log" 2>&1 &
+ACTOR=$!
+
+# The learner can only complete because the actor feeds it (fleet-only
+# pacing): its rc 0 IS the ingest proof, and --debug-guards means any
+# recompile/transfer/staging trip would have raised instead.
+if ! wait "$LEARNER"; then
+  cat "$RUN/learner.log"; kill -9 "$ACTOR" 2>/dev/null || true
+  echo "FLEET_SMOKE_FAIL: learner exited non-zero"; exit 1
+fi
+grep -q "published bundle generation 1" "$RUN/learner.log" \
+  || { cat "$RUN/learner.log"; echo "FLEET_SMOKE_FAIL: no mid-run bundle publish"; exit 1; }
+
+# Give the actor one more poll so it observes the final published bundle
+# (the hot-swap-mid-run assertion below), then SIGTERM-drain it.
+sleep 1.2
+kill -TERM "$ACTOR"
+if ! wait "$ACTOR"; then
+  cat "$RUN/actor.log"; echo "FLEET_SMOKE_FAIL: actor drain exited non-zero"; exit 1
+fi
+grep -q "hot-swapped bundle generation=" "$RUN/actor.log" \
+  || { cat "$RUN/actor.log"; echo "FLEET_SMOKE_FAIL: actor never hot-swapped the bundle"; exit 1; }
+grep -q "\[fleet-actor\] drained:" "$RUN/actor.log" \
+  || { cat "$RUN/actor.log"; echo "FLEET_SMOKE_FAIL: actor never drained"; exit 1; }
+
+# Window accounting: real windows ingested, and every emitted window
+# accounted for (acked + stale + shed + dropped + still-spooled) — the
+# zero-torn-windows contract, checked from the artifacts the run left.
+python - "$RUN" <<'EOF'
+import ast, json, sys
+run = sys.argv[1]
+rows = [json.loads(l) for l in open(f"{run}/metrics.jsonl")]
+fleet_rows = [r for r in rows if "fleet_windows_ingested" in r]
+assert fleet_rows, "no metrics row carries fleet counters"
+last = fleet_rows[-1]
+assert last["fleet_windows_ingested"] > 0, last
+assert last["fleet_generation"] >= 1, last
+drained = [l for l in open(f"{run}/actor.log") if "drained:" in l][-1]
+stats = ast.literal_eval(drained.split("drained:", 1)[1].strip())
+acct = (stats["windows_acked"] + stats["windows_stale"] + stats["windows_shed"]
+        + stats["windows_dropped_reconnect"] + stats["windows_dropped_spool"]
+        + stats["spool_depth"])
+assert acct == stats["windows_emitted"], (acct, stats)
+print("FLEET_SMOKE_COUNTERS_OK", {k: stats[k] for k in
+      ("windows_emitted", "windows_acked", "bundle_reloads")})
+EOF
+
+echo "FLEET_SMOKE_OK"

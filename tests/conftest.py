@@ -34,6 +34,32 @@ import functools  # noqa: E402
 import pytest  # noqa: E402
 
 
+def clean_cpu_env(*, pythonpath_repo: bool = False) -> dict:
+    """A child-process env with a REAL local CPU backend: the
+    tunneled-TPU plugin registers itself via PYTHONPATH site hooks and
+    AXON_*/TPU_* vars and overrides JAX_PLATFORMS=cpu (a per-step host
+    sync then costs a ~100 ms link round-trip — per-step env loops crawl
+    ~1000x). ONE copy here: every subprocess smoke (serve, fleet, dmc)
+    and the EGL probe scrub the same vars or their scrub rules diverge.
+    ``pythonpath_repo=True`` also drops the inherited PYTHONPATH (where
+    the plugin's site hooks live) and pins it to the repo root so the
+    child can still import d4pg_tpu."""
+    drop = {"JAX_PLATFORMS", "XLA_FLAGS"}
+    if pythonpath_repo:
+        drop.add("PYTHONPATH")
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in drop and "AXON" not in k and "TPU" not in k
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    if pythonpath_repo:
+        env["PYTHONPATH"] = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+    return env
+
+
 @functools.lru_cache(maxsize=1)
 def has_working_egl() -> bool:
     """True iff an EGL context can be created and a frame rendered, probed
@@ -54,13 +80,7 @@ def has_working_egl() -> bool:
         "e = suite.load('cartpole', 'swingup'); e.reset(); "
         "e.physics.render(16, 16); print('EGL_OK')"
     )
-    env = {
-        k: v
-        for k, v in os.environ.items()
-        if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
-        and "AXON" not in k
-        and "TPU" not in k
-    }
+    env = clean_cpu_env()
     env["MUJOCO_GL"] = "egl"
     try:
         p = subprocess.run(

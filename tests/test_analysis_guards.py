@@ -386,6 +386,27 @@ def test_debug_guards_integration_smoke(tmp_path):
         t.close()
 
 
+def test_debug_guards_clean_across_resume(tmp_path):
+    """Regression (chaos-soak fleet leg): Orbax restore hands back
+    host-resident leaves; without the explicit post-restore device_put
+    commit, the first guarded dispatch of a --resume --debug-guards run
+    trips the transfer guard on the restored state's int32 step scalar."""
+    from d4pg_tpu.runtime.trainer import Trainer
+
+    t = Trainer(_guarded_config(tmp_path, "res", checkpoint_interval=4))
+    try:
+        t.train()
+    finally:
+        t.close()
+    r = Trainer(_guarded_config(tmp_path, "res", resume=True, total_steps=8))
+    try:
+        r.train()  # without the commit this raises the disallowed-transfer
+        assert r.grad_steps > 4  # really trained past the restored step
+        assert r._ledger.stats()["trips"] == 0
+    finally:
+        r.close()
+
+
 def test_guards_no_false_trip_with_lagging_async_flusher(tmp_path, monkeypatch):
     """The async priority flusher paces hold releases; a lagging flusher
     must make the guarded learner WAIT, not false-trip the ledger. The

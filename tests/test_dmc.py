@@ -16,22 +16,11 @@ pytest.importorskip("dm_control")
 
 
 def _clean_cpu_env():
-    """A child env with a REAL local CPU backend: the tunneled-TPU plugin
-    registers itself via PYTHONPATH site hooks and AXON_*/TPU_* vars and
-    overrides JAX_PLATFORMS=cpu (a per-step host sync then costs a ~100 ms
-    link round-trip — per-step env loops crawl ~1000x)."""
-    import os
+    """conftest.clean_cpu_env with the dmc extras: repo-pinned PYTHONPATH
+    (the children run train.py from the repo root) and an EGL default."""
+    from conftest import clean_cpu_env
 
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = {
-        k: v
-        for k, v in os.environ.items()
-        if k not in ("JAX_PLATFORMS", "XLA_FLAGS", "PYTHONPATH")
-        and "AXON" not in k
-        and "TPU" not in k
-    }
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = repo
+    env = clean_cpu_env(pythonpath_repo=True)
     env.setdefault("MUJOCO_GL", "egl")
     return env
 

@@ -1,0 +1,928 @@
+"""Collection-fleet tests: wire codecs, numpy policy parity, ingest
+server contracts (admission/shed/stale-gen/torn-frame), and the
+content-parity claim — a localhost fleet stream and the in-process
+writer path produce byte-identical replay content.
+
+Everything here is in-process and device-free except the numpy-policy
+parity test (which compiles a tiny actor on CPU) and the subprocess
+JAX-free import assertion. The end-to-end 2-process CLI smoke lives in
+``tests/test_fleet_smoke.py`` (scripts/fleet_smoke.sh); the fault soak
+in ``scripts/chaos_soak.sh``.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from d4pg_tpu.chaos import ChaosInjector, ChaosPlan
+from d4pg_tpu.fleet import wire
+from d4pg_tpu.fleet.actor import FleetLink, _Spool
+from d4pg_tpu.fleet.ingest import IngestServer
+from d4pg_tpu.fleet.policy import load_numpy_policy
+from d4pg_tpu.replay.nstep_writer import NStepWriter
+from d4pg_tpu.replay.uniform import ReplayBuffer, Transition
+from d4pg_tpu.serve import protocol
+from d4pg_tpu.serve.protocol import ProtocolError
+
+OBS, ACT, NSTEP, GAMMA = 5, 2, 3, 0.99
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+# ------------------------------------------------------------------- wire
+def test_wire_hello_roundtrip():
+    payload = wire.encode_hello(
+        actor_id="a0", env="Pendulum-v1", obs_dim=OBS, action_dim=ACT,
+        n_step=NSTEP, gamma=GAMMA, generation=4,
+    )
+    doc = wire.decode_hello(payload)
+    assert (doc["obs_dim"], doc["action_dim"]) == (OBS, ACT)
+    assert (doc["n_step"], doc["gamma"], doc["generation"]) == (NSTEP, GAMMA, 4)
+    ok = wire.decode_hello_ok(
+        wire.encode_hello_ok(generation=7, max_windows=64, max_inflight=8)
+    )
+    assert ok == {"generation": 7, "max_windows_per_frame": 64, "max_inflight": 8}
+
+
+def test_wire_hello_malformed():
+    with pytest.raises(ProtocolError, match="malformed HELLO"):
+        wire.decode_hello(b"not json")
+    with pytest.raises(ProtocolError, match="malformed HELLO"):
+        wire.decode_hello(b'{"obs_dim": 3}')  # missing required keys
+    with pytest.raises(ProtocolError, match="malformed HELLO"):
+        # keys present, wrong types: must be ProtocolError (answered with
+        # the documented ERROR+close), never a TypeError that kills the
+        # reader thread with a bare close
+        wire.decode_hello(
+            b'{"obs_dim": null, "action_dim": 3, "n_step": 5,'
+            b' "gamma": 0.99}'
+        )
+    with pytest.raises(ProtocolError, match="malformed HELLO_OK"):
+        wire.decode_hello_ok(b'{"generation": 1}')
+    with pytest.raises(ProtocolError, match="malformed HELLO_OK"):
+        wire.decode_hello_ok(
+            b'{"generation": 1, "max_windows_per_frame": null,'
+            b' "max_inflight": 4}'
+        )
+
+
+def test_wire_windows_roundtrip():
+    rng = np.random.default_rng(0)
+    n = 11
+    cols = {
+        "obs": rng.standard_normal((n, OBS)).astype(np.float32),
+        "action": rng.standard_normal((n, ACT)).astype(np.float32),
+        "reward": rng.standard_normal(n).astype(np.float32),
+        "next_obs": rng.standard_normal((n, OBS)).astype(np.float32),
+        "discount": rng.random(n).astype(np.float32),
+    }
+    payload = wire.encode_windows(3, **cols)
+    gen, got = wire.decode_windows(payload, OBS, ACT)
+    assert gen == 3
+    for k in cols:
+        np.testing.assert_array_equal(got[k], cols[k])
+
+
+def test_wire_windows_size_mismatch():
+    payload = wire.encode_windows(
+        0,
+        np.zeros((2, OBS), np.float32), np.zeros((2, ACT), np.float32),
+        np.zeros(2, np.float32), np.zeros((2, OBS), np.float32),
+        np.zeros(2, np.float32),
+    )
+    with pytest.raises(ProtocolError, match="declares"):
+        wire.decode_windows(payload[:-4], OBS, ACT)  # truncated
+    with pytest.raises(ProtocolError, match="declares"):
+        wire.decode_windows(payload, OBS + 1, ACT)  # wrong dims
+    with pytest.raises(ProtocolError, match="header"):
+        wire.decode_windows(b"\x01", OBS, ACT)
+    ok = wire.encode_windows_ok(5, 2)
+    assert wire.decode_windows_ok(ok) == (5, 2)
+    with pytest.raises(ProtocolError):
+        wire.decode_windows_ok(ok + b"x")
+
+
+def test_bundle_constants_pinned():
+    """fleet.policy restates serve.bundle's layout constants (importing
+    serve.bundle pulls JAX, which policy.py must never do) — pin them."""
+    from d4pg_tpu.fleet import policy as fp
+    from d4pg_tpu.serve import bundle as sb
+
+    assert fp.BUNDLE_VERSION == sb.BUNDLE_VERSION
+    assert fp.PARAMS_FILE == sb.PARAMS_FILE
+    assert fp.META_FILE == sb.META_FILE
+
+
+# ----------------------------------------------------------- numpy policy
+@pytest.fixture(scope="module")
+def tiny_bundle(tmp_path_factory):
+    from d4pg_tpu.config import D4PGConfig
+    from d4pg_tpu.serve.bundle import actor_template, export_bundle
+
+    cfg = D4PGConfig(obs_dim=OBS, action_dim=ACT, hidden_sizes=(8, 8),
+                     n_step=NSTEP, gamma=GAMMA)
+    params = actor_template(cfg)
+    path = str(tmp_path_factory.mktemp("bundle"))
+    export_bundle(path, cfg, params, meta={"generation": 3, "env": "e"})
+    return cfg, params, path
+
+
+def test_numpy_policy_parity_with_jitted_actor(tiny_bundle):
+    import jax
+
+    from d4pg_tpu.agent import act_deterministic
+
+    cfg, params, path = tiny_bundle
+    pol = load_numpy_policy(path)
+    assert (pol.obs_dim, pol.action_dim) == (OBS, ACT)
+    assert (pol.n_step, pol.gamma, pol.generation) == (NSTEP, GAMMA, 3)
+    obs = np.random.default_rng(1).standard_normal((16, OBS)).astype(np.float32)
+    want = np.asarray(jax.jit(act_deterministic, static_argnums=0)(
+        cfg, params, obs))
+    got = pol.act(obs)
+    # XLA may reassociate float reductions; exploration noise dwarfs 1e-5
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_numpy_policy_obs_norm(tiny_bundle):
+    import json
+
+    cfg, params, path = tiny_bundle
+    from d4pg_tpu.serve.bundle import export_bundle
+
+    stats = {
+        "count": 10.0,
+        "mean": [0.5] * OBS,
+        "m2": [40.0] * OBS,  # var 4.0 -> std 2.0
+    }
+    p2 = path + "_norm"
+    export_bundle(p2, cfg, params, obs_norm_state=stats)
+    pol = load_numpy_policy(p2)
+    plain = load_numpy_policy(path)
+    obs = np.full((1, OBS), 1.5, np.float32)
+    # (1.5 - 0.5) / 2.0 = 0.5 must be what the layers see
+    np.testing.assert_allclose(
+        pol.act(obs), plain.act(np.full((1, OBS), 0.5, np.float32)), atol=1e-6
+    )
+    # torn/malformed meta is a load error, not a garbage policy
+    doc = json.load(open(os.path.join(p2, "bundle.json")))
+    doc["agent"]["hidden_sizes"] = [16, 16]
+    json.dump(doc, open(os.path.join(p2, "bundle.json"), "w"))
+    with pytest.raises(ValueError, match="mismatch|leaves"):
+        load_numpy_policy(p2)
+    doc["agent"]["hidden_sizes"] = [8, 8]
+    doc["agent"]["pixel_shape"] = [8, 8, 2]
+    json.dump(doc, open(os.path.join(p2, "bundle.json"), "w"))
+    with pytest.raises(ValueError, match="pixel"):
+        load_numpy_policy(p2)
+
+
+def test_fleet_modules_are_jax_free():
+    """The actor-host contract: importing every fleet module (plus the
+    replay writers the actor reuses) must not load the JAX runtime."""
+    code = (
+        "import sys\n"
+        "import d4pg_tpu.fleet.actor, d4pg_tpu.fleet.ingest\n"
+        "import d4pg_tpu.fleet.wire, d4pg_tpu.fleet.policy\n"
+        "import d4pg_tpu.replay.nstep_writer\n"
+        "bad = [m for m in sys.modules if m == 'jax' or m.startswith('jax.')]\n"
+        "assert not bad, bad\n"
+        "print('JAXFREE_OK')\n"
+    )
+    p = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=120,
+    )
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "JAXFREE_OK" in p.stdout
+
+
+# ------------------------------------------------------------------ spool
+def test_spool_bounded_and_generation_prefix():
+    sp = _Spool(limit=4)
+    for i in range(6):
+        sp.generation = 0 if i < 3 else 1
+        sp.add(np.zeros(OBS), np.zeros(ACT), float(i), np.zeros(OBS), 0.9)
+    assert len(sp) == 4 and sp.dropped == 2  # oldest two dropped
+    gen, cols = sp.take_frame(max_rows=8)
+    # rows 2 (gen 0) then 3..5 (gen 1): the frame stops at the gen flip
+    assert gen == 0 and len(cols["reward"]) == 1
+    gen, cols = sp.take_frame(max_rows=2)
+    assert gen == 1 and len(cols["reward"]) == 2  # capped at max_rows
+    gen, cols = sp.take_frame(max_rows=8)
+    assert gen == 1 and len(cols["reward"]) == 1
+    assert sp.take_frame(8) is None
+
+
+# ----------------------------------------------------------------- ingest
+def _start_server(buffer=None, **kw):
+    buf = buffer if buffer is not None else ReplayBuffer(256, OBS, ACT)
+    srv = IngestServer(
+        buf, obs_dim=OBS, action_dim=ACT, n_step=NSTEP, gamma=GAMMA,
+        port=0, **kw,
+    ).start()
+    return srv, buf
+
+
+def _handshake(srv, generation=0, **over):
+    hello = dict(actor_id="t", env="e", obs_dim=OBS, action_dim=ACT,
+                 n_step=NSTEP, gamma=GAMMA, generation=generation)
+    hello.update(over)
+    s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+    s.settimeout(5)
+    protocol.write_frame(s, protocol.HELLO, 1, wire.encode_hello(**hello))
+    return s, protocol.read_frame(s)
+
+
+def _frame_cols(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "obs": rng.standard_normal((n, OBS)).astype(np.float32),
+        "action": rng.standard_normal((n, ACT)).astype(np.float32),
+        "reward": rng.standard_normal(n).astype(np.float32),
+        "next_obs": rng.standard_normal((n, OBS)).astype(np.float32),
+        "discount": rng.random(n).astype(np.float32),
+    }
+
+
+def test_ingest_accepts_windows_and_acks():
+    srv, buf = _start_server()
+    try:
+        s, (t, _r, payload) = _handshake(srv)
+        assert t == protocol.HELLO_OK
+        ok = wire.decode_hello_ok(payload)
+        assert ok["max_inflight"] >= 1 and ok["max_windows_per_frame"] >= 1
+        cols = _frame_cols(7)
+        protocol.write_frame(
+            s, protocol.WINDOWS, 2, wire.encode_windows(0, **cols)
+        )
+        t, r, payload = protocol.read_frame(s)
+        assert (t, r) == (protocol.WINDOWS_OK, 2)
+        assert wire.decode_windows_ok(payload) == (7, 0)
+        assert _wait(lambda: len(buf) == 7)
+        np.testing.assert_array_equal(buf.obs[:7], cols["obs"])
+        np.testing.assert_array_equal(buf.reward[:7], cols["reward"])
+        # healthz over the same connection
+        protocol.write_frame(s, protocol.HEALTHZ, 3)
+        t, _r, payload = protocol.read_frame(s)
+        import json
+
+        assert t == protocol.HEALTHZ_OK
+        h = json.loads(payload)
+        assert h["windows_ingested"] == 7 and h["connections"] == 1
+        s.close()
+    finally:
+        srv.close()
+
+
+def test_ingest_answers_healthz_before_handshake():
+    """Monitoring probes send a bare HEALTHZ with no HELLO — the same
+    probe the serve port answers (docs/fleet.md); it must not count as a
+    protocol error."""
+    import json
+
+    srv, _buf = _start_server()
+    try:
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        s.settimeout(5)
+        protocol.write_frame(s, protocol.HEALTHZ, 1)
+        t, r, payload = protocol.read_frame(s)
+        assert (t, r) == (protocol.HEALTHZ_OK, 1)
+        assert json.loads(payload)["protocol_errors"] == 0
+        # the connection can still HELLO and stream afterwards
+        protocol.write_frame(
+            s, protocol.HELLO, 2,
+            wire.encode_hello(actor_id="probe", env="e", obs_dim=OBS,
+                              action_dim=ACT, n_step=NSTEP, gamma=GAMMA,
+                              generation=0),
+        )
+        t, _r, _p = protocol.read_frame(s)
+        assert t == protocol.HELLO_OK
+        s.close()
+        assert srv.counters()["protocol_errors"] == 0
+    finally:
+        srv.close()
+
+
+def test_ingest_refuses_mismatched_hello():
+    srv, _buf = _start_server()
+    try:
+        s, (t, _r, payload) = _handshake(srv, obs_dim=OBS + 1)
+        assert t == protocol.ERROR and b"obs_dim" in payload
+        assert protocol.read_frame(s) is None  # server closed
+        s.close()
+        s, (t, _r, payload) = _handshake(srv, n_step=NSTEP + 1, gamma=0.5)
+        assert t == protocol.ERROR
+        assert b"n_step" in payload and b"gamma" in payload
+        s.close()
+    finally:
+        srv.close()
+
+
+def test_ingest_refuses_wrong_typed_hello():
+    """Keys present but wrong-typed ({"obs_dim": null}): the server must
+    answer ERROR and close — not die with an uncaught TypeError and a
+    bare close — and count it in protocol_errors."""
+    srv, _buf = _start_server()
+    try:
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        s.settimeout(5)
+        protocol.write_frame(
+            s, protocol.HELLO, 1,
+            b'{"actor_id": "t", "env": "e", "obs_dim": null,'
+            b' "action_dim": 3, "n_step": 5, "gamma": 0.99}',
+        )
+        t, _r, payload = protocol.read_frame(s)
+        assert t == protocol.ERROR and b"HELLO" in payload
+        assert protocol.read_frame(s) is None  # server closed
+        s.close()
+        assert _wait(lambda: srv.counters()["protocol_errors"] == 1)
+    finally:
+        srv.close()
+
+
+def test_ingest_drops_stale_generation():
+    srv, buf = _start_server(max_gen_lag=1)
+    try:
+        srv.set_generation(5)
+        s, (t, _r, payload) = _handshake(srv, generation=0)
+        assert t == protocol.HELLO_OK
+        # a fresh HELLO_OK tells the actor where the learner is
+        assert wire.decode_hello_ok(payload)["generation"] == 5
+        cols = _frame_cols(6)
+        protocol.write_frame(
+            s, protocol.WINDOWS, 2, wire.encode_windows(3, **cols)
+        )  # gen 3 < 5 - 1: stale
+        t, _r, payload = protocol.read_frame(s)
+        assert t == protocol.WINDOWS_OK
+        assert wire.decode_windows_ok(payload) == (0, 6)
+        protocol.write_frame(
+            s, protocol.WINDOWS, 3, wire.encode_windows(4, **cols)
+        )  # gen 4 == 5 - 1: inside the lag window
+        t, _r, payload = protocol.read_frame(s)
+        assert wire.decode_windows_ok(payload) == (6, 0)
+        assert _wait(lambda: len(buf) == 6)
+        c = srv.counters()
+        assert c["windows_dropped_stale_gen"] == 6
+        assert c["windows_ingested"] == 6
+        s.close()
+    finally:
+        srv.close()
+
+
+class _GatedBuffer:
+    """add_batch blocks until released — pins the ingest writer thread so
+    the admission queue can actually fill."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+        self.rows = 0
+
+    def add_batch(self, t):
+        self.gate.wait(10)
+        self.rows += len(t.reward)
+        return np.arange(len(t.reward))
+
+
+def test_ingest_queue_full_sheds_explicitly():
+    gated = _GatedBuffer()  # pins the writer thread in its first add_batch
+    srv, _buf = _start_server(buffer=gated, queue_limit=1)
+    try:
+        s, (t, _r, _p) = _handshake(srv)
+        assert t == protocol.HELLO_OK
+        cols = _frame_cols(4)
+        accepted = 0
+        shed = False
+        # with the writer pinned and a 1-deep queue, a few frames MUST
+        # cross the admission limit; exactly when depends on the writer's
+        # pop timing, so accept-until-OVERLOADED is the deterministic form
+        for req in range(2, 12):
+            protocol.write_frame(
+                s, protocol.WINDOWS, req, wire.encode_windows(0, **cols)
+            )
+            t, r, p = protocol.read_frame(s)
+            assert r == req
+            if t == protocol.OVERLOADED:
+                assert p == b"queue_full"
+                shed = True
+                break
+            assert t == protocol.WINDOWS_OK
+            assert wire.decode_windows_ok(p) == (4, 0)
+            accepted += 1
+        assert shed, "queue never filled"
+        assert accepted >= 1
+        assert srv.counters()["windows_shed"] == 4
+        gated.gate.set()
+        # every ADMITTED frame still lands in replay; the shed one never does
+        assert _wait(lambda: gated.rows == 4 * accepted)
+        s.close()
+    finally:
+        gated.gate.set()
+        srv.close()
+
+
+def test_ingest_malformed_frame_errors_and_survives():
+    srv, buf = _start_server()
+    try:
+        s, (t, _r, _p) = _handshake(srv)
+        assert t == protocol.HELLO_OK
+        s.sendall(b"XX" + b"\x00" * 10)  # bad magic
+        t, _r, payload = protocol.read_frame(s)
+        assert t == protocol.ERROR and b"magic" in payload
+        assert protocol.read_frame(s) is None  # server closed the conn
+        s.close()
+        # declared-size/content mismatch inside a well-framed payload
+        s, _ = _handshake(srv)
+        protocol.write_frame(s, protocol.WINDOWS, 2, b"\x00" * 9)
+        t, _r, payload = protocol.read_frame(s)
+        assert t == protocol.ERROR
+        s.close()
+        assert _wait(lambda: srv.counters()["protocol_errors"] == 2)
+        # the server is still alive and accepting
+        s, (t, _r, _p) = _handshake(srv)
+        assert t == protocol.HELLO_OK
+        s.close()
+        assert len(buf) == 0  # nothing malformed ever reached replay
+    finally:
+        srv.close()
+
+
+def test_ingest_torn_frame_drops_windows_whole():
+    """Disconnect mid-WINDOWS-frame: the partial frame dies inside
+    read_frame, its windows never reach the queue or the buffer."""
+    srv, buf = _start_server()
+    try:
+        s, (t, _r, _p) = _handshake(srv)
+        assert t == protocol.HELLO_OK
+        payload = wire.encode_windows(0, **_frame_cols(5))
+        hdr = protocol.HEADER.pack(
+            protocol.MAGIC, protocol.PROTOCOL_VERSION, protocol.WINDOWS,
+            2, len(payload),
+        )
+        s.sendall(hdr + payload[: len(payload) // 2])
+        s.close()  # EOF mid-frame
+        assert _wait(lambda: srv.counters()["connections"] == 0)
+        time.sleep(0.05)  # writer drain window
+        assert len(buf) == 0
+        assert srv.counters()["windows_ingested"] == 0
+    finally:
+        srv.close()
+
+
+def test_ingest_chaos_partition_aborts_midstream():
+    plan = ChaosPlan.parse("seed=1;partition@2")
+    srv, buf = _start_server(chaos=ChaosInjector(plan))
+    try:
+        s, (t, _r, _p) = _handshake(srv)
+        assert t == protocol.HELLO_OK
+        cols = _frame_cols(3)
+        protocol.write_frame(s, protocol.WINDOWS, 2, wire.encode_windows(0, **cols))
+        t, _r, p = protocol.read_frame(s)
+        assert wire.decode_windows_ok(p) == (3, 0)
+        protocol.write_frame(s, protocol.WINDOWS, 3, wire.encode_windows(0, **cols))
+        # injected abortive close: reset or EOF, never a WINDOWS_OK
+        with pytest.raises((OSError, ProtocolError, ConnectionError)):
+            frame = protocol.read_frame(s)
+            if frame is None:
+                raise ConnectionError("closed")
+            assert frame[0] != protocol.WINDOWS_OK
+        s.close()
+        assert _wait(lambda: len(buf) == 3)  # only the pre-fault frame
+        # server survives: a new connection handshakes fine
+        s, (t, _r, _p) = _handshake(srv)
+        assert t == protocol.HELLO_OK
+        s.close()
+    finally:
+        srv.close()
+
+
+def test_ingest_close_drains_admitted_frames():
+    gated = _GatedBuffer()
+    srv, _ = _start_server(buffer=gated, queue_limit=8)
+    s, (t, _r, _p) = _handshake(srv)
+    assert t == protocol.HELLO_OK
+    for i in range(3):
+        protocol.write_frame(
+            s, protocol.WINDOWS, 2 + i,
+            wire.encode_windows(0, **_frame_cols(2, seed=i)),
+        )
+    for _ in range(3):
+        t, _r, p = protocol.read_frame(s)
+        assert wire.decode_windows_ok(p) == (2, 0)
+    threading.Timer(0.2, gated.gate.set).start()
+    srv.close()  # must block until the queue drained into add_batch
+    assert gated.rows == 6
+    s.close()
+
+
+# ---------------------------------------------------------- content parity
+def _episode_stream(seed, steps):
+    """A deterministic (obs, action, reward, next_obs, term, trunc) stream
+    with both episode-end flavors, shared by both writer paths."""
+    rng = np.random.default_rng(seed)
+    obs = rng.standard_normal(OBS).astype(np.float32)
+    t_in_ep = 0
+    for i in range(steps):
+        action = rng.standard_normal(ACT).astype(np.float32)
+        reward = float(rng.standard_normal())
+        next_obs = rng.standard_normal(OBS).astype(np.float32)
+        t_in_ep += 1
+        term = t_in_ep == 13 and (i // 13) % 2 == 0
+        trunc = t_in_ep == 13 and not term
+        yield obs, action, reward, next_obs, term, trunc
+        if term or trunc:
+            obs = rng.standard_normal(OBS).astype(np.float32)
+            t_in_ep = 0
+        else:
+            obs = next_obs
+
+
+def test_fleet_and_inprocess_replay_content_identical():
+    """The headline parity claim: the same episode stream through (a) the
+    in-process NStepWriter -> ReplayBuffer path and (b) the fleet path —
+    NStepWriter -> spool -> framed socket -> IngestServer -> ReplayBuffer
+    — leaves byte-identical replay content, in order, zero torn rows."""
+    buf_local = ReplayBuffer(512, OBS, ACT)
+    w_local = NStepWriter(buf_local, NSTEP, GAMMA)
+
+    srv, buf_fleet = _start_server()
+    acks = {"accepted": 0, "stale": 0, "shed": 0, "dropped": 0}
+
+    def on_ack(kind, n):
+        acks[kind] += n
+
+    try:
+        link = FleetLink(
+            "127.0.0.1", srv.port,
+            dict(actor_id="p", env="e", obs_dim=OBS, action_dim=ACT,
+                 n_step=NSTEP, gamma=GAMMA, generation=0),
+            on_ack=on_ack,
+        )
+        spool = _Spool(4096)
+        w_fleet = NStepWriter(spool, NSTEP, GAMMA)
+        for obs, action, reward, next_obs, term, trunc in _episode_stream(7, 200):
+            w_local.add(obs, action, reward, next_obs, term, trunc)
+            w_fleet.add(obs, action, reward, next_obs, term, trunc)
+        emitted = len(spool)
+        assert emitted == len(buf_local) > 0
+        while spool.rows:
+            assert link.acquire_credit(5)
+            gen, cols = spool.take_frame(link.max_windows)
+            link.send_windows(gen, cols)
+        assert _wait(lambda: link.inflight() == 0)
+        link.close()
+        assert _wait(lambda: len(buf_fleet) == emitted)
+        assert acks == {"accepted": emitted, "stale": 0, "shed": 0, "dropped": 0}
+        n = emitted
+        np.testing.assert_array_equal(buf_fleet.obs[:n], buf_local.obs[:n])
+        np.testing.assert_array_equal(buf_fleet.action[:n], buf_local.action[:n])
+        np.testing.assert_array_equal(buf_fleet.reward[:n], buf_local.reward[:n])
+        np.testing.assert_array_equal(
+            buf_fleet.next_obs[:n], buf_local.next_obs[:n]
+        )
+        np.testing.assert_array_equal(
+            buf_fleet.discount[:n], buf_local.discount[:n]
+        )
+    finally:
+        srv.close()
+
+
+def test_actor_step_envs_windows_capture_preassignment_obs(tmp_path):
+    """Regression: NStepWriter stores obs WITHOUT copying, and
+    ``_step_envs`` assigns INTO the same ``self._obs[i]`` row afterwards
+    — without the defensive copy every emitted window's obs silently
+    read the row's FUTURE value (wrong (s, a) pairs in replay)."""
+    from d4pg_tpu.config import D4PGConfig
+    from d4pg_tpu.fleet.actor import FleetActor
+    from d4pg_tpu.serve.bundle import actor_template, export_bundle
+
+    cfg = D4PGConfig(obs_dim=3, action_dim=1, hidden_sizes=(8, 8),
+                     n_step=NSTEP, gamma=GAMMA)
+    bundle = str(tmp_path / "b")
+    export_bundle(bundle, cfg, actor_template(cfg),
+                  meta={"generation": 0, "env": "Pendulum-v1"})
+    actor = FleetActor(
+        connect="127.0.0.1:1", bundle_dir=bundle, num_envs=1, seed=3,
+    )
+    try:
+        obs0 = actor._obs[0].copy()
+        for _ in range(NSTEP + 1):
+            actor._step_envs()
+        assert len(actor.spool) >= 1
+        _gen, cols = actor.spool.take_frame(1)
+        # the first window's obs is the episode's FIRST observation, not
+        # whatever the mutated row holds now
+        np.testing.assert_array_equal(cols["obs"][0], obs0)
+        assert not np.array_equal(actor._obs[0], obs0)
+    finally:
+        for env in actor.envs:
+            env.close()
+
+
+def _pendulum_bundle(tmp_path):
+    from d4pg_tpu.config import D4PGConfig
+    from d4pg_tpu.serve.bundle import actor_template, export_bundle
+
+    cfg = D4PGConfig(obs_dim=3, action_dim=1, hidden_sizes=(8, 8),
+                     n_step=NSTEP, gamma=GAMMA)
+    bundle = str(tmp_path / "bundle")
+    export_bundle(bundle, cfg, actor_template(cfg),
+                  meta={"generation": 0, "env": "Pendulum-v1"})
+    return bundle
+
+
+def test_actor_refuses_zero_envs(tmp_path):
+    """--num-envs 0 must be a clear argument error, not an opaque
+    np.stack ValueError from an empty reset list."""
+    from d4pg_tpu.fleet.actor import FleetActor
+
+    bundle = _pendulum_bundle(tmp_path)
+    with pytest.raises(ValueError, match="num-envs"):
+        FleetActor(connect="127.0.0.1:1", bundle_dir=bundle, num_envs=0)
+
+
+def test_actor_collects_while_disconnected_spool_drops_oldest(tmp_path):
+    """The documented disconnect contract: collection CONTINUES while the
+    server is unreachable — _ensure_link makes one non-blocking paced
+    attempt per call instead of sleeping through the whole Backoff budget
+    — and the bounded spool drops its oldest windows (counted in
+    windows_dropped_spool)."""
+    from d4pg_tpu.fleet.actor import FleetActor
+
+    bundle = _pendulum_bundle(tmp_path)
+    actor = FleetActor(
+        connect="127.0.0.1:1",  # nothing listens: ECONNREFUSED instantly
+        bundle_dir=bundle, num_envs=1, seed=5, batch_windows=4,
+        spool_limit=8, reconnect_attempts=50, connect_timeout_s=0.2,
+    )
+    try:
+        t0 = time.monotonic()
+        for _ in range(64):
+            actor._step_envs()
+            while len(actor.spool) >= actor.batch_windows:
+                if not actor._flush_once():
+                    break
+        # the old blocking _ensure_link slept minutes of Backoff here
+        assert time.monotonic() - t0 < 10.0
+        assert len(actor.spool) <= 8
+        s = actor.stats()
+        assert s["windows_dropped_spool"] > 0
+        assert s["env_steps"] == 64
+        assert s["windows_sent"] == 0
+    finally:
+        for env in actor.envs:
+            env.close()
+
+
+def test_actor_reconnect_budget_exhaustion_raises(tmp_path):
+    """Once the bounded retry budget is spent the actor fails loudly
+    (RuntimeError), never a silent forever-disconnected spin."""
+    from d4pg_tpu.fleet.actor import FleetActor
+
+    bundle = _pendulum_bundle(tmp_path)
+    actor = FleetActor(
+        connect="127.0.0.1:1", bundle_dir=bundle, num_envs=1, seed=5,
+        batch_windows=1, reconnect_attempts=0, connect_timeout_s=0.2,
+    )
+    try:
+        for _ in range(NSTEP + 1):  # emit at least one complete window
+            actor._step_envs()
+        assert len(actor.spool) >= 1
+        with pytest.raises(RuntimeError, match="bounded retries"):
+            actor._flush_once()
+    finally:
+        for env in actor.envs:
+            env.close()
+
+
+def test_drain_credit_wait_honors_deadline_when_stopping(tmp_path):
+    """Regression: on the drain path _stop is ALWAYS set (SIGTERM is the
+    normal trigger), so the credit wait must run to the drain deadline —
+    not give up at the first 0.5 s poll and abandon windows a slow-acking
+    but live server would still accept."""
+    from d4pg_tpu.fleet.actor import FleetActor
+
+    bundle = _pendulum_bundle(tmp_path)
+    lsock = socket.create_server(("127.0.0.1", 0))
+    port = lsock.getsockname()[1]
+    state = {"frames": 0}
+
+    def serve():
+        conn, _ = lsock.accept()
+        with conn:
+            frame = protocol.read_frame(conn)  # HELLO
+            protocol.write_frame(
+                conn, protocol.HELLO_OK, frame[1],
+                wire.encode_hello_ok(
+                    generation=0, max_windows=1, max_inflight=1
+                ),
+            )
+            for _ in range(2):
+                t, rid, payload = protocol.read_frame(conn)
+                assert t == protocol.WINDOWS
+                state["frames"] += 1
+                if state["frames"] == 1:
+                    time.sleep(1.2)  # ack withheld past two credit polls
+                protocol.write_frame(
+                    conn, protocol.WINDOWS_OK, rid,
+                    wire.encode_windows_ok(1),
+                )
+
+    threading.Thread(target=serve, name="slow-ack-ingest",
+                     daemon=True).start()
+    actor = FleetActor(
+        connect=f"127.0.0.1:{port}", bundle_dir=bundle, num_envs=1,
+        batch_windows=1, connect_timeout_s=5.0,
+    )
+    try:
+        assert actor._ensure_link()
+        obs = np.zeros(3, np.float32)
+        act = np.zeros(1, np.float32)
+        actor.spool.add(obs, act, 0.0, obs, 1.0)
+        actor.spool.add(obs, act, 0.0, obs, 1.0)
+        actor._stop.set()  # SIGTERM arrived: this IS the drain state
+        deadline = time.monotonic() + 5.0
+        assert actor._flush_once(deadline=deadline)  # takes the only credit
+        # the second flush must WAIT ~1.2 s for the withheld ack's credit
+        assert actor._flush_once(deadline=deadline)
+        assert len(actor.spool) == 0
+        assert _wait(lambda: state["frames"] == 2)
+    finally:
+        if actor._link is not None:
+            actor._link.close()
+        for env in actor.envs:
+            env.close()
+        lsock.close()
+
+
+def test_mixed_mode_dead_ingest_thread_fails_loudly(tmp_path):
+    """--fleet-listen alongside local collection: no pacing loop consults
+    the ingest server, so a dead writer/accept thread must surface at the
+    _periodic scrape — not shed every actor frame forever in silence."""
+    from d4pg_tpu.config import D4PGConfig, TrainConfig
+    from d4pg_tpu.runtime.trainer import Trainer
+
+    t = Trainer(TrainConfig(
+        env="pendulum", total_steps=2, warmup_steps=8, batch_size=8,
+        num_envs=2, eval_interval=1000, checkpoint_interval=1000,
+        log_dir=str(tmp_path), fleet_listen=0,
+        agent=D4PGConfig(hidden_sizes=(16, 16)),
+    ))
+    try:
+        t._fleet._thread_error = RuntimeError("boom")
+        with pytest.raises(RuntimeError, match="ingest thread died"):
+            t.train()
+    finally:
+        t.close()
+
+
+def test_fleet_stall_heartbeat_warns(tmp_path, capsys):
+    """All remote actors dead = the fleet-only pacing loop waits by design
+    (the learner outlives actor churn), but it must say so: a stalled
+    ingest logs a heartbeat with the live connection count instead of
+    starving in silence."""
+    from d4pg_tpu.config import D4PGConfig, TrainConfig
+    from d4pg_tpu.runtime.trainer import Trainer
+
+    t = Trainer(TrainConfig(
+        env="pendulum", total_steps=2, num_envs=0, fleet_listen=0,
+        log_dir=str(tmp_path), agent=D4PGConfig(hidden_sizes=(16, 16)),
+    ))
+    try:
+        t._fleet_stall_check()  # records the zero-ingested baseline
+        t._fleet_stall_check()  # no progress, but the interval hasn't run
+        assert "no windows ingested" not in capsys.readouterr().out
+        t._fleet_stall_t -= 31.0
+        t._fleet_stall_check()
+        out = capsys.readouterr().out
+        assert "no windows ingested" in out and "0 live actor" in out
+    finally:
+        t.close()
+
+
+def test_fleet_bundle_without_listen_refused(tmp_path):
+    """--fleet-bundle publishes at ingest generation bumps; without
+    --fleet-listen it would be silently ignored — refused instead."""
+    from d4pg_tpu.config import D4PGConfig, TrainConfig
+    from d4pg_tpu.runtime.trainer import Trainer
+
+    with pytest.raises(ValueError, match="fleet-bundle"):
+        Trainer(TrainConfig(
+            env="pendulum", total_steps=4, num_envs=2,
+            fleet_bundle=str(tmp_path / "bundle"), log_dir=str(tmp_path),
+            agent=D4PGConfig(hidden_sizes=(16, 16)),
+        ))
+
+
+def test_fleet_only_refuses_async_collect(tmp_path):
+    """--async-collect with --num-envs 0 would deadlock the steady-state
+    pacing loop (no collector thread exists) — refused at construction."""
+    from d4pg_tpu.config import D4PGConfig, TrainConfig
+    from d4pg_tpu.runtime.trainer import Trainer
+
+    with pytest.raises(ValueError, match="async-collect"):
+        Trainer(TrainConfig(
+            env="pendulum", total_steps=4, num_envs=0, fleet_listen=0,
+            async_collect=True, log_dir=str(tmp_path),
+            agent=D4PGConfig(hidden_sizes=(16, 16)),
+        ))
+
+
+def test_fleet_generation_survives_resume(tmp_path):
+    """Regression: the published-bundle generation persists in
+    trainer_meta.json and restores on --resume — restarting at 0 would
+    regress below generations connected actors already hold, disarming
+    the stale-window drop at ingest until the counter caught back up."""
+    from d4pg_tpu.config import D4PGConfig, TrainConfig
+    from d4pg_tpu.runtime.trainer import Trainer
+
+    def cfg(**kw):
+        return TrainConfig(
+            env="pendulum", total_steps=4, warmup_steps=32, batch_size=16,
+            num_envs=2, eval_interval=1000, checkpoint_interval=4,
+            log_dir=str(tmp_path), fleet_listen=0,
+            fleet_bundle=str(tmp_path / "bundle"), fleet_publish_interval=2,
+            agent=D4PGConfig(hidden_sizes=(16, 16)),
+            **kw,
+        )
+
+    t = Trainer(cfg())
+    try:
+        t.train()  # publish interval 2 -> generation bumped past 0
+        gen = t._fleet_gen
+        assert gen >= 1
+    finally:
+        t.close()
+    r = Trainer(cfg(resume=True))
+    try:
+        assert r._fleet_gen == gen
+        assert r._fleet.generation == gen  # pushed into ingest at publish
+    finally:
+        r.close()
+
+
+# -------------------------------------------------------------- fleet link
+def test_link_death_sweeps_pending_as_dropped():
+    """Unacked frames at disconnect are counted dropped exactly once and
+    never resent — the at-most-once reconnect contract. A hand-rolled
+    server handshakes, reads one WINDOWS frame, and never acks it."""
+    lsock = socket.create_server(("127.0.0.1", 0))
+    port = lsock.getsockname()[1]
+    state = {}
+
+    def serve():
+        conn, _ = lsock.accept()
+        state["conn"] = conn
+        frame = protocol.read_frame(conn)  # HELLO
+        protocol.write_frame(
+            conn, protocol.HELLO_OK, frame[1],
+            wire.encode_hello_ok(generation=0, max_windows=64, max_inflight=4),
+        )
+        protocol.read_frame(conn)  # the WINDOWS frame — swallowed, no ack
+        state["got"] = True
+
+    threading.Thread(target=serve, name="fake-ingest", daemon=True).start()
+    acks = {"accepted": 0, "stale": 0, "shed": 0, "dropped": 0}
+    lock = threading.Lock()
+
+    def on_ack(kind, n):
+        with lock:
+            acks[kind] += n
+
+    link = FleetLink(
+        "127.0.0.1", port,
+        dict(actor_id="d", env="e", obs_dim=OBS, action_dim=ACT,
+             n_step=NSTEP, gamma=GAMMA, generation=0),
+        on_ack=on_ack,
+    )
+    try:
+        assert link.acquire_credit(5)
+        link.send_windows(0, _frame_cols(3))
+        assert link.inflight() == 1
+        assert _wait(lambda: state.get("got"))
+        state["conn"].close()  # server dies with the frame unacked
+        assert _wait(lambda: link.dead is not None)
+        with lock:
+            assert acks == {"accepted": 0, "stale": 0, "shed": 0,
+                            "dropped": 3}, acks
+        assert link.inflight() == 0  # swept exactly once
+    finally:
+        link.close()
+        lsock.close()
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v"]))

@@ -1,0 +1,41 @@
+"""Tier-1 fleet smoke: the 2-process collection fleet through the real
+CLIs (``scripts/fleet_smoke.sh``) — train.py --fleet-listen with NO local
+collection, a remote actor host streaming real windows, a bundle
+hot-swap mid-run, and a SIGTERM drain with every emitted window
+accounted for.
+
+This is THE end-to-end smoke for the fleet subsystem (conftest fast-tier
+policy): everything else fleet-related tests layers in-process
+(``tests/test_fleet.py``); only this one proves the shipped commands
+compose.
+"""
+
+import os
+import subprocess
+import sys
+
+from conftest import clean_cpu_env
+
+
+def test_fleet_smoke_script(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = clean_cpu_env()
+    env["FLEET_SMOKE_DIR"] = str(tmp_path / "run")
+    p = subprocess.run(
+        ["bash", os.path.join(repo, "scripts", "fleet_smoke.sh")],
+        capture_output=True,
+        text=True,
+        timeout=840,
+        env=env,
+        cwd=repo,
+    )
+    out = p.stdout + p.stderr
+    assert p.returncode == 0, out[-4000:]
+    assert "FLEET_SMOKE_COUNTERS_OK" in p.stdout, out[-4000:]
+    assert "FLEET_SMOKE_OK" in p.stdout, out[-4000:]
+    # the published bundle is a real directory artifact the actor swapped
+    assert os.path.exists(str(tmp_path / "run" / "bundle" / "bundle.json"))
+
+
+if __name__ == "__main__":
+    sys.exit(0)

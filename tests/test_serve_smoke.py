@@ -11,22 +11,12 @@ import os
 import subprocess
 import sys
 
-
-def _clean_cpu_env():
-    env = {
-        k: v
-        for k, v in os.environ.items()
-        if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
-        and "AXON" not in k
-        and "TPU" not in k
-    }
-    env["JAX_PLATFORMS"] = "cpu"
-    return env
+from conftest import clean_cpu_env
 
 
 def test_serve_smoke_script(tmp_path):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = _clean_cpu_env()
+    env = clean_cpu_env()
     env["SERVE_SMOKE_DIR"] = str(tmp_path / "run")
     p = subprocess.run(
         ["bash", os.path.join(repo, "scripts", "serve_smoke.sh")],

@@ -49,9 +49,27 @@ HOST_ONLY_MODULES = (
     "d4pg_tpu/runtime/__init__.py",
     "d4pg_tpu/runtime/actor_pool.py",
     "d4pg_tpu/runtime/metrics.py",
+    "d4pg_tpu/serve/__init__.py",
     "d4pg_tpu/serve/protocol.py",
     "d4pg_tpu/serve/client.py",
     "d4pg_tpu/serve/stats.py",
+    # The collection fleet: actor hosts run env + a NumPy policy and must
+    # never pull the JAX runtime (the whole point of the numpy-policy
+    # contract); the ingest server is constructed by the trainer before
+    # any backend decision and imported by device-free tests.
+    "d4pg_tpu/fleet/__init__.py",
+    "d4pg_tpu/fleet/wire.py",
+    "d4pg_tpu/fleet/policy.py",
+    "d4pg_tpu/fleet/ingest.py",
+    "d4pg_tpu/fleet/actor.py",
+    # The fleet actor's n-step collapse reuses the replay writers, so the
+    # whole (numpy-only) replay package must stay JAX-free at import.
+    "d4pg_tpu/replay/__init__.py",
+    "d4pg_tpu/replay/uniform.py",
+    "d4pg_tpu/replay/nstep_writer.py",
+    # utils/__init__ must stay lazy: an eager profiling import there would
+    # drag JAX into every utils.retry / utils.signals importer (fleet hosts).
+    "d4pg_tpu/utils/__init__.py",
     "d4pg_tpu/utils/signals.py",
     "d4pg_tpu/utils/retry.py",
     "d4pg_tpu/chaos.py",

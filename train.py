@@ -198,6 +198,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "python -m d4pg_tpu.serve, then exit")
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace of grad steps 10-60 here")
+    # networked collection fleet (d4pg_tpu/fleet, docs/fleet.md)
+    p.add_argument("--fleet-listen", type=int, default=None, metavar="PORT",
+                   help="run the experience-ingest server on PORT (0 = "
+                        "ephemeral, printed at startup): remote actor hosts "
+                        "(python -m d4pg_tpu.fleet.actor) stream n-step "
+                        "windows into replay — alongside local collection, "
+                        "or instead of it with --num-envs 0")
+    p.add_argument("--fleet-host", default="0.0.0.0", metavar="ADDR",
+                   help="ingest bind address (default 0.0.0.0 so remote "
+                        "actor hosts can reach it; 127.0.0.1 = loopback-"
+                        "only fleet)")
+    p.add_argument("--fleet-bundle", default=None, metavar="DIR",
+                   help="publish the acting bundle here for fleet actors "
+                        "(atomic re-export every --fleet-publish-interval "
+                        "grad steps, bumping the bundle generation; actors "
+                        "hot-swap on the bundle.json mtime)")
+    p.add_argument("--fleet-publish-interval", type=int, default=200,
+                   help="grad steps between fleet bundle publications")
     p.add_argument("--chaos", default=None, metavar="PLAN",
                    help="deterministic fault injection (d4pg_tpu/chaos.py): "
                         "';'-separated site@count[:arg][#actor] entries, "
@@ -317,6 +335,10 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         resume=args.resume,
         snapshot_replay=args.snapshot_replay,
         profile_dir=args.profile_dir,
+        fleet_listen=args.fleet_listen,
+        fleet_host=args.fleet_host,
+        fleet_bundle=args.fleet_bundle,
+        fleet_publish_interval=args.fleet_publish_interval,
         debug_guards=args.debug_guards,
         chaos=args.chaos,
         pool_step_timeout_s=args.pool_step_timeout_s,
@@ -505,7 +527,18 @@ def main(argv=None) -> None:
             cfg, log_dir=os.path.join(cfg.log_dir, f"worker{info['process_index']}")
         )
     print(f"config: {cfg}")
+    if args.num_envs == 0 and args.fleet_listen is None:
+        raise SystemExit(
+            "--num-envs 0 means no local collection at all; it requires "
+            "--fleet-listen so remote actor hosts supply the experience"
+        )
     if args.on_device:
+        if args.fleet_listen is not None:
+            raise SystemExit(
+                "--fleet-listen feeds the HOST replay buffer; --on-device "
+                "keeps replay inside one XLA program (the flag would be "
+                "silently ignored)"
+            )
         if args.transfer_dtype != "float32":
             raise SystemExit(
                 "--transfer-dtype is a HOST-path link optimization; "
