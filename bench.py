@@ -906,6 +906,256 @@ def bench_serve(
     }
 
 
+def kill_policy_server_abruptly(server) -> None:
+    """Simulate SIGKILL on an in-process :class:`PolicyServer`: abortive-
+    close the listener and every live connection (peers see an RST —
+    exactly a killed process's teardown as observed from the wire), no
+    drain, nothing answered. Used by the router availability bench and the
+    in-process router fault tests; the REAL ``kill -9`` path runs through
+    subprocess replicas in scripts/router_smoke.sh and chaos_soak.sh."""
+    from d4pg_tpu.serve import protocol as _sp
+
+    server._shutdown.set()
+    try:
+        server._listen_sock.close()
+    except OSError:
+        pass
+    with server._conns_lock:
+        conns = list(server._conns)
+    for c in conns:
+        _sp.abortive_close(c)
+    server.batcher.stop(drain=False, timeout=5)
+
+
+def bench_serve_router(
+    *,
+    obs_dim: int = OBS_DIM,
+    act_dim: int = ACT_DIM,
+    hidden: int = 64,
+    max_batch: int = 16,
+    max_wait_us: int = 2000,
+    queue_limit: int | None = None,
+    conns: int = 4,
+    window: int = 16,
+    duration_s: float = 2.0,
+    kill_at_frac: float = 0.4,
+    infer_delay_ms: float = 50.0,
+    seed: int = 0,
+) -> dict:
+    """Closed-loop load through the replica front-end (``serve/router.py``).
+
+    Two measurements, chip-independent by the bench_serve argument (the
+    router adds pure host work on top of an already-host-dominated path):
+
+    - **scaling** — the same closed population against a 1-replica fleet
+      and a 2-replica fleet: aggregate throughput and p99. Replica
+      capacity is pinned by a labeled ``infer_delay_ms`` slow-device stub
+      (same device-bound-regime trick as the serve_microbench overload
+      scenario): on a few-core bench host the real tiny-MLP batcher is
+      HOST-bound, so a second in-process replica just contends for the
+      same cores and the ratio measures GIL thrash, not dispatch. With
+      per-replica capacity device-bound — the regime the committed
+      serve_microbench shows a real device thread is in at saturation —
+      the 1→2 replica ratio measures what the router actually adds.
+    - **availability** — sustained closed-loop load on the 2-replica fleet
+      while one replica is killed abruptly mid-stream. Reported: the
+      accounting identity (submitted == ok + overloaded + failed — zero
+      silent losses), availability (ok/submitted), router retries and
+      ejections, and the latency percentiles THROUGH the failure.
+    """
+    import threading
+
+    from d4pg_tpu.agent.state import D4PGConfig
+    from d4pg_tpu.models.critic import DistConfig
+    from d4pg_tpu.serve import PolicyBundle, PolicyClient, PolicyServer, Router
+    from d4pg_tpu.serve.bundle import actor_template
+    from d4pg_tpu.serve.client import ConnectionClosed, Overloaded
+
+    config = D4PGConfig(
+        obs_dim=obs_dim,
+        action_dim=act_dim,
+        hidden_sizes=(hidden, hidden, hidden),
+        dist=DistConfig(kind="categorical", num_atoms=ATOMS, v_min=V_MIN, v_max=V_MAX),
+    )
+    bundle = PolicyBundle(
+        config=config,
+        actor_params=actor_template(config),
+        action_low=np.full(act_dim, -1.0, np.float32),
+        action_high=np.full(act_dim, 1.0, np.float32),
+        obs_norm=None,
+        meta={"source": "bench_serve_router"},
+    )
+    rng = np.random.default_rng(seed)
+    obs = rng.normal(size=obs_dim).astype(np.float32)
+
+    def pct(lat):
+        if not lat:
+            return {"p50_ms": None, "p95_ms": None, "p99_ms": None}
+        v = np.percentile(np.asarray(lat), (50, 95, 99))
+        return {f"p{q}_ms": round(float(x) * 1e3, 4) for q, x in zip((50, 95, 99), v)}
+
+    def start_fleet(m: int):
+        servers = [
+            PolicyServer(
+                bundle,
+                port=0,
+                max_batch=max_batch,
+                max_wait_us=max_wait_us,
+                queue_limit=queue_limit or 8 * max_batch,
+                watch_bundle=False,
+            )
+            for _ in range(m)
+        ]
+        for s in servers:
+            s.start()
+            if infer_delay_ms:
+                # Slow-device stub (see docstring): pins per-replica
+                # capacity to the device thread so the 1-vs-2 comparison
+                # measures dispatch, not host contention. sleep() releases
+                # the GIL, unlike the real tiny-MLP CPU forward.
+                real_infer = s.batcher._infer
+
+                def slow_infer(params, obs_batch, _real=real_infer):
+                    time.sleep(infer_delay_ms / 1e3)
+                    return _real(params, obs_batch)
+
+                s.batcher._infer = slow_infer
+        router = Router(
+            [("127.0.0.1", s.port) for s in servers],
+            port=0,
+            probe_interval_s=0.1,
+            probe_timeout_s=1.0,
+            readmit_after=1,
+            retry_seed=seed,
+        )
+        router.start()
+        router.wait_for_replicas(m, timeout_s=60)
+        return servers, router
+
+    def closed_loop(port: int, on_start=None) -> dict:
+        """``conns`` pipelined connections × ``window`` in flight each;
+        every completion (ok, shed, OR failed) immediately triggers the
+        next send, so the outcome counts tally the full identity."""
+        counts = {"submitted": 0, "ok": 0, "overloaded": 0, "error": 0}
+        lats: list[float] = []
+        lock = threading.Lock()
+        stop = threading.Event()
+        clients = [PolicyClient("127.0.0.1", port) for _ in range(conns)]
+        idle = threading.Semaphore(0)  # released once per drained chain
+
+        def send_next(c):
+            t0 = time.perf_counter()
+            with lock:
+                counts["submitted"] += 1
+            fut = c.act_async(obs)
+
+            def done(f, t0=t0, c=c):
+                exc = f.exception()
+                with lock:
+                    if exc is None:
+                        counts["ok"] += 1
+                        lats.append(time.perf_counter() - t0)
+                    elif isinstance(exc, Overloaded):
+                        counts["overloaded"] += 1
+                    else:
+                        counts["error"] += 1
+                if stop.is_set() or isinstance(exc, ConnectionClosed):
+                    idle.release()
+                else:
+                    send_next(c)
+
+            fut.add_done_callback(done)
+
+        t_start = time.perf_counter()
+        for c in clients:
+            for _ in range(window):
+                send_next(c)
+        if on_start is not None:
+            on_start()
+        time.sleep(duration_s)
+        stop.set()
+        for _ in range(conns * window):
+            idle.acquire(timeout=30)
+        dt = time.perf_counter() - t_start
+        for c in clients:
+            c.close()
+        answered = counts["ok"] + counts["overloaded"] + counts["error"]
+        return {
+            "conns": conns,
+            "window": window,
+            "duration_s": round(dt, 3),
+            "throughput_rps": round(counts["ok"] / dt, 2),
+            **counts,
+            "answered": answered,
+            "lost": counts["submitted"] - answered,
+            "identity_ok": answered == counts["submitted"],
+            "availability": round(counts["ok"] / counts["submitted"], 6)
+            if counts["submitted"]
+            else None,
+            **pct(lats),
+        }
+
+    out: dict = {
+        "config": {
+            "obs_dim": obs_dim,
+            "act_dim": act_dim,
+            "hidden": hidden,
+            "max_batch": max_batch,
+            "max_wait_us": max_wait_us,
+            "conns": conns,
+            "window": window,
+            "duration_s": duration_s,
+            "infer_delay_ms": infer_delay_ms,
+            "queue_limit": queue_limit or 8 * max_batch,
+        },
+        "scaling": [],
+    }
+    # ---- scaling: 1 replica ------------------------------------------------
+    servers, router = start_fleet(1)
+    try:
+        row = closed_loop(router.port)
+        row["replicas"] = 1
+        out["scaling"].append(row)
+    finally:
+        router.drain()
+        for s in servers:
+            s.drain()
+    # ---- scaling: 2 replicas, then availability on the same fleet ----------
+    servers, router = start_fleet(2)
+    killed = []
+    try:
+        row = closed_loop(router.port)
+        row["replicas"] = 2
+        out["scaling"].append(row)
+
+        def kill_one():
+            def killer():
+                time.sleep(kill_at_frac * duration_s)
+                kill_policy_server_abruptly(servers[0])
+                killed.append(servers[0])
+
+            threading.Thread(
+                target=killer, name="bench-replica-killer", daemon=True
+            ).start()
+
+        avail = closed_loop(router.port, on_start=kill_one)
+        avail["replicas"] = 2
+        avail["kill_at_s"] = round(kill_at_frac * duration_s, 3)
+        health = router.healthz()
+        avail["router_retries"] = health["retries"]
+        avail["router_ejections"] = health["ejections"]
+        out["availability"] = avail
+    finally:
+        router.drain()
+        for s in servers:
+            if s not in killed:
+                s.drain()
+    r1 = out["scaling"][0]["throughput_rps"]
+    r2 = out["scaling"][1]["throughput_rps"]
+    out["scaling_2_over_1"] = round(r2 / r1, 3) if r1 else None
+    return out
+
+
 def bench_torch_cpu_baseline() -> float:
     """Reference-style D4PG step: CPU torch nets + host NumPy projection."""
     import torch
@@ -1053,6 +1303,15 @@ def main(argv=None) -> None:
         "backend, print ONE JSON line, and exit; the committed "
         "chip-independent artifact is benchmarks/serve_microbench.json",
     )
+    ap.add_argument(
+        "--serve-router",
+        action="store_true",
+        help="run the replica front-end load generator (bench_serve_router: "
+        "aggregate throughput + p99 across 1 vs 2 in-process replicas, and "
+        "availability/accounting identity during an abrupt replica kill), "
+        "print ONE JSON line, and exit; the committed chip-independent "
+        "artifact is benchmarks/router_microbench.json",
+    )
     args = ap.parse_args(argv)
     # Hermetic gate: the driver must get ONE parseable JSON line even when
     # the TPU tunnel is wedged (raises, hangs, or silently downgrades to
@@ -1135,6 +1394,14 @@ def main(argv=None) -> None:
     if args.serve:
         out = bench_serve()
         out["metric"] = "serve_loadgen"
+        import jax
+
+        out["backend"] = jax.default_backend()
+        print(json.dumps(out))
+        return
+    if args.serve_router:
+        out = bench_serve_router()
+        out["metric"] = "serve_router_loadgen"
         import jax
 
         out["backend"] = jax.default_backend()

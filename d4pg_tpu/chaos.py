@@ -22,6 +22,10 @@ Spec syntax (entries separated by ``;`` or ``,``)::
     reconnect_flap@2      fleet actor: drop its 2nd connection post-HELLO
     stale_bundle@1        fleet actor: skip its 1st bundle hot-swap
     slow_link@3:250       fleet actor: stall its 3rd frame send 250 ms
+    replica_kill@25       router: SIGKILL the replica serving dispatch 25
+    replica_slow@9:200    router: stall dispatch 9 for 200 ms
+    canary_corrupt@1      router: truncate the params of its 1st canary
+                          deploy (replica load fails, healthz degrades)
 
 ``count`` is 1-based and counted *at the site* (a worker counts its own
 env steps; the pool counts pool steps; the flusher counts wakes), which
@@ -54,6 +58,16 @@ site                  tick location               recovery proven
 ``slow_link``         fleet actor, per frame      flow control absorbs the
                                                   stall; read deadline
                                                   tolerates live-but-slow
+``replica_kill``      router, per dispatch        in-flight request fails
+                                                  over (bounded retry on a
+                                                  different replica);
+                                                  prober ejects, re-admits
+                                                  the restarted process
+``replica_slow``      router, per dispatch        p99 accounted; other
+                                                  requests unaffected
+``canary_corrupt``    router, per canary deploy   replica keeps old params
+                                                  (degraded), router
+                                                  auto-rolls-back
 ====================  ==========================  =========================
 """
 
@@ -81,6 +95,13 @@ KNOWN_SITES = WORKER_SITES + (
     "reconnect_flap",
     "stale_bundle",
     "slow_link",
+    # serving-fleet sites (d4pg_tpu/serve/router.py): all three tick in
+    # the ROUTER process (--chaos on python -m d4pg_tpu.serve.router) —
+    # replica_kill/replica_slow per dispatched request, canary_corrupt
+    # per canary bundle deploy.
+    "replica_kill",
+    "replica_slow",
+    "canary_corrupt",
 )
 
 

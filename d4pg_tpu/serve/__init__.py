@@ -13,9 +13,14 @@ Barth-Maron et al. 2018 §deployment):
   checkpoint hot-reload, graceful drain, healthz;
 - :mod:`~d4pg_tpu.serve.client`   — blocking + pipelined client;
 - :mod:`~d4pg_tpu.serve.protocol` — the length-prefixed binary frames;
-- :mod:`~d4pg_tpu.serve.stats`    — p50/p95/p99, batch/queue histograms.
+- :mod:`~d4pg_tpu.serve.stats`    — p50/p95/p99, batch/queue histograms;
+- :mod:`~d4pg_tpu.serve.router`   — replicated front-end: least-loaded
+  dispatch across M replicas, health-driven ejection/re-admission,
+  rolling canary rollout with auto-rollback (JAX-free, host-only).
 
-Run it: ``python -m d4pg_tpu.serve --bundle <dir>`` (docs/serving.md).
+Run it: ``python -m d4pg_tpu.serve --bundle <dir>`` (one replica) and
+``python -m d4pg_tpu.serve.router --backends host:port,...`` (the fleet
+front-end) — docs/serving.md.
 
 Lazy re-exports (the `_lazy.py` contract): the protocol, client, and
 stats submodules are host-only — thin clients and the JAX-free fleet
@@ -38,6 +43,7 @@ _EXPORTS = {
     "PolicyClient": "d4pg_tpu.serve.client",
     "ServerError": "d4pg_tpu.serve.client",
     "PolicyServer": "d4pg_tpu.serve.server",
+    "Router": "d4pg_tpu.serve.router",
 }
 
 __getattr__, __dir__ = lazy_exports(__name__, _EXPORTS)

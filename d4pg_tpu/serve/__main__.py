@@ -45,6 +45,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--log-dir", default=None,
                    help="append serve metrics rows (metrics.jsonl) here")
     p.add_argument("--metrics-interval", type=float, default=30.0)
+    p.add_argument("--replica-id", type=int, default=None,
+                   help="fleet identity: stamped into healthz and every "
+                        "metrics.jsonl row so multi-replica soak logs are "
+                        "attributable per process")
     p.add_argument("--chaos", default=None, metavar="PLAN",
                    help="deterministic fault injection (d4pg_tpu/chaos.py): "
                         "e.g. 'sock_reset@5' force-resets the serving "
@@ -85,6 +89,7 @@ def main(argv=None) -> None:
         metrics_interval_s=args.metrics_interval,
         debug_guards=args.debug_guards,
         chaos=chaos,
+        replica_id=args.replica_id,
     )
 
     install_graceful_signals(
@@ -93,8 +98,9 @@ def main(argv=None) -> None:
     )
 
     server.start()
+    rid = f"replica_id={args.replica_id} " if args.replica_id is not None else ""
     print(
-        f"[serve] listening on {server.host}:{server.port} "
+        f"[serve] listening on {server.host}:{server.port} {rid}"
         f"obs_dim={bundle.obs_dim} action_dim={bundle.action_dim} "
         f"buckets={list(server.batcher.buckets)} "
         f"source={bundle.meta.get('source', '?')}",

@@ -419,9 +419,19 @@ class DynamicBatcher:
                 self.stats.inc("shed_queue_full")
                 raise ShedError("queue_full")
             self._queue.append(req)
+            self.stats.inc("inflight")
             self.stats.queue_hist.add(len(self._queue))
             self._cond.notify()
+        # Outside the lock: the callback may fire inline if the device
+        # thread already resolved the future, and it takes the stats lock.
+        # add_done_callback fires exactly once on EVERY resolution path
+        # (reply, shed, device/reply-thread death sweep, cancel), which is
+        # what makes the gauge trustworthy as a dispatch-weight signal.
+        req.future.add_done_callback(self._dec_inflight)
         return req.future
+
+    def _dec_inflight(self, _fut) -> None:
+        self.stats.inc("inflight", -1)
 
     def _shed(self, req: _Request, reason: str) -> None:
         if reason == "deadline":

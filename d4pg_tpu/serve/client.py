@@ -6,10 +6,24 @@ dedicated reader thread. The pipelined form is what the open-loop load
 generator (``bench.py bench_serve``) is built on: an open-loop arrival
 process must keep issuing at its offered rate regardless of reply latency,
 which a blocking call cannot do.
+
+Bounded retry (``retries=``, OFF by default): ``act`` re-attempts on
+:class:`Overloaded` / :class:`ConnectionClosed` under a seeded jittered
+:class:`~d4pg_tpu.utils.retry.Backoff`, transparently re-dialing a dead
+link between attempts. Off by default on purpose — a shed is an explicit
+server signal and most callers (the load generators, the shed-rate tests)
+must SEE it, not have it retried away. The retry path serializes
+reconnects behind a lock but is meant for blocking single-caller use;
+``act_async`` never retries (a pipelined caller owns its own policy).
+The replica front-end (``serve/router.py``) keeps its dispatch links at
+``retries=0`` — its recovery is failover to a DIFFERENT replica, not a
+hammer on the same one — and implements that failover with the same
+``Backoff`` budget.
 """
 
 from __future__ import annotations
 
+import random
 import socket
 import threading
 from concurrent.futures import Future
@@ -19,6 +33,7 @@ import numpy as np
 
 from d4pg_tpu.serve import protocol
 from d4pg_tpu.serve.protocol import ProtocolError
+from d4pg_tpu.utils.retry import Backoff
 
 
 class Overloaded(RuntimeError):
@@ -44,8 +59,39 @@ class PolicyClient:
     # mark-dead-then-sweep ordering note in _read_loop)
     _THREAD_SAFE = ("_dead",)
 
-    def __init__(self, host: str, port: int, timeout: float = 30.0):
-        self._sock = socket.create_connection((host, port), timeout=timeout)
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        timeout: float = 30.0,
+        *,
+        retries: int = 0,
+        retry_seed: Optional[int] = None,
+    ):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        # Opt-in bounded retry for act(): attempts beyond the first on
+        # Overloaded/ConnectionClosed, paced by a seeded Backoff (jitter
+        # must not synchronize a retrying fleet; seeding keeps chaos runs
+        # deterministic). 0 = historical fast-fail semantics.
+        self._retries = int(retries)
+        self._retry_rng = random.Random(retry_seed)
+        # Serializes _reconnect against concurrent act() retries; never
+        # held while blocking on a reply (only during dial/teardown).
+        self._conn_lock = threading.Lock()
+        self._send_lock = threading.Lock()
+        self._pending: dict[int, Future] = {}
+        self._pending_lock = threading.Lock()
+        self._next_id = 0
+        self._closed = False
+        self._connect()
+
+    def _connect(self) -> None:
+        """Dial and arm a fresh link (init + the retry path's re-dial)."""
+        self._sock = socket.create_connection(
+            (self.host, self.port), timeout=self.timeout
+        )
         # ``timeout`` governs CONNECT and the default future wait in act();
         # the socket itself must block indefinitely — the reader thread sits
         # in read() between replies, and a socket timeout there would kill
@@ -56,12 +102,8 @@ class PolicyClient:
         # Buffered read side (same rationale as the server): one kernel
         # read per burst of pipelined replies, not per frame piece.
         self._rfile = self._sock.makefile("rb")
-        self.timeout = timeout
-        self._send_lock = threading.Lock()
-        self._pending: dict[int, Future] = {}
-        self._pending_lock = threading.Lock()
-        self._next_id = 0
-        self._closed = False
+        with self._pending_lock:
+            self._pending = {}
         # Terminal error once the reader exits: without it, a request
         # issued AFTER the reader died would register a future nobody can
         # ever resolve (the send usually still succeeds into the kernel
@@ -72,6 +114,35 @@ class PolicyClient:
             target=self._read_loop, name="policy-client-reader", daemon=True
         )
         self._reader.start()
+
+    def _reconnect(self) -> None:
+        """Tear down a dead link and dial a new one (retry path only).
+        The old reader is joined BEFORE the new link arms so its death
+        sweep (which writes ``_dead``) can never clobber the fresh link's
+        state; pending futures of the old link were already failed by
+        that sweep."""
+        with self._conn_lock:
+            if self._closed:
+                # close() is final: the retry path must not resurrect a
+                # closed client with a fresh socket + reader thread the
+                # owner will never tear down
+                raise ConnectionClosed("client closed")
+            if self._dead is None:
+                return  # another retrying caller already re-dialed
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            # Bounded join under a lock only retrying act() callers ever
+            # take (never the reader or any hot path); the old reader MUST
+            # be dead before the new link arms, or its death sweep would
+            # clobber the fresh link's _dead/_pending.
+            self._reader.join(timeout=5)  # d4pglint: disable=lock-blocking-call -- see above: reconnect-only lock, bounded join ordering requirement
+            try:
+                self._rfile.close()
+            except OSError:
+                pass
+            self._connect()
 
     # ------------------------------------------------------------------ plumbing
     def _register(self) -> tuple[int, Future]:
@@ -165,10 +236,32 @@ class PolicyClient:
         deadline_ms: Optional[float] = None,
         timeout: Optional[float] = None,
     ) -> np.ndarray:
-        """One action, blocking. Raises :class:`Overloaded` when shed."""
-        return self.act_async(obs, deadline_ms).result(
-            timeout if timeout is not None else self.timeout
+        """One action, blocking. Raises :class:`Overloaded` when shed
+        (after the bounded ``retries=`` budget, when one was configured —
+        a dead link is re-dialed between attempts)."""
+        timeout = timeout if timeout is not None else self.timeout
+        if not self._retries:
+            return self.act_async(obs, deadline_ms).result(timeout)
+        last: Optional[Exception] = None
+        backoff = Backoff(
+            base_s=0.05,
+            max_s=2.0,
+            max_attempts=self._retries,
+            rng=self._retry_rng,
         )
+        for _attempt in backoff:
+            if self._dead is not None:
+                try:
+                    self._reconnect()
+                except OSError as e:
+                    last = ConnectionClosed(f"reconnect failed: {e}")
+                    continue
+            try:
+                return self.act_async(obs, deadline_ms).result(timeout)
+            except (Overloaded, ConnectionClosed) as e:
+                last = e  # bounded: the Backoff iterator sleeps, then stops
+        assert last is not None
+        raise last
 
     def healthz(self, timeout: Optional[float] = None) -> dict:
         import json

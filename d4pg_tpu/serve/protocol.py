@@ -103,6 +103,31 @@ def abortive_close(sock) -> None:
         pass
 
 
+def probe_healthz(host: str, port: int, timeout_s: float = 2.0) -> dict:
+    """One-shot healthz probe: connect, ask, decode, close.
+
+    Deliberately NOT a :class:`~d4pg_tpu.serve.client.PolicyClient`: the
+    prober in the replica front-end (``serve/router.py``) runs this on a
+    timer against possibly-dead backends — a persistent pipelined client
+    would hide exactly the connect-failure signal ejection keys on, and
+    a probe must never outlive its timeout (``settimeout`` bounds every
+    recv). Raises ``OSError`` (connect/timeout) or :class:`ProtocolError`
+    (malformed reply) — the caller maps both to "unhealthy"."""
+    import json
+    import socket
+
+    with socket.create_connection((host, port), timeout=timeout_s) as s:
+        s.settimeout(timeout_s)
+        write_frame(s, HEALTHZ, 0)
+        frame = read_frame(s)
+        if frame is None:
+            raise ProtocolError("EOF before healthz reply")
+        msg_type, _req_id, payload = frame
+        if msg_type != HEALTHZ_OK:
+            raise ProtocolError(f"unexpected healthz reply type {msg_type}")
+        return json.loads(payload.decode("utf-8", "replace"))
+
+
 def recv_exact(stream, n: int) -> Optional[bytes]:
     """Read exactly ``n`` bytes; None on EOF at a frame boundary (n>0 and
     zero bytes read); ProtocolError on EOF mid-read.

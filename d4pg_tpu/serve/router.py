@@ -1,0 +1,1471 @@
+"""Replicated serving front-end: least-loaded dispatch, health-driven
+ejection, rolling canary rollout with auto-rollback.
+
+One ``serve/`` process saturates one device thread (1,437 rps on the
+committed artifact); production traffic needs the tier above it. This
+module is that tier — the serving-side mirror of the collection fleet
+(``d4pg_tpu/fleet``): a stdlib front-end speaking the SAME length-prefixed
+frame protocol on both sides, dispatching each request to the least-loaded
+of M backend replicas.
+
+Three jobs:
+
+- **Dispatch** — per-replica inflight accounting (the router's own gauge,
+  not a healthz round-trip per request); least-loaded admitted replica
+  wins, ties broken by index. A replica that sheds (``OVERLOADED``) or
+  dies mid-stream (``ConnectionClosed`` — its pipelined dispatch link
+  sweeps every in-flight future) triggers ONE bounded re-dispatch on a
+  different replica under a seeded :class:`~d4pg_tpu.utils.retry.Backoff`
+  budget; only when every replica is ejected does the router itself
+  answer ``OVERLOADED(no_replicas)``. The accounting identity the chaos
+  soak pins: every request is answered ok, answered OVERLOADED, or
+  failed-after-bounded-retry — never silently lost.
+
+- **Health-driven ejection** — a prober thread polls each replica's
+  healthz (``protocol.probe_healthz``, one-shot so a dead backend cannot
+  wedge it). ``degraded`` / ``draining`` / timeout / connect-failure
+  ejects the replica (its dispatch link is closed, failing its in-flight
+  requests over to survivors); re-admission takes K CONSECUTIVE healthy
+  probes (``readmit_after``) — one lucky probe must not flap a sick
+  replica back in.
+
+- **Rolling canary rollout** — ``--canary-bundle`` names a bundle
+  directory the router watches (its ``bundle.json`` mtime is the version
+  vector, exactly the attestation the exporter's params-first/json-second
+  write ordering provides). A new version deploys onto a deterministic
+  subset of replicas (the canaries), then ``--canary-fraction`` of
+  requests (a deterministic counter fraction, not RNG) routes to them
+  while the router compares canary vs baseline reply-error rate and p99
+  over sliding windows. Better-or-equal → auto-promote (roll the
+  remaining replicas forward one at a time, each attested via healthz
+  ``bundle_mtime`` before the next). Worse — or a canary that fails to
+  load / gets ejected — → auto-rollback: restore the saved old bundle
+  and RE-EJECT the canaries until their healthz attests the old version
+  again. Every decision is a structured ``[router-event]`` JSON line.
+
+The router is a HOST-ONLY module (d4pglint manifest): it moves bytes and
+stats files, never tensors — the one numpy touch is decoding the obs to
+re-encode it for the backend link. Deliberately no JAX import anywhere
+near it: M replicas own the devices; the router must restart in
+milliseconds.
+
+Run it::
+
+    python -m d4pg_tpu.serve.router --backends 127.0.0.1:7431,127.0.0.1:7432 \\
+        --backend-bundles runs/p1/bundle_a,runs/p1/bundle_b \\
+        --canary-bundle runs/p1/canary --canary-fraction 0.25
+
+docs/serving.md ("Replication & rollout") has the dispatch rules, the
+ejection/re-admission state machine, and the canary decision table.
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import os
+import random
+import shutil
+import signal
+import socket
+import struct
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from d4pg_tpu.serve import protocol
+from d4pg_tpu.serve.client import ConnectionClosed, Overloaded, PolicyClient
+from d4pg_tpu.serve.protocol import ProtocolError
+from d4pg_tpu.serve.stats import LatencyReservoir
+from d4pg_tpu.utils.retry import Backoff
+
+# Bundle file names, duplicated from serve/bundle.py ON PURPOSE: that
+# module imports the agent config (and with it JAX) at module top, and the
+# router is a host-only process that must never pay — or crash on — a JAX
+# import. The names are a stable on-disk contract (docs/serving.md).
+_PARAMS_FILE = "actor_params.npz"
+_META_FILE = "bundle.json"
+
+
+def _bundle_json_mtime(bundle_dir: str) -> Optional[float]:
+    try:
+        return os.stat(os.path.join(bundle_dir, _META_FILE)).st_mtime
+    except (OSError, TypeError):
+        return None
+
+
+class RouterStats:
+    """Router-level counters + client-observed latency. One lock, O(1)
+    per request; the identity surface is replies_ok + replies_overloaded
+    + replies_error == answered requests."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self.requests_total = 0
+        self.replies_ok = 0
+        self.replies_overloaded = 0
+        self.replies_error = 0
+        self.retries = 0
+        self.ejections = 0
+        self.admissions = 0
+        self.dropped_replies = 0
+        self.protocol_errors = 0
+        self.canary_rollbacks = 0
+        self.canary_promotions = 0
+        self.latency = LatencyReservoir()
+
+    def inc(self, field: str, by: int = 1) -> None:
+        with self._lock:
+            setattr(self, field, getattr(self, field) + by)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {
+                "uptime_s": round(time.monotonic() - self._t0, 3),
+                "requests_total": self.requests_total,
+                "replies_ok": self.replies_ok,
+                "replies_overloaded": self.replies_overloaded,
+                "replies_error": self.replies_error,
+                "retries": self.retries,
+                "ejections": self.ejections,
+                "admissions": self.admissions,
+                "dropped_replies": self.dropped_replies,
+                "protocol_errors": self.protocol_errors,
+                "canary_rollbacks": self.canary_rollbacks,
+                "canary_promotions": self.canary_promotions,
+            }
+        out["answered_total"] = (
+            out["replies_ok"] + out["replies_overloaded"] + out["replies_error"]
+        )
+        out.update(self.latency.percentiles_ms())
+        return out
+
+
+class Replica:
+    """Router-side bookkeeping for one backend ``serve/`` process.
+
+    No threads of its own and no locks: every mutable field is guarded by
+    the ROUTER's lock — dispatch picks, inflight accounting, and ejection
+    flips must be mutually consistent, and a per-replica lock would just
+    invite ordering bugs between two.
+    """
+
+    def __init__(self, index: int, host: str, port: int,
+                 bundle_dir: Optional[str] = None):
+        self.index = index
+        self.host = host
+        self.port = port
+        self.bundle_dir = bundle_dir      # None = canary cannot target it
+        self.client: Optional[PolicyClient] = None  # dispatch link
+        self.inflight = 0                 # router-side, not healthz
+        self.admitted = False
+        self.ejected_reason: Optional[str] = "startup"
+        self.healthy_streak = 0
+        self.health: dict = {}            # last successful probe snapshot
+        self.pid: Optional[int] = None
+        self.bundle_mtime: Optional[float] = None
+        self.canary = False
+        self.ok = 0                       # lifetime final outcomes served
+        self.errors = 0
+        # Dispatch-progress watermark: refreshed when inflight leaves 0 at
+        # a pick and on EVERY future resolution. While inflight > 0 a
+        # stale watermark means nothing is coming back — the stuck-replica
+        # signal healthz can't carry (a wedged device thread still answers
+        # healthz "ok").
+        self.last_progress = time.monotonic()
+
+    @property
+    def addr(self) -> str:
+        return f"{self.host}:{self.port}"
+
+
+class Router:
+    """The replicated front-end. ``start()`` binds and spawns the accept /
+    control threads; ``drain()`` is the graceful stop (answer in-flight,
+    shed new with ``draining``)."""
+
+    # d4pglint shared-mutable-state: written by exactly one thread each,
+    # read as atomic snapshots —
+    #   _canary_* cursor fields: control thread only (the state machine
+    #   runs there); _canary_state itself is written under _lock because
+    #   _pick routes on it;
+    #   _rollback_dir/_backed_up: control thread only (file staging);
+    #   _obs_dim is also written under _lock (prober) after the first
+    #   successful probe and only ever goes None -> int.
+    _THREAD_SAFE = (
+        "_canary_seen_mtime", "_canary_version", "_canary_deadline",
+        "_rollback_deadline", "_deploys", "_promote_done",
+        "_rollback_dir", "_backed_up",
+    )
+
+    def __init__(
+        self,
+        backends,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        bundle_dirs=None,
+        probe_interval_s: float = 0.5,
+        probe_timeout_s: float = 2.0,
+        readmit_after: int = 2,
+        dispatch_retries: int = 1,
+        dispatch_timeout_s: float = 10.0,
+        stuck_after_s: float = 30.0,
+        retry_seed: int = 0,
+        canary_bundle: Optional[str] = None,
+        canary_fraction: float = 0.25,
+        canary_window: int = 256,
+        canary_min_samples: int = 40,
+        canary_max_err_increase: float = 0.05,
+        canary_p99_ratio: float = 3.0,
+        canary_attest_timeout_s: float = 30.0,
+        canary_observe_timeout_s: float = 600.0,
+        log_dir: Optional[str] = None,
+        metrics_interval_s: float = 30.0,
+        chaos=None,
+    ):
+        if not backends:
+            raise ValueError("router needs at least one backend replica")
+        bundle_dirs = list(bundle_dirs) if bundle_dirs else [None] * len(backends)
+        if len(bundle_dirs) != len(backends):
+            raise ValueError(
+                f"{len(backends)} backends but {len(bundle_dirs)} bundle "
+                "dirs — the canary controller needs a 1:1 mapping"
+            )
+        self._replicas = []
+        for i, spec in enumerate(backends):
+            if isinstance(spec, (tuple, list)):
+                h, p = spec
+            else:
+                h, _, p = str(spec).rpartition(":")
+            self._replicas.append(Replica(i, h or "127.0.0.1", int(p),
+                                          bundle_dirs[i]))
+        if canary_bundle is not None and not any(
+            r.bundle_dir for r in self._replicas
+        ):
+            raise ValueError(
+                "--canary-bundle needs --backend-bundles: the router rolls "
+                "a replica forward by writing into ITS bundle directory"
+            )
+        self.host = host
+        self._requested_port = port
+        self.port: Optional[int] = None
+        self.stats = RouterStats()
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._obs_dim: Optional[int] = None
+
+        self._probe_interval_s = float(probe_interval_s)
+        self._probe_timeout_s = float(probe_timeout_s)
+        self._readmit_after = int(readmit_after)
+        self._dispatch_retries = int(dispatch_retries)
+        self._dispatch_timeout_s = float(dispatch_timeout_s)
+        self._stuck_after_s = float(stuck_after_s)
+        # Seeded: the failover Backoff budget and its jitter replay
+        # deterministically under --chaos, like every retry in this repo.
+        self._retry_rng = random.Random(retry_seed)
+
+        # ---- canary rollout state machine (control thread) ----
+        self._canary_dir = canary_bundle
+        self._canary_permille = int(round(float(canary_fraction) * 1000))
+        if canary_bundle is not None and not (
+            0 < self._canary_permille < 1000
+        ):
+            raise ValueError(
+                "--canary-fraction must be strictly between 0 and 1: the "
+                "verdict compares a canary window AGAINST a baseline "
+                "window, so both groups must receive traffic (0 routes "
+                "nothing to the canary, 1 starves the baseline — either "
+                "way the rollout would observe forever)"
+            )
+        self._canary_state = "idle"   # idle|deploying|observing|promoting|rolling_back
+        self._canary_seen_mtime: Optional[float] = None
+        self._canary_version: Optional[float] = None
+        self._canary_deadline: Optional[float] = None
+        self._rollback_deadline: Optional[float] = None
+        self._attest_timeout_s = float(canary_attest_timeout_s)
+        self._observe_timeout_s = float(canary_observe_timeout_s)
+        self._min_samples = int(canary_min_samples)
+        self._max_err_increase = float(canary_max_err_increase)
+        self._p99_ratio = float(canary_p99_ratio)
+        self._deploys: dict = {}        # replica index -> awaited json mtime
+        self._promote_done: set = set()
+        self._rollback_dir: Optional[str] = None
+        self._backed_up: set = set()
+        # replica index -> bundle_mtime it must attest before probes count
+        # as healthy again (the re-eject-until-old-bundle rollback contract)
+        self._readmit_gate: dict = {}
+        self._windows = {
+            "baseline": deque(maxlen=int(canary_window)),
+            "canary": deque(maxlen=int(canary_window)),
+        }
+
+        self._events: deque = deque(maxlen=1000)
+        self._events_total = 0
+        self._events_lock = threading.Lock()
+
+        self._chaos = chaos
+        self._log_dir = log_dir
+        self._metrics_interval_s = metrics_interval_s
+        self._metrics = None
+
+        self._listen_sock: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._control_thread: Optional[threading.Thread] = None
+        self._metrics_thread: Optional[threading.Thread] = None
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        self._shutdown = threading.Event()
+        self._started = False
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        if self._started:
+            raise RuntimeError("router already started")
+        self._started = True
+        self._listen_sock = socket.create_server(
+            (self.host, self._requested_port)
+        )
+        self.port = self._listen_sock.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="router-accept", daemon=True
+        )
+        self._accept_thread.start()
+        self._control_thread = threading.Thread(
+            target=self._control_loop, name="router-control", daemon=True
+        )
+        self._control_thread.start()
+        if self._log_dir:
+            from d4pg_tpu.runtime.metrics import MetricsLogger
+
+            self._metrics = MetricsLogger(self._log_dir, use_tensorboard=False)
+            self._metrics_thread = threading.Thread(
+                target=self._metrics_loop, name="router-metrics", daemon=True
+            )
+            self._metrics_thread.start()
+
+    def wait_for_replicas(self, n: int, timeout_s: float = 120.0) -> int:
+        """Block until ``n`` replicas are admitted (bounded, monotonic).
+        Returns the admitted count; raises ``TimeoutError`` when the fleet
+        never materializes — a router fronting zero replicas should fail
+        its orchestrator's readiness check loudly, not serve OVERLOADED."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._lock:
+                admitted = sum(1 for r in self._replicas if r.admitted)
+            if admitted >= n:
+                return admitted
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"only {admitted}/{n} replicas admitted after {timeout_s}s"
+                )
+            time.sleep(0.05)
+
+    def request_shutdown(self) -> None:
+        """Signal-handler-safe: set the event; drain happens on the waiter."""
+        self._shutdown.set()
+
+    def serve_until_shutdown(self) -> None:
+        self._shutdown.wait()
+        self.drain()
+
+    def drain(self, timeout: float = 30.0) -> None:
+        """Graceful stop: no new connections, shed new requests with
+        ``draining``, let every in-flight dispatch come back, tear down."""
+        self._shutdown.set()
+        if self._listen_sock is not None:
+            try:
+                self._listen_sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:  # wake a stack where shutdown() on a listener is a no-op
+                with socket.create_connection((self.host, self.port), timeout=1):
+                    pass
+            except OSError:
+                pass
+            try:
+                self._listen_sock.close()
+            except OSError:
+                pass
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                inflight = sum(r.inflight for r in self._replicas)
+            if inflight == 0:
+                break
+            time.sleep(0.05)
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5)
+        if self._control_thread is not None:
+            self._control_thread.join(timeout=self._probe_interval_s + 10)
+        with self._lock:
+            clients = [r.client for r in self._replicas if r.client is not None]
+            for r in self._replicas:
+                r.client = None
+                r.admitted = False
+                r.ejected_reason = "router draining"
+        for c in clients:
+            try:
+                c.close()
+            except OSError:
+                pass
+        if self._metrics_thread is not None:
+            self._metrics_thread.join(timeout=self._metrics_interval_s + 5)
+        if self._metrics is not None:
+            self._metrics.log(self.stats.requests_total, self._metrics_row())
+            self._metrics.close()
+            self._metrics = None
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------ event log
+    def _record_event(self, kind: str, **fields) -> None:
+        """Structured decision log: one JSON line per event on stdout
+        (greppable by the soak) + a bounded in-memory tail for healthz."""
+        event = {"event": kind, "t": round(time.monotonic(), 3), **fields}
+        with self._events_lock:
+            self._events.append(event)
+            self._events_total += 1
+        print(f"[router-event] {json.dumps(event, sort_keys=True)}", flush=True)
+
+    # ------------------------------------------------------- control thread
+    def _control_loop(self) -> None:
+        """Probe → eject/re-admit → canary step, every probe interval.
+        ONE control thread on purpose: ejection flips and rollout
+        transitions observe each other, and two timers would race."""
+        while not self._shutdown.is_set():
+            try:
+                self._probe_all()
+                self._canary_step()
+            except Exception as e:  # control must never die silently
+                print(f"[router] control loop error: {e!r}", flush=True)
+                self._record_event("control_error", error=repr(e))
+            if self._shutdown.wait(self._probe_interval_s):
+                return
+
+    def _probe_all(self) -> None:
+        # Probes run CONCURRENTLY: sequentially, every unreachable replica
+        # would stall the whole control loop by its full connect timeout
+        # per round (M-1 dead backends → the survivor's ejection and the
+        # canary attestation deadlines slip by seconds while the
+        # wall-parallel monotonic deadlines keep ticking). Each probe is a
+        # self-contained one-shot socket, so a thread per replica per
+        # round is safe; a wedged probe past the join bound is treated as
+        # failed and its daemon thread dies with its socket timeout.
+        results: list = [None] * len(self._replicas)
+
+        def probe_one(i: int, r: Replica) -> None:
+            try:
+                results[i] = (protocol.probe_healthz(
+                    r.host, r.port, timeout_s=self._probe_timeout_s
+                ), None)
+            except (OSError, ProtocolError) as e:
+                results[i] = (None, e)
+
+        threads = [
+            threading.Thread(
+                target=probe_one, args=(i, r),
+                name=f"router-probe-{i}", daemon=True,
+            )
+            for i, r in enumerate(self._replicas)
+        ]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + self._probe_timeout_s + 2.0
+        for t in threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        for r, res in zip(self._replicas, results):
+            if res is None:
+                res = (None, TimeoutError("probe thread did not finish"))
+            self._apply_probe(r, res[0], res[1])
+        self._check_stuck()
+
+    def _check_stuck(self) -> None:
+        """Eject a replica whose dispatches stopped resolving. A backend
+        with a wedged device thread still answers healthz ``ok`` (status
+        only reflects drain/reload state), so the prober alone would keep
+        it admitted while its unanswered futures break the accounting
+        identity and its leaked inflight biases least-loaded dispatch.
+        Closing the dispatch link fails every in-flight future with
+        ``ConnectionClosed`` — the normal bounded-failover trigger — so
+        stuck requests are rescued onto other replicas, not abandoned."""
+        if not self._stuck_after_s:
+            return
+        now = time.monotonic()
+        to_close, ejected = [], []
+        with self._lock:
+            for r in self._replicas:
+                if (
+                    r.admitted and r.inflight > 0
+                    and now - r.last_progress > self._stuck_after_s
+                ):
+                    to_close.append(self._eject_locked(
+                        r, f"stuck: no dispatch resolved in "
+                           f"{self._stuck_after_s:g}s"
+                    ))
+                    ejected.append(r)
+        for c in to_close:
+            if c is not None:
+                try:
+                    c.close()
+                except OSError:
+                    pass
+        for r in ejected:
+            self._record_event("eject", replica=r.index, addr=r.addr,
+                               reason="stuck")
+
+    def _apply_probe(self, r: Replica, h: Optional[dict], err) -> None:
+        to_close = None
+        eject_reason = None
+        dial = False
+        with self._lock:
+            if h is not None:
+                r.health = h
+                r.pid = h.get("pid")
+                r.bundle_mtime = h.get("bundle_mtime")
+                if self._obs_dim is None and h.get("obs_dim"):
+                    self._obs_dim = int(h["obs_dim"])
+            if h is None or h.get("status") != "ok":
+                r.healthy_streak = 0
+                if r.admitted:
+                    eject_reason = (
+                        f"probe failed: {err!r}" if err is not None
+                        else f"status: {h.get('status')}"
+                    )
+                    to_close = self._eject_locked(r, eject_reason)
+            else:
+                gate = self._readmit_gate.get(r.index)
+                if gate is not None and r.bundle_mtime != gate:
+                    # rolled-back canary: healthy probes do not count until
+                    # it attests the RESTORED bundle version
+                    r.healthy_streak = 0
+                else:
+                    if gate is not None:
+                        del self._readmit_gate[r.index]
+                    r.healthy_streak += 1
+                    if (
+                        not r.admitted
+                        and r.healthy_streak >= self._readmit_after
+                    ):
+                        dial = True
+        if to_close is not None:
+            try:
+                to_close.close()
+            except OSError:
+                pass
+        if eject_reason is not None:
+            self._record_event("eject", replica=r.index, addr=r.addr,
+                               reason=eject_reason)
+        if dial:
+            self._admit(r)
+
+    def _eject_locked(self, r: Replica, reason: str):
+        """Caller holds ``self._lock``. Returns the dispatch link to close
+        OUTSIDE the lock. Closing it fails every in-flight request on this
+        replica with ``ConnectionClosed`` — which is exactly the bounded
+        failover trigger, so ejection actively rescues in-flight work from
+        a sick replica instead of letting it ride out a timeout."""
+        r.admitted = False
+        r.ejected_reason = reason
+        r.healthy_streak = 0
+        client, r.client = r.client, None
+        self.stats.inc("ejections")
+        return client
+
+    def _admit(self, r: Replica) -> None:
+        """Dial the dispatch link OUTSIDE the lock, then publish. The link
+        is a pipelined PolicyClient at retries=0: the router's recovery is
+        failover to a DIFFERENT replica, never a hammer on the same one."""
+        try:
+            client = PolicyClient(
+                r.host, r.port, timeout=self._dispatch_timeout_s
+            )
+        except OSError as e:
+            with self._lock:
+                r.healthy_streak = 0
+            self._record_event("admit_failed", replica=r.index, addr=r.addr,
+                               error=str(e))
+            return
+        stale = None
+        with self._lock:
+            if r.admitted or self._shutdown.is_set():
+                stale = client
+            else:
+                r.client = client
+                r.admitted = True
+                r.ejected_reason = None
+                r.last_progress = time.monotonic()
+        if stale is not None:
+            try:
+                stale.close()
+            except OSError:
+                pass
+            return
+        self.stats.inc("admissions")
+        self._record_event("admit", replica=r.index, addr=r.addr,
+                           streak=r.healthy_streak)
+
+    # -------------------------------------------------------------- dispatch
+    def _pick(self, exclude):
+        """Least-loaded admitted replica (ties → lowest index), honoring
+        the deterministic canary traffic split while a rollout is
+        observing. Returns ``(replica, client)`` or ``(None, None)`` —
+        the all-ejected case the router answers OVERLOADED itself."""
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            pool = [
+                r for r in self._replicas
+                if r.admitted and r.client is not None
+                and r.index not in exclude
+            ]
+            if not pool:
+                return None, None
+            if self._canary_state == "observing" and self._canary_permille:
+                # Bresenham-style striping: request i is canary iff
+                # (i·permille) mod 1000 < permille — the fraction is exact
+                # over any 1000-request window AND interleaved, so both
+                # comparison windows fill together (seq%1000 < permille
+                # would send a contiguous block of 1000·fraction requests
+                # to the canary first, starving the baseline window).
+                want_canary = (
+                    seq * self._canary_permille
+                ) % 1000 < self._canary_permille
+                group = [r for r in pool if r.canary == want_canary] or pool
+            else:
+                group = [r for r in pool if not r.canary] or pool
+            # least-loaded wins; ties rotate with the dispatch counter so
+            # sequential (inflight-0) traffic round-robins instead of
+            # pinning the lowest index
+            n = len(self._replicas)
+            best = group[0]
+            best_key = (best.inflight, (best.index - seq) % n)
+            for r in group[1:]:
+                key = (r.inflight, (r.index - seq) % n)
+                if key < best_key:
+                    best, best_key = r, key
+            if best.inflight == 0:
+                # arm the stuck watermark: from idle, the clock starts at
+                # this dispatch (while inflight stays >0 only resolutions
+                # refresh it — see _check_stuck)
+                best.last_progress = time.monotonic()
+            best.inflight += 1
+            return best, best.client
+
+    def _route(self, obs, deadline_us: int, req_id: int, reply) -> None:
+        """Dispatch one decoded request; ``reply`` is the per-connection
+        frame writer. Exactly one reply per request, on every path — the
+        accounting identity depends on it."""
+        t0 = time.perf_counter()
+        deadline_ms = deadline_us / 1e3 if deadline_us else None
+        state = {"backoff": None, "exclude": []}
+
+        def attempt():
+            remaining_ms = None
+            if deadline_ms is not None:
+                # the client's deadline is a budget for the whole request,
+                # not per attempt: a failover re-dispatch gets what's LEFT
+                # (a first replica that burned the budget before shedding
+                # must yield an honest OVERLOADED, not a reply at 2x the
+                # declared deadline)
+                remaining_ms = (
+                    deadline_ms - (time.perf_counter() - t0) * 1e3
+                )
+                if remaining_ms <= 0:
+                    self.stats.inc("replies_overloaded")
+                    reply(protocol.OVERLOADED, req_id, b"deadline")
+                    return
+            replica, client = self._pick(state["exclude"])
+            if replica is None:
+                self.stats.inc("replies_overloaded")
+                reply(protocol.OVERLOADED, req_id, b"no_replicas")
+                return
+            kill_pid = None
+            if self._chaos is not None:
+                e = self._chaos.tick("replica_kill")
+                if e is not None:
+                    kill_pid = replica.pid
+            fut = client.act_async(obs, remaining_ms)
+            if kill_pid:
+                # AFTER the send: the request is on the wire — this is the
+                # mid-stream replica death the failover contract covers.
+                try:
+                    os.kill(int(kill_pid), signal.SIGKILL)
+                except (OSError, ValueError) as e:
+                    print(f"[router] chaos replica_kill failed: {e}",
+                          flush=True)
+
+            def done(f, replica=replica):
+                with self._lock:
+                    replica.inflight -= 1
+                    replica.last_progress = time.monotonic()
+                exc = f.exception()
+                lat = time.perf_counter() - t0
+                if exc is None:
+                    with self._lock:
+                        replica.ok += 1
+                        self._windows[
+                            "canary" if replica.canary else "baseline"
+                        ].append((True, lat))
+                    self.stats.inc("replies_ok")
+                    self.stats.latency.add(lat)
+                    reply(protocol.ACT_OK, req_id,
+                          protocol.encode_action(f.result()))
+                    return
+                if isinstance(exc, (Overloaded, ConnectionClosed)):
+                    bo = state["backoff"]
+                    if bo is None:
+                        # base_s=0: with another replica available the
+                        # failover is immediate; the Backoff's job here is
+                        # the bounded ATTEMPT budget (and determinism under
+                        # --chaos via the seeded rng).
+                        bo = state["backoff"] = Backoff(
+                            base_s=0.0, jitter=0.0,
+                            max_attempts=self._dispatch_retries,
+                            rng=self._retry_rng,
+                        )
+                    delay = bo.next_delay()
+                    if delay is not None:
+                        state["exclude"].append(replica.index)
+                        self.stats.inc("retries")
+                        if delay:
+                            time.sleep(delay)
+                        attempt()
+                        return
+                with self._lock:
+                    replica.errors += 1
+                    if not isinstance(exc, Overloaded):
+                        self._windows[
+                            "canary" if replica.canary else "baseline"
+                        ].append((False, lat))
+                if isinstance(exc, Overloaded):
+                    self.stats.inc("replies_overloaded")
+                    reply(protocol.OVERLOADED, req_id,
+                          str(exc).encode() or b"overloaded")
+                else:
+                    self.stats.inc("replies_error")
+                    reply(protocol.ERROR, req_id,
+                          f"failed after bounded retry: {exc}".encode())
+
+            fut.add_done_callback(done)
+
+        attempt()
+
+    # ------------------------------------------------------------ client side
+    def _accept_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                conn, _addr = self._listen_sock.accept()
+            except OSError as e:
+                if self._shutdown.is_set():
+                    return  # listener closed: draining
+                if e.errno in (errno.EBADF, errno.EINVAL):
+                    # the listen socket died under us WITHOUT a drain:
+                    # say so loudly instead of silently never accepting
+                    # again while the fleet keeps answering probes
+                    print(f"[router] accept loop dead: {e!r}", flush=True)
+                    self._record_event("accept_error", error=repr(e))
+                    return
+                # transient (ECONNABORTED from a client RST between SYN
+                # and accept — exactly the failover/chaos traffic shape —
+                # or a brief EMFILE): keep accepting (the ingest server's
+                # accept loop learned this in PR 7)
+                time.sleep(0.05)
+                continue
+            if self._shutdown.is_set():
+                try:
+                    conn.close()  # the drain's own wake-up connection
+                except OSError:
+                    pass
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            try:
+                # Same rationale as PolicyServer: replies are written from
+                # the replica links' reader threads — one zero-window
+                # client must not head-of-line-block a replica's whole
+                # reply pump behind an unbounded sendall.
+                conn.setsockopt(
+                    socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                    struct.pack("ll", 10, 0),
+                )
+            except OSError:
+                pass
+            with self._conns_lock:
+                self._conns.add(conn)
+            threading.Thread(
+                target=self._serve_conn, args=(conn,),
+                name="router-conn", daemon=True,
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        send_lock = threading.Lock()
+        rfile = conn.makefile("rb")
+
+        def reply(msg_type: int, req_id: int, payload: bytes = b"") -> None:
+            try:
+                with send_lock:
+                    protocol.write_frame(conn, msg_type, req_id, payload)
+            except OSError:
+                # Client gone before its reply, or wedged past the send
+                # timeout: a partial frame is unrecoverable — close (which
+                # also unblocks this connection's reader).
+                self.stats.inc("dropped_replies")
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+        try:
+            while True:
+                frame = protocol.read_frame(rfile)
+                if frame is None:
+                    return  # clean EOF
+                msg_type, req_id, payload = frame
+                if msg_type == protocol.HEALTHZ:
+                    reply(protocol.HEALTHZ_OK, req_id,
+                          json.dumps(self.healthz()).encode())
+                    continue
+                if msg_type != protocol.ACT:
+                    raise ProtocolError(f"unexpected message type {msg_type}")
+                obs_dim = self._obs_dim
+                if obs_dim is None:
+                    # no replica has ever answered a probe: obs_dim (and
+                    # the fleet) is unknown — shed honestly
+                    self.stats.inc("requests_total")
+                    self.stats.inc("replies_overloaded")
+                    reply(protocol.OVERLOADED, req_id, b"no_replicas")
+                    continue
+                obs, deadline_us = protocol.decode_act(payload, obs_dim)
+                self.stats.inc("requests_total")
+                if self._shutdown.is_set():
+                    self.stats.inc("replies_overloaded")
+                    reply(protocol.OVERLOADED, req_id, b"draining")
+                    continue
+                if self._chaos is not None:
+                    e = self._chaos.tick("replica_slow")
+                    if e is not None:
+                        # stall THIS request's dispatch (a slow replica as
+                        # seen by one request): p99 must account it, other
+                        # connections must not feel it
+                        time.sleep(
+                            (e.arg if e.arg is not None else 100.0) / 1e3
+                        )
+                self._route(obs, deadline_us, req_id, reply)
+        except ProtocolError as e:
+            self.stats.inc("protocol_errors")
+            try:
+                with send_lock:
+                    protocol.write_frame(
+                        conn, protocol.ERROR, 0, str(e).encode()
+                    )
+            except OSError:
+                pass
+        except OSError:
+            pass  # peer reset / socket closed by drain
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+            try:
+                rfile.close()
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # ------------------------------------------------------- canary rollout
+    def _canary_step(self) -> None:
+        if self._canary_dir is None:
+            return
+        state = self._canary_state
+        if state == "idle":
+            self._canary_idle()
+        elif state == "deploying":
+            self._canary_check_deploys()
+        elif state == "observing":
+            self._canary_observe()
+        elif state == "promoting":
+            self._canary_promote()
+        elif state == "rolling_back":
+            self._canary_check_rollback()
+
+    def _set_canary_state(self, state: str) -> None:
+        with self._lock:
+            self._canary_state = state
+
+    def _clear_windows(self) -> None:
+        with self._lock:
+            self._windows["baseline"].clear()
+            self._windows["canary"].clear()
+
+    def _canary_replicas(self):
+        return [r for r in self._replicas if r.canary]
+
+    def _canary_idle(self) -> None:
+        m = _bundle_json_mtime(self._canary_dir)
+        if m is None or m == self._canary_seen_mtime:
+            return
+        with self._lock:
+            eligible = [
+                r for r in self._replicas if r.admitted and r.bundle_dir
+            ]
+            total = len(self._replicas)
+        if len(eligible) < 2:
+            # a canary needs at least one baseline to compare against;
+            # keep waiting (the bookmark does NOT advance — the rollout
+            # starts as soon as the fleet is healthy enough)
+            return
+        n_canary = min(max(1, round(self._canary_permille / 1000 * total)),
+                       len(eligible) - 1)
+        # deterministic choice: the highest-index eligible replicas
+        canaries = sorted(eligible, key=lambda r: -r.index)[:n_canary]
+        self._canary_seen_mtime = m
+        self._canary_version = m
+        self._rollback_dir = tempfile.mkdtemp(prefix="d4pg-router-rollback-")
+        self._backed_up = set()
+        deploys = {}
+        try:
+            for r in canaries:
+                self._backup_bundle(r)
+                corrupt = False
+                if self._chaos is not None:
+                    corrupt = self._chaos.tick("canary_corrupt") is not None
+                deploys[r.index] = self._deploy_bundle(
+                    self._canary_dir, r.bundle_dir, corrupt=corrupt
+                )
+        except OSError as e:
+            # Mid-deploy I/O failure (ENOSPC, unreadable canary source, a
+            # missing replica bundle file): any canary ALREADY rolled
+            # forward must not be left serving the new bundle as a phantom
+            # baseline. Route through the normal rollback — it restores
+            # every replica in _backed_up and re-ejects until the old
+            # version attests; the bookmark stays advanced so a broken
+            # rollout is reported once, not retried every probe tick.
+            self._canary_rollback(f"deploy I/O error: {e!r}")
+            return
+        with self._lock:
+            for r in canaries:
+                r.canary = True
+            self._canary_state = "deploying"
+        self._deploys = deploys
+        self._canary_deadline = time.monotonic() + self._attest_timeout_s
+        self._clear_windows()
+        self._record_event(
+            "canary_start", version=m,
+            canaries=[r.index for r in canaries],
+            fraction=self._canary_permille / 1000.0,
+        )
+
+    def _canary_check_deploys(self) -> None:
+        with self._lock:
+            canaries = [r for r in self._replicas if r.canary]
+            attested = all(
+                r.bundle_mtime == self._deploys.get(r.index) and r.admitted
+                for r in canaries
+            )
+            failed = [
+                r.index for r in canaries
+                if not r.admitted or r.health.get("status") == "degraded"
+            ]
+        if attested:
+            self._set_canary_state("observing")
+            # observing gets its own deadline: every other rollout state
+            # is bounded, and a fleet with too little traffic to fill the
+            # comparison windows must eventually roll back (frozen canary
+            # traffic + a rollout that blocks every newer version forever
+            # is worse than retrying later under real load)
+            self._canary_deadline = (
+                time.monotonic() + self._observe_timeout_s
+            )
+            self._clear_windows()
+            self._record_event("canary_observing",
+                               version=self._canary_version)
+        elif failed or time.monotonic() > self._canary_deadline:
+            self._canary_rollback(
+                f"deploy failed on replicas {failed}" if failed
+                else "deploy attestation timed out"
+            )
+
+    def _canary_observe(self) -> None:
+        with self._lock:
+            dead = [r.index for r in self._replicas
+                    if r.canary and not r.admitted]
+            base = list(self._windows["baseline"])
+            can = list(self._windows["canary"])
+        if dead:
+            self._canary_rollback(f"canary replicas {dead} ejected "
+                                  "mid-observation")
+            return
+        if len(base) < self._min_samples or len(can) < self._min_samples:
+            if time.monotonic() > self._canary_deadline:
+                self._canary_rollback(
+                    f"observation starved: windows never filled "
+                    f"({len(base)} baseline / {len(can)} canary of "
+                    f"{self._min_samples} required)"
+                )
+            return
+        base_err = 1.0 - sum(ok for ok, _ in base) / len(base)
+        can_err = 1.0 - sum(ok for ok, _ in can) / len(can)
+        base_p99 = _p99([lat for ok, lat in base if ok])
+        can_p99 = _p99([lat for ok, lat in can if ok])
+        verdict = {
+            "baseline_error_rate": round(base_err, 4),
+            "canary_error_rate": round(can_err, 4),
+            "baseline_p99_ms": _ms(base_p99),
+            "canary_p99_ms": _ms(can_p99),
+            "samples": [len(base), len(can)],
+        }
+        if can_err > base_err + self._max_err_increase:
+            self._canary_rollback(
+                f"error-rate regression {can_err:.4f} vs {base_err:.4f}",
+                **verdict,
+            )
+        elif (
+            base_p99 is not None and can_p99 is not None
+            and can_p99 > base_p99 * self._p99_ratio + 0.010
+        ):
+            self._canary_rollback(
+                f"p99 regression {_ms(can_p99)} ms vs {_ms(base_p99)} ms",
+                **verdict,
+            )
+        else:
+            # canary_promotions ticks at COMPLETION (the canary_promoted
+            # terminal in _canary_promote), not here at the verdict: a
+            # promote that later fails (deploy I/O, attestation timeout)
+            # ends in a rollback, and one rollout must never book both
+            self._promote_done = set()
+            self._deploys = {}
+            self._set_canary_state("promoting")
+            self._record_event("canary_promote",
+                               version=self._canary_version, **verdict)
+
+    def _canary_promote(self) -> None:
+        """Roll the remaining baselines forward ONE at a time, each
+        attested before the next — a bad surprise mid-promote strands one
+        replica, not the fleet."""
+        with self._lock:
+            baselines = [r for r in self._replicas
+                         if r.bundle_dir and not r.canary]
+            pending = [r for r in baselines if r.index in self._deploys]
+            for r in pending:
+                if r.bundle_mtime == self._deploys[r.index] and r.admitted:
+                    self._promote_done.add(r.index)
+                    del self._deploys[r.index]
+        for r in pending:
+            if r.index in self._promote_done:
+                self._record_event("promoted_replica", replica=r.index)
+        if self._deploys:
+            if time.monotonic() > self._canary_deadline:
+                self._canary_rollback(
+                    f"promote attestation timed out on "
+                    f"{sorted(self._deploys)}"
+                )
+            return
+        nxt = next(
+            (r for r in baselines if r.index not in self._promote_done), None
+        )
+        if nxt is not None:
+            try:
+                self._backup_bundle(nxt)
+                mt = self._deploy_bundle(self._canary_dir, nxt.bundle_dir)
+            except OSError as e:
+                # same contract as the idle-path deploy guard: a promote
+                # whose source vanished or whose disk filled must roll the
+                # whole rollout back, not spin in "promoting" re-raising
+                # into the control loop's catch-all every tick
+                self._canary_rollback(
+                    f"deploy I/O error during promote: {e!r}"
+                )
+                return
+            self._deploys = {nxt.index: mt}
+            self._canary_deadline = time.monotonic() + self._attest_timeout_s
+            self._record_event("promote_replica", replica=nxt.index)
+            return
+        # nxt is None: every baseline rolled forward — terminal event
+        # BEFORE the state flip: a healthz reader that polls for
+        # state=="idle" must find the terminal event already in
+        # events_tail (the soak and tests do exactly that)
+        self.stats.inc("canary_promotions")
+        self._record_event("canary_promoted",
+                           version=self._canary_version)
+        with self._lock:
+            for r in self._replicas:
+                r.canary = False
+            self._canary_state = "idle"
+        self._cleanup_rollback_dir()
+
+    def _canary_rollback(self, reason: str, **verdict) -> None:
+        """Restore every replica the rollout touched to the saved old
+        bundle and RE-EJECT it until its healthz attests that old version
+        (then the normal K-consecutive-probes re-admission applies).
+        Baselines that were never deployed to are never touched."""
+        # State flips FIRST: once canary_rollbacks ticks (next line), a
+        # healthz reader must never see the rollout still "idle"/
+        # "observing" — a rollback entered from idle (deploy I/O error)
+        # does file restores below before the gates land, and that window
+        # read as a settled fleet.
+        with self._lock:
+            self._canary_state = "rolling_back"
+        # deadline BEFORE the restores: if one raises below, the next
+        # _canary_check_rollback tick must compare against a real deadline,
+        # not a stale/None one (TypeError every control tick = a
+        # permanently wedged rollout machine)
+        self._rollback_deadline = time.monotonic() + 4 * self._attest_timeout_s
+        self.stats.inc("canary_rollbacks")
+        self._record_event("canary_rollback", reason=reason,
+                           version=self._canary_version, **verdict)
+        gates = {}
+        restore_failed = []
+        for i in sorted(self._backed_up):
+            r = self._replicas[i]
+            try:
+                gates[i] = self._deploy_bundle(
+                    os.path.join(self._rollback_dir, str(i)), r.bundle_dir
+                )
+            except OSError as e:
+                # the restore itself failed (ENOSPC again, backup dir
+                # damaged): no version to gate re-admission on — eject the
+                # replica below anyway (its probes decide re-admission) and
+                # say so loudly; the rollback deadline bounds the wait
+                restore_failed.append((i, e))
+        to_close = []
+        ejected = []
+        with self._lock:
+            for i in sorted(self._backed_up):
+                r = self._replicas[i]
+                if i in gates:
+                    self._readmit_gate[i] = gates[i]
+                if r.admitted:
+                    to_close.append(self._eject_locked(r, "rollback"))
+                    ejected.append(i)
+                else:
+                    r.healthy_streak = 0
+        self._deploys = {}
+        for c in to_close:
+            if c is not None:
+                try:
+                    c.close()
+                except OSError:
+                    pass
+        for i, e in restore_failed:
+            self._record_event("rollback_restore_failed", replica=i,
+                               error=repr(e))
+        for i in ejected:
+            self._record_event("eject", replica=i,
+                               addr=self._replicas[i].addr, reason="rollback")
+
+    def _canary_check_rollback(self) -> None:
+        with self._lock:
+            # every replica the rollout DEPLOYED to (canaries, plus any
+            # baseline a failed promote already rolled forward) must attest
+            # the restored bundle and re-admit before the rollback is done
+            waiting = [
+                r.index for r in self._replicas
+                if r.index in self._backed_up
+                and (r.index in self._readmit_gate or not r.admitted)
+            ]
+        if not waiting:
+            # terminal event BEFORE the state flip (see _canary_promote)
+            self._record_event("canary_rolled_back",
+                               version=self._canary_version)
+            with self._lock:
+                for r in self._replicas:
+                    r.canary = False
+                self._canary_state = "idle"
+            self._cleanup_rollback_dir()
+            return
+        if time.monotonic() > self._rollback_deadline:
+            # the replica never came back (killed and not restarted?) —
+            # stop gating on it so a fresh process serving the restored
+            # bundle can re-admit normally, and say so loudly
+            self._record_event("canary_rollback_timeout",
+                               version=self._canary_version,
+                               waiting=waiting)
+            with self._lock:
+                for r in self._replicas:
+                    r.canary = False
+                self._readmit_gate.clear()
+                self._canary_state = "idle"
+            self._cleanup_rollback_dir()
+
+    def _backup_bundle(self, r: Replica) -> None:
+        if r.index in self._backed_up:
+            # never overwrite the pristine pre-rollout copy: a re-entered
+            # promote step after a partial deploy would otherwise save the
+            # half-deployed dir (new params + old json) AS the backup, and
+            # a later rollback would restore that corrupt mixture
+            return
+        dst = os.path.join(self._rollback_dir, str(r.index))
+        os.makedirs(dst, exist_ok=True)
+        for fname in (_PARAMS_FILE, _META_FILE):
+            shutil.copyfile(os.path.join(r.bundle_dir, fname),
+                            os.path.join(dst, fname))
+        self._backed_up.add(r.index)
+
+    def _deploy_bundle(self, src_dir: str, dst_dir: str,
+                       corrupt: bool = False) -> float:
+        """Roll ``dst_dir`` (a replica's live bundle) onto ``src_dir``'s
+        content: params FIRST, json second, each tmp+rename — the
+        exporter's atomic attestation ordering, reproduced because the
+        router IS an exporter when it rolls a replica forward. Returns the
+        new json mtime (the version the replica must attest via healthz).
+        ``corrupt`` is the ``canary_corrupt`` chaos fault: truncate the
+        params copy so the replica's reload fails AFTER the attestation
+        moved — the degraded-not-promoted path."""
+        os.makedirs(dst_dir, exist_ok=True)
+        for fname in (_PARAMS_FILE, _META_FILE):
+            src = os.path.join(src_dir, fname)
+            fd, tmp = tempfile.mkstemp(dir=dst_dir, suffix=".tmp")
+            os.close(fd)
+            try:
+                shutil.copyfile(src, tmp)
+                if corrupt and fname == _PARAMS_FILE:
+                    size = os.path.getsize(tmp)
+                    with open(tmp, "rb+") as f:
+                        f.truncate(max(1, size // 2))
+                    print(f"[router] chaos canary_corrupt: truncated "
+                          f"{fname} for {dst_dir}", flush=True)
+                os.replace(tmp, os.path.join(dst_dir, fname))
+            except BaseException:
+                if os.path.exists(tmp):
+                    os.remove(tmp)
+                raise
+        return os.stat(os.path.join(dst_dir, _META_FILE)).st_mtime
+
+    def _cleanup_rollback_dir(self) -> None:
+        if self._rollback_dir is not None:
+            shutil.rmtree(self._rollback_dir, ignore_errors=True)
+            self._rollback_dir = None
+        self._backed_up = set()
+
+    # ----------------------------------------------------------------- status
+    def healthz(self) -> dict:
+        with self._lock:
+            replicas = [
+                {
+                    "index": r.index,
+                    "addr": r.addr,
+                    "admitted": r.admitted,
+                    "ejected_reason": r.ejected_reason,
+                    "canary": r.canary,
+                    "inflight": r.inflight,
+                    "healthy_streak": r.healthy_streak,
+                    "bundle_mtime": r.bundle_mtime,
+                    "pid": r.pid,
+                    "replica_id": r.health.get("replica_id"),
+                    "status": r.health.get("status"),
+                    "compile_count": r.health.get("compile_count"),
+                    "params_reloads": r.health.get("params_reloads"),
+                    "ok": r.ok,
+                    "errors": r.errors,
+                }
+                for r in self._replicas
+            ]
+            admitted = sum(1 for r in self._replicas if r.admitted)
+            inflight = sum(r.inflight for r in self._replicas)
+            canary = {
+                "state": self._canary_state,
+                "fraction": self._canary_permille / 1000.0,
+                "version": self._canary_version,
+                "window_baseline": len(self._windows["baseline"]),
+                "window_canary": len(self._windows["canary"]),
+            }
+            obs_dim = self._obs_dim
+        snap = self.stats.snapshot()
+        snap["router"] = True
+        snap["status"] = "draining" if self._shutdown.is_set() else (
+            "ok" if admitted else "degraded"
+        )
+        snap["draining"] = self._shutdown.is_set()
+        snap["admitted"] = admitted
+        snap["inflight"] = inflight
+        snap["obs_dim"] = obs_dim
+        snap["replicas"] = replicas
+        snap["canary"] = canary
+        with self._events_lock:
+            snap["events_total"] = self._events_total
+            snap["events_tail"] = list(self._events)[-20:]
+        if self._chaos is not None:
+            snap["chaos_injections"] = self._chaos.injections_total
+        return snap
+
+    def _metrics_row(self) -> dict:
+        """Numeric-only flat row (MetricsLogger contract)."""
+        snap = self.stats.snapshot()
+        with self._lock:
+            snap["admitted"] = sum(1 for r in self._replicas if r.admitted)
+            snap["inflight"] = sum(r.inflight for r in self._replicas)
+            snap["canary_active"] = float(self._canary_state != "idle")
+        return {
+            k: float(v) for k, v in snap.items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
+        }
+
+    def _metrics_loop(self) -> None:
+        while not self._shutdown.wait(self._metrics_interval_s):
+            self._metrics.log(self.stats.requests_total, self._metrics_row())
+
+
+def _p99(lats) -> Optional[float]:
+    if not lats:
+        return None
+    s = sorted(lats)
+    return s[min(len(s) - 1, int(0.99 * len(s)))]
+
+
+def _ms(v: Optional[float]):
+    return None if v is None else round(v * 1e3, 4)
+
+
+# --------------------------------------------------------------------- CLI
+def build_parser():
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m d4pg_tpu.serve.router",
+        description="Replicated serving front-end: least-loaded dispatch, "
+                    "health-driven ejection, rolling canary rollout.",
+    )
+    p.add_argument("--backends", required=True,
+                   help="comma-separated host:port of the serve/ replicas")
+    p.add_argument("--backend-bundles", default=None,
+                   help="comma-separated bundle dirs, 1:1 with --backends "
+                        "(required for canary rollout: the router rolls a "
+                        "replica forward by writing into its bundle dir)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=7430,
+                   help="0 = ephemeral (printed on startup)")
+    p.add_argument("--probe-interval", type=float, default=0.5,
+                   help="seconds between healthz probe rounds")
+    p.add_argument("--probe-timeout", type=float, default=2.0,
+                   help="per-probe budget; past it the replica is ejected")
+    p.add_argument("--readmit-after", type=int, default=2,
+                   help="consecutive healthy probes before (re-)admission")
+    p.add_argument("--dispatch-retries", type=int, default=1,
+                   help="bounded re-dispatches on a different replica when "
+                        "one sheds or dies mid-stream")
+    p.add_argument("--stuck-after", type=float, default=30.0,
+                   help="eject a replica whose in-flight dispatches stop "
+                        "resolving for this many seconds even though its "
+                        "healthz still answers ok (a wedged device thread); "
+                        "ejection fails the stuck requests over. 0 disables")
+    p.add_argument("--retry-seed", type=int, default=0)
+    p.add_argument("--wait-replicas", type=int, default=None,
+                   help="block startup until N replicas admitted "
+                        "(default: all backends)")
+    p.add_argument("--wait-timeout", type=float, default=120.0)
+    p.add_argument("--canary-bundle", default=None,
+                   help="bundle dir to watch for rollouts: each new "
+                        "bundle.json mtime there starts a canary rollout")
+    p.add_argument("--canary-fraction", type=float, default=0.25,
+                   help="deterministic request fraction routed to canary "
+                        "replicas while observing")
+    p.add_argument("--canary-window", type=int, default=256,
+                   help="sliding comparison window per group (requests)")
+    p.add_argument("--canary-min-samples", type=int, default=40,
+                   help="per-group samples required before a verdict")
+    p.add_argument("--canary-max-error-increase", type=float, default=0.05,
+                   help="canary error rate above baseline+this rolls back")
+    p.add_argument("--canary-p99-ratio", type=float, default=3.0,
+                   help="canary p99 above baseline*this (+10ms) rolls back")
+    p.add_argument("--canary-attest-timeout", type=float, default=30.0,
+                   help="seconds a deployed replica gets to attest the new "
+                        "bundle_mtime before the rollout rolls back")
+    p.add_argument("--canary-observe-timeout", type=float, default=600.0,
+                   help="seconds the observation windows get to reach "
+                        "--canary-min-samples before the rollout rolls "
+                        "back (too little traffic must not wedge a "
+                        "rollout in 'observing' forever)")
+    p.add_argument("--log-dir", default=None,
+                   help="append router metrics rows (metrics.jsonl) here")
+    p.add_argument("--metrics-interval", type=float, default=30.0)
+    p.add_argument("--chaos", default=None, metavar="PLAN",
+                   help="deterministic fault injection (d4pg_tpu/chaos.py): "
+                        "replica_kill@N / replica_slow@N:ms / "
+                        "canary_corrupt@N")
+    return p
+
+
+def main(argv=None) -> None:
+    import sys
+
+    from d4pg_tpu.utils.signals import install_graceful_signals
+
+    args = build_parser().parse_args(argv)
+    backends = [b.strip() for b in args.backends.split(",") if b.strip()]
+    bundles = None
+    if args.backend_bundles:
+        bundles = [
+            b.strip() or None for b in args.backend_bundles.split(",")
+        ]
+    chaos = None
+    if args.chaos:
+        from d4pg_tpu.chaos import ChaosInjector, ChaosPlan
+
+        chaos = ChaosInjector(ChaosPlan.parse(args.chaos))
+    router = Router(
+        backends,
+        host=args.host,
+        port=args.port,
+        bundle_dirs=bundles,
+        probe_interval_s=args.probe_interval,
+        probe_timeout_s=args.probe_timeout,
+        readmit_after=args.readmit_after,
+        dispatch_retries=args.dispatch_retries,
+        stuck_after_s=args.stuck_after,
+        retry_seed=args.retry_seed,
+        canary_bundle=args.canary_bundle,
+        canary_fraction=args.canary_fraction,
+        canary_window=args.canary_window,
+        canary_min_samples=args.canary_min_samples,
+        canary_max_err_increase=args.canary_max_error_increase,
+        canary_p99_ratio=args.canary_p99_ratio,
+        canary_attest_timeout_s=args.canary_attest_timeout,
+        canary_observe_timeout_s=args.canary_observe_timeout,
+        log_dir=args.log_dir,
+        metrics_interval_s=args.metrics_interval,
+        chaos=chaos,
+    )
+    install_graceful_signals(
+        router.request_shutdown,
+        "[router] {sig}: draining (second signal hard-kills)",
+    )
+    router.start()
+    print(
+        f"[router] listening on {router.host}:{router.port} "
+        f"backends={','.join(backends)}",
+        flush=True,
+    )
+    want = args.wait_replicas if args.wait_replicas is not None else len(backends)
+    if want:
+        admitted = router.wait_for_replicas(want, timeout_s=args.wait_timeout)
+        print(f"[router] admitted {admitted}/{len(backends)} replicas",
+              flush=True)
+    router.serve_until_shutdown()
+    snap = router.healthz()
+    print(
+        f"[router] drained: {snap['replies_ok']} ok, "
+        f"{snap['replies_overloaded']} overloaded, "
+        f"{snap['replies_error']} failed, "
+        f"retries={snap['retries']} ejections={snap['ejections']} "
+        f"p99={snap.get('p99_ms')} ms",
+        flush=True,
+    )
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

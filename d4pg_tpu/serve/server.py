@@ -69,10 +69,13 @@ def load_best_actor_params(run_dir: str, config):
 
 class PolicyServer:
     # d4pglint shared-mutable-state: the reload watcher thread is the ONLY
-    # writer of all four after start() (check_reload is watcher-only);
+    # writer of all five after start() (check_reload is watcher-only);
     # readers (healthz, conn threads) take atomic reference snapshots and
     # tolerate being one reload stale.
-    _THREAD_SAFE = ("bundle", "_bundle_mtime", "_best_mtime", "_last_reload")
+    _THREAD_SAFE = (
+        "bundle", "_bundle_mtime", "_best_mtime", "_last_reload",
+        "_serving_bundle_mtime",
+    )
 
     def __init__(
         self,
@@ -91,8 +94,13 @@ class PolicyServer:
         metrics_interval_s: float = 30.0,
         debug_guards: bool = False,
         chaos=None,
+        replica_id: Optional[int] = None,
     ):
         self.bundle = bundle
+        # Fleet attribution (--replica-id): stamped into healthz and every
+        # metrics.jsonl row so a multi-replica soak's logs are attributable
+        # per process without cross-referencing ports against pids.
+        self.replica_id = replica_id
         self.host = host
         self._requested_port = port
         self.port: Optional[int] = None
@@ -137,6 +145,15 @@ class PolicyServer:
         self._poll_interval_s = poll_interval_s
         self._bundle_mtime = (
             bundle_mtime(bundle.path) if self._watch_bundle else None
+        )
+        # The json mtime of the bundle this server is actually SERVING —
+        # the rollout version vector the replica front-end's prober keys
+        # on. Distinct from ``_bundle_mtime`` (the watch bookmark), which
+        # advances even when a reload FAILS: a canary offered a corrupt
+        # bundle must keep attesting the OLD version, or the router would
+        # promote a rollout nobody loaded.
+        self._serving_bundle_mtime = (
+            bundle_mtime(bundle.path) if bundle.path is not None else None
         )
         self._best_mtime = self._stat_best() if watch_run else None
         self._log_dir = log_dir
@@ -220,7 +237,7 @@ class PolicyServer:
         if self._metrics_thread is not None:
             self._metrics_thread.join(timeout=self._metrics_interval_s + 5)
         if self._metrics is not None:
-            self._metrics.log(self.stats.batches_total, self.stats.metrics_row())
+            self._metrics.log(self.stats.batches_total, self._metrics_row())
             self._metrics.close()
             self._metrics = None
         # Reader threads block in recv; closing the sockets unblocks them.
@@ -288,6 +305,7 @@ class PolicyServer:
                     self.batcher.set_obs_norm(fresh.obs_norm)
                     self.bundle = fresh
                     swapped = True
+                    self._serving_bundle_mtime = m
                     self._last_reload = "ok: bundle"
                     print(f"[serve] reloaded bundle {self.bundle.path}")
                 except Exception as e:
@@ -330,11 +348,20 @@ class PolicyServer:
                 print(f"[serve] reload watcher error: {e}")
 
     # ---------------------------------------------------------------- metrics
+    def _metrics_row(self) -> dict:
+        """Stats row with the replica identity stamped in (numeric-only,
+        per the MetricsLogger contract) — multi-replica soak logs stay
+        attributable per process."""
+        row = self.stats.metrics_row()
+        if self.replica_id is not None:
+            row["replica_id"] = float(self.replica_id)
+        return row
+
     def _metrics_loop(self) -> None:
         while not self._shutdown.wait(self._metrics_interval_s):
             self._metrics.log(
                 self.stats.batches_total,
-                self.stats.metrics_row(),
+                self._metrics_row(),
                 timers=self.batcher.timers,
             )
 
@@ -495,6 +522,13 @@ class PolicyServer:
         snap["buckets"] = list(self.batcher.buckets)
         snap["obs_dim"] = self.bundle.obs_dim
         snap["action_dim"] = self.bundle.action_dim
+        # Prober surface (docs/serving.md schema): the serving-bundle
+        # version vector (advances ONLY on successful reload), process
+        # identity for fleet attribution / chaos targeting, and the
+        # inflight/uptime_s gauges already in the stats snapshot.
+        snap["bundle_mtime"] = self._serving_bundle_mtime
+        snap["replica_id"] = self.replica_id
+        snap["pid"] = os.getpid()
         snap["stage_ms"] = {
             k: round(v, 4)
             for k, v in self.batcher.timers.summary_ms().items()

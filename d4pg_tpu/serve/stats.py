@@ -107,6 +107,10 @@ class ServeStats:
         self.padded_rows_total = 0
         self.params_version = 0
         self.params_reloads = 0
+        # Admitted-but-unanswered gauge (+1 at enqueue, −1 when the request
+        # future resolves — any way). The replica front-end's prober reads
+        # it from healthz for least-loaded dispatch across replicas.
+        self.inflight = 0
 
     def inc(self, field: str, by: int = 1) -> None:
         with self._lock:
@@ -122,6 +126,7 @@ class ServeStats:
         with self._lock:
             out = {
                 "uptime_s": round(time.monotonic() - self._t0, 3),
+                "inflight": self.inflight,
                 "requests_total": self.requests_total,
                 "replies_ok": self.replies_ok,
                 "shed_queue_full": self.shed_queue_full,

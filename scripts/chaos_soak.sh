@@ -200,4 +200,184 @@ print("CHAOS_SOAK_FLEET_OK", {k: s[k] for k in
        "reconnects", "bundle_reloads")})
 EOF
 
+# ---- leg 6: replicated serving fleet under replica kill + corrupt canary ---
+# Sustained closed-loop load through the router front-end across TWO
+# --debug-guards replicas while (a) one replica is SIGKILLed mid-stream,
+# restarted, and re-admitted, and (b) a CORRUPT canary bundle is offered
+# (router --chaos canary_corrupt truncates the deployed params) and must
+# auto-roll-back with the baseline replica never reloading. Contracts:
+# the accounting identity (every submitted request answered ok /
+# OVERLOADED / error — zero silent losses), zero recompiles on surviving
+# replicas (healthz compile_count flat + the sentinel's bucket budget
+# asserted by each replica's rc-0 drain), and metrics rows attributable
+# per replica (--replica-id).
+cp -r "$DIR/bundle" "$DIR/r0"
+cp -r "$DIR/bundle" "$DIR/r1"
+python - "$DIR" <<'EOF'
+import json, shutil, signal, sys, threading, time
+import numpy as np
+
+sys.path.insert(0, "scripts")
+from spawnlib import spawn
+
+d = sys.argv[1]
+
+
+def replica(rid, port=0):
+    return spawn(
+        [sys.executable, "-m", "d4pg_tpu.serve",
+         "--bundle", f"{d}/r{rid}", "--port", str(port),
+         "--max-batch", "8", "--max-wait-us", "500",
+         "--poll-interval", "0.2", "--replica-id", str(rid),
+         "--debug-guards", "--log-dir", f"{d}/r{rid}_logs",
+         "--metrics-interval", "2"],
+        f"replica{rid}",
+    )
+
+
+reps = [replica(0), replica(1)]
+ports = [r.wait_port(180) for r in reps]
+
+router = spawn(
+    [sys.executable, "-m", "d4pg_tpu.serve.router",
+     "--backends", ",".join(f"127.0.0.1:{p}" for p in ports),
+     "--backend-bundles", f"{d}/r0,{d}/r1",
+     "--port", "0", "--probe-interval", "0.2", "--readmit-after", "2",
+     "--canary-bundle", f"{d}/canary_src", "--canary-fraction", "0.5",
+     "--canary-min-samples", "10", "--canary-attest-timeout", "30",
+     "--chaos", "seed=11;canary_corrupt@1"],
+    "router",
+)
+rport = router.wait_port(120)
+for _ in range(300):
+    if any("admitted 2/2" in l for l in router.lines):
+        break
+    time.sleep(0.2)
+else:
+    raise SystemExit("CHAOS_SOAK_FAIL: router never admitted both replicas")
+
+from d4pg_tpu.serve.client import PolicyClient, Overloaded
+
+obs = np.array([0.1, -0.2, 0.05], np.float32)
+counts = {"ok": 0, "overloaded": 0, "error": 0}
+lock = threading.Lock()
+stop = threading.Event()
+
+
+def load_loop():
+    # one blocking chain: every act() resolves to exactly ONE outcome, so
+    # the client-side tally IS the accounting identity's left side
+    with PolicyClient("127.0.0.1", rport, timeout=60) as c:
+        while not stop.is_set():
+            try:
+                a = c.act(obs, timeout=60)
+                assert a.shape == (1,) and abs(float(a[0])) <= 2.0, a
+                k = "ok"
+            except Overloaded:
+                k = "overloaded"
+            except Exception:
+                k = "error"
+            with lock:
+                counts[k] += 1
+
+
+threads = [
+    threading.Thread(target=load_loop, name=f"load{i}", daemon=True)
+    for i in range(6)
+]
+for t in threads:
+    t.start()
+
+
+def healthz():
+    from d4pg_tpu.serve.protocol import probe_healthz
+
+    return probe_healthz("127.0.0.1", rport, timeout_s=5.0)
+
+
+def wait_for(cond, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.2)
+    raise SystemExit(f"CHAOS_SOAK_FAIL: timed out waiting for {what}")
+
+
+time.sleep(2)  # sustained load on the healthy fleet first
+
+# ---- (a) SIGKILL replica 0 mid-stream, restart, re-admission ---------------
+reps[0].proc.kill()
+print("[chaos-soak] SIGKILLed replica 0 under load", flush=True)
+wait_for(lambda: healthz()["admitted"] == 1, 60, "ejection of the dead replica")
+reps[0] = replica(0, port=ports[0])  # same address, fresh process
+reps[0].wait_port(180)
+wait_for(lambda: healthz()["admitted"] == 2, 120, "re-admission after restart")
+print("[chaos-soak] replica 0 restarted and re-admitted", flush=True)
+
+# ---- (b) offer a canary the router's chaos plan corrupts -------------------
+shutil.copytree(f"{d}/bundle", f"{d}/canary_src")
+wait_for(
+    lambda: healthz()["canary_rollbacks"] >= 1,
+    120,
+    "auto-rollback of the corrupt canary",
+)
+wait_for(
+    lambda: (lambda h: h["canary"]["state"] == "idle" and h["admitted"] == 2)(
+        healthz()
+    ),
+    120,
+    "rollback settle + canary re-admission",
+)
+print("[chaos-soak] corrupt canary rolled back", flush=True)
+
+time.sleep(2)  # load rides on the restored fleet
+stop.set()
+for t in threads:
+    t.join(timeout=90)
+    assert not t.is_alive(), "load thread wedged"
+
+h = healthz()
+submitted = sum(counts.values())
+assert submitted > 0 and counts["ok"] > 0, counts
+# identity (client side): every request answered ok / OVERLOADED / error
+# (error = failed-after-bounded-retry; the threads count every outcome)
+# identity (router side): every ACT it admitted was answered
+assert h["requests_total"] == h["answered_total"], (counts, h)
+assert h["canary_rollbacks"] == 1 and h["canary_promotions"] == 0, h
+assert h["ejections"] >= 2 and h["admissions"] >= 4, h  # kill + rollback
+# the corrupt deploy really fired and the rollback re-ejected the canary
+assert any("canary_rollback" in l for l in router.lines)
+# baseline (replica 0, restarted) NEVER reloaded; canary (replica 1)
+# recovered onto the restored bundle with its compiled programs intact
+from d4pg_tpu.serve.protocol import probe_healthz as probe
+
+h0 = probe("127.0.0.1", ports[0], timeout_s=5.0)
+h1 = probe("127.0.0.1", ports[1], timeout_s=5.0)
+assert h0["params_reloads"] == 0, h0
+assert h0["status"] == "ok" and h1["status"] == "ok", (h0, h1)
+assert h0["compile_count"] == 4 and h1["compile_count"] == 4, (h0, h1)
+assert h0["replica_id"] == 0 and h1["replica_id"] == 1
+
+# ---- graceful drains: rc 0 = sentinel bucket budgets + guards clean --------
+router.proc.send_signal(signal.SIGTERM)
+rc = router.proc.wait(timeout=120)
+assert rc == 0, f"router exit {rc}"
+for rid in (0, 1):
+    reps[rid].proc.send_signal(signal.SIGTERM)
+    rc = reps[rid].proc.wait(timeout=120)
+    assert rc == 0, f"replica {rid} exit {rc} (guards/sentinel not clean?)"
+
+# metrics attribution: every surviving replica's rows carry ITS replica_id
+for rid in (0, 1):
+    rows = [json.loads(l) for l in open(f"{d}/r{rid}_logs/metrics.jsonl")]
+    assert rows and all(r["replica_id"] == float(rid) for r in rows), rid
+
+print("CHAOS_SOAK_ROUTER_OK",
+      {"submitted": submitted, **counts,
+       "retries": h["retries"], "ejections": h["ejections"],
+       "admissions": h["admissions"],
+       "rollbacks": h["canary_rollbacks"]})
+EOF
+
 echo "CHAOS_SOAK_OK"

@@ -1,0 +1,123 @@
+#!/usr/bin/env bash
+# Router smoke: the replicated-serving path end to end through the real
+# CLIs — train → export-bundle → TWO serve replicas (--replica-id) →
+# router front-end → roundtrips → kill -9 one replica → roundtrips keep
+# succeeding through the failover → graceful drains. Wired into tier-1 via
+# tests/test_router_smoke.py; also runnable by hand:
+#
+#   scripts/router_smoke.sh
+#   ROUTER_SMOKE_DIR=/tmp/x scripts/router_smoke.sh
+#
+# Knobs (env vars): ROUTER_SMOKE_DIR (run dir, default mktemp),
+# ROUTER_SMOKE_STEPS (grad steps, default 2), ROUTER_SMOKE_HIDDEN
+# (MLP widths, default 16,16).
+#
+# Asserts: the router admits both replicas; requests through the router
+# answer inside the env's bounds; after a replica SIGKILL the survivors
+# keep answering (health-driven ejection + bounded failover), the router's
+# healthz records the ejection AND the accounting identity (answered ==
+# submitted); both the router and the surviving replica drain rc 0.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+RUN=${ROUTER_SMOKE_DIR:-$(mktemp -d /tmp/router_smoke.XXXXXX)}
+STEPS=${ROUTER_SMOKE_STEPS:-2}
+HIDDEN=${ROUTER_SMOKE_HIDDEN:-16,16}
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+
+echo "[router-smoke] run dir: $RUN"
+python train.py --env Pendulum-v1 --hidden-sizes "$HIDDEN" \
+  --total-steps "$STEPS" --warmup 16 --bsize 8 --rmsize 512 \
+  --eval-interval "$STEPS" --eval-episodes 2 \
+  --checkpoint-interval "$STEPS" --num-envs 1 \
+  --log-dir "$RUN"
+
+python train.py --env Pendulum-v1 --hidden-sizes "$HIDDEN" \
+  --log-dir "$RUN" --export-bundle "$RUN/bundle"
+
+# each replica serves its OWN bundle dir (the canary controller's contract:
+# the router rolls a replica forward by writing into its dir)
+cp -r "$RUN/bundle" "$RUN/replica0"
+cp -r "$RUN/bundle" "$RUN/replica1"
+
+python - "$RUN" <<'EOF'
+import signal, sys, time
+import numpy as np
+
+sys.path.insert(0, "scripts")
+from spawnlib import spawn
+
+run = sys.argv[1]
+
+replicas = []
+for rid in (0, 1):
+    replicas.append(
+        spawn(
+            [sys.executable, "-m", "d4pg_tpu.serve",
+             "--bundle", f"{run}/replica{rid}", "--port", "0",
+             "--max-batch", "8", "--max-wait-us", "500",
+             "--replica-id", str(rid)],
+            f"replica{rid}",
+        )
+    )
+ports = [r.wait_port(120) for r in replicas]
+
+router = spawn(
+    [sys.executable, "-m", "d4pg_tpu.serve.router",
+     "--backends", ",".join(f"127.0.0.1:{p}" for p in ports),
+     "--backend-bundles", f"{run}/replica0,{run}/replica1",
+     "--port", "0", "--probe-interval", "0.2", "--readmit-after", "2"],
+    "router",
+)
+rport = router.wait_port(120)
+for _ in range(600):
+    if any("admitted 2/2" in l for l in router.lines):
+        break
+    time.sleep(0.2)
+else:
+    raise SystemExit("ROUTER_SMOKE_FAIL: router never admitted both replicas")
+
+from d4pg_tpu.serve.client import PolicyClient
+
+obs = np.array([0.1, -0.2, 0.05], np.float32)
+with PolicyClient("127.0.0.1", rport) as c:
+    for _ in range(8):
+        a = c.act(obs, timeout=30)
+        assert a.shape == (1,) and abs(float(a[0])) <= 2.0, a
+    h = c.healthz()
+    assert h["router"] is True and h["admitted"] == 2, h
+    # --replica-id flows through healthz into the router's fleet view
+    assert sorted(r["replica_id"] for r in h["replicas"]) == [0, 1], h
+
+    # ---- kill -9 replica 0 mid-fleet: ejection + failover ------------------
+    replicas[0].proc.kill()
+    for _ in range(16):  # requests keep succeeding THROUGH the failure
+        a = c.act(obs, timeout=30)
+        assert a.shape == (1,) and abs(float(a[0])) <= 2.0, a
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        h = c.healthz()
+        if h["admitted"] == 1:
+            break
+        time.sleep(0.2)
+    assert h["admitted"] == 1, h
+    dead = next(r for r in h["replicas"] if not r["admitted"])
+    assert dead["replica_id"] == 0 and dead["ejected_reason"], dead
+    # accounting identity: every ACT answered, none silently lost
+    # (healthz frames don't count — 8 pre-kill + 16 through the failure)
+    assert h["requests_total"] == h["answered_total"] == 24, h
+    assert h["replies_error"] == 0, h
+
+router.proc.send_signal(signal.SIGTERM)
+rc = router.proc.wait(timeout=120)
+assert rc == 0, f"router exit code {rc}"
+assert any("drained" in l for l in router.lines), router.lines[-5:]
+
+replicas[1].proc.send_signal(signal.SIGTERM)
+rc = replicas[1].proc.wait(timeout=120)
+assert rc == 0, f"surviving replica exit code {rc}"
+replicas[0].proc.wait(timeout=30)
+print("ROUTER_SMOKE_ROUNDTRIP_OK")
+EOF
+
+echo "ROUTER_SMOKE_OK"

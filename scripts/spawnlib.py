@@ -1,0 +1,52 @@
+"""Shared subprocess harness for the CLI smoke/soak scripts' python legs.
+
+One copy on purpose (the ``clean_cpu_env`` / ``abortive_close`` dedup
+precedent): both ``scripts/router_smoke.sh`` and the chaos-soak router
+leg spawn serve/router CLIs, pump their stdout through a tagged tee, and
+scrape the ``listening on host:port`` startup line for the ephemeral
+port. The heredocs run from the repo root, so they import this with::
+
+    sys.path.insert(0, "scripts"); from spawnlib import spawn
+"""
+
+import subprocess
+import sys
+import threading
+
+
+class Spawned:
+    """A CLI subprocess with a stdout pump thread: ``lines`` collects
+    everything printed (tagged onto our stdout as it arrives), and the
+    first ``listening on host:port`` line parses into ``wait_port()``."""
+
+    def __init__(self, argv, tag):
+        self.tag = tag
+        self.proc = subprocess.Popen(
+            argv, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True
+        )
+        self.lines = []
+        self.port_event = threading.Event()
+        self._port_box = []
+        threading.Thread(
+            target=self._pump, name=f"pump-{tag}", daemon=True
+        ).start()
+
+    def _pump(self):
+        for line in self.proc.stdout:
+            sys.stdout.write(f"[{self.tag}] {line}")
+            self.lines.append(line)
+            if "listening on" in line and not self._port_box:
+                addr = line.split("listening on", 1)[1].split()[0]
+                self._port_box.append(int(addr.rsplit(":", 1)[1]))
+                self.port_event.set()
+        self.port_event.set()  # EOF: don't leave a waiter hanging
+
+    def wait_port(self, timeout=180.0):
+        assert self.port_event.wait(timeout) and self._port_box, (
+            f"{self.tag} never reported its port"
+        )
+        return self._port_box[0]
+
+
+def spawn(argv, tag):
+    return Spawned(argv, tag)

@@ -1,0 +1,89 @@
+"""Tier-1 smokes for the replica-front-end microbench.
+
+Two halves, mirroring the other benchmark smokes:
+
+- the GENERATOR runs end-to-end at a tiny shape (a refactor that breaks
+  ``bench_serve_router``/``run_microbench`` fails here, not at
+  artifact-regen time). The scaling RATIO is not asserted at this scale
+  (CPU noise), but the accounting identity is — zero silent losses during
+  the replica kill is a correctness contract, not a performance number;
+- the COMMITTED artifact (``benchmarks/router_microbench.json``) keeps its
+  schema and the acceptance headlines: ≥1.5× aggregate throughput at 2
+  replicas, availability ≥0.99 through an abrupt replica kill with the
+  identity holding exactly, and at least one ejection recorded (the kill
+  was real). Regenerate: ``JAX_PLATFORMS=cpu python
+  benchmarks/router_microbench.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+pytest.importorskip("jax")
+
+ARTIFACT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "benchmarks",
+    "router_microbench.json",
+)
+
+
+def test_generator_runs_at_small_shape(tmp_path):
+    from benchmarks.router_microbench import run_microbench
+
+    out_path = str(tmp_path / "router_microbench.json")
+    out = run_microbench(
+        out_path,
+        hidden=8,
+        max_batch=8,
+        conns=2,
+        window=4,
+        duration_s=0.5,
+        infer_delay_ms=20.0,
+        repeats=1,
+    )
+    with open(out_path) as f:
+        on_disk = json.load(f)
+    assert on_disk["metric"] == "router_microbench"
+    assert len(out["scaling"]) == 2
+    for row in out["scaling"]:
+        assert row["throughput_rps"] > 0
+        assert row["identity_ok"] is True and row["lost"] == 0
+    avail = out["availability"]
+    # the correctness half holds at ANY scale: the kill loses nothing
+    assert avail["identity_ok"] is True and avail["lost"] == 0
+    assert avail["ok"] + avail["overloaded"] + avail["error"] == avail["submitted"]
+    assert avail["router_ejections"] >= 1
+    assert out["ratio_repeats"] and out["scaling_2_over_1"] is not None
+
+
+def test_committed_artifact_meets_acceptance():
+    with open(ARTIFACT) as f:
+        art = json.load(f)
+    assert art["metric"] == "router_microbench"
+    assert art["backend"] == "cpu"  # chip-independent artifact
+    # scaling headline: a second replica buys real aggregate capacity
+    assert art["scaling_2_over_1"] >= 1.5
+    assert art["scaling"][0]["replicas"] == 1
+    assert art["scaling"][1]["replicas"] == 2
+    assert (
+        art["scaling"][1]["throughput_rps"]
+        > art["scaling"][0]["throughput_rps"]
+    )
+    # p99 must not blow up when the fleet doubles (same closed population)
+    assert art["scaling"][1]["p99_ms"] <= art["scaling"][0]["p99_ms"] * 1.5
+    # availability headline: a mid-stream replica kill costs at most 1% of
+    # requests (bounded-retry failover) and NEVER accounting integrity
+    avail = art["availability"]
+    assert avail["identity_ok"] is True and avail["lost"] == 0
+    assert avail["availability"] >= 0.99
+    assert avail["router_ejections"] >= 1
+    assert avail["router_retries"] >= 1
+    # the slow-device stub must stay labeled (the scaling regime claim
+    # depends on it — see the generator docstring)
+    assert art["infer_delay_ms"] > 0
+    assert art["config"]["infer_delay_ms"] > 0
+    assert len(art["ratio_repeats"]) == art["repeats"]

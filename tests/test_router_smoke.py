@@ -1,0 +1,38 @@
+"""Tier-1 router smoke: train → --export-bundle → two serve replicas →
+router front-end → roundtrips → replica ``kill -9`` → failover →
+graceful drains, through the real CLIs (``scripts/router_smoke.sh``), in
+subprocesses with a clean CPU backend.
+
+This is THE end-to-end smoke for the replicated-serving tier (conftest
+fast-tier policy): everything else router-related tests in-process
+(tests/test_router.py); only this one proves the shipped commands compose
+across three real processes.
+"""
+
+import os
+import subprocess
+import sys
+
+from conftest import clean_cpu_env
+
+
+def test_router_smoke_script(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = clean_cpu_env()
+    env["ROUTER_SMOKE_DIR"] = str(tmp_path / "run")
+    p = subprocess.run(
+        ["bash", os.path.join(repo, "scripts", "router_smoke.sh")],
+        capture_output=True,
+        text=True,
+        timeout=840,
+        env=env,
+        cwd=repo,
+    )
+    out = p.stdout + p.stderr
+    assert p.returncode == 0, out[-4000:]
+    assert "ROUTER_SMOKE_ROUNDTRIP_OK" in p.stdout, out[-4000:]
+    assert "ROUTER_SMOKE_OK" in p.stdout, out[-4000:]
+
+
+if __name__ == "__main__":
+    sys.exit(0)

@@ -53,6 +53,10 @@ HOST_ONLY_MODULES = (
     "d4pg_tpu/serve/protocol.py",
     "d4pg_tpu/serve/client.py",
     "d4pg_tpu/serve/stats.py",
+    # The replica front-end moves bytes and stat files, never tensors: M
+    # replicas own the devices, the router must restart in milliseconds —
+    # a JAX import here would also break the soak's kill/restart timing.
+    "d4pg_tpu/serve/router.py",
     # The collection fleet: actor hosts run env + a NumPy policy and must
     # never pull the JAX runtime (the whole point of the numpy-policy
     # contract); the ingest server is constructed by the trainer before
@@ -93,6 +97,7 @@ HOT_PATH_FUNCTIONS = (
     "d4pg_tpu/serve/batcher.py::DynamicBatcher._device_loop",
     "d4pg_tpu/serve/batcher.py::DynamicBatcher._reply_loop",
     "d4pg_tpu/serve/batcher.py::DynamicBatcher.submit",
+    "d4pg_tpu/serve/router.py::Router._pick",
 )
 
 # The jit-traced bodies of the device-resident data plane (the megastep

@@ -55,6 +55,46 @@ def check_benchmark_json(path: str) -> list[str]:
     return errs
 
 
+def check_router_microbench(path: str) -> list[str]:
+    """Shape check for ``benchmarks/router_microbench.json`` beyond the
+    generic benchmark rule: the regression smoke and the ROADMAP
+    availability headline parse these exact fields, so a hand-edited or
+    half-regenerated artifact must fail lint, not the smoke."""
+    errs = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: unreadable/invalid JSON ({e})"]
+    for key in ("backend", "scaling", "scaling_2_over_1", "availability",
+                "ratio_repeats"):
+        if key not in doc:
+            errs.append(f"{path}: missing top-level key {key!r}")
+    scaling = doc.get("scaling")
+    if not (isinstance(scaling, list) and len(scaling) >= 2):
+        errs.append(f"{path}: 'scaling' must list >= 2 replica-count rows")
+    else:
+        for i, row in enumerate(scaling):
+            for key in ("replicas", "throughput_rps", "p99_ms",
+                        "identity_ok", "submitted"):
+                if key not in row:
+                    errs.append(f"{path}: scaling[{i}] missing {key!r}")
+    avail = doc.get("availability")
+    if not isinstance(avail, dict):
+        errs.append(f"{path}: 'availability' must be an object")
+    else:
+        for key in ("availability", "identity_ok", "lost", "submitted",
+                    "router_retries", "router_ejections", "p99_ms"):
+            if key not in avail:
+                errs.append(f"{path}: availability missing {key!r}")
+        if avail.get("identity_ok") is not True:
+            errs.append(
+                f"{path}: availability.identity_ok is not true — the "
+                "committed artifact must never attest a silent loss"
+            )
+    return errs
+
+
 def check_metrics_jsonl(path: str, max_rows: int | None = None) -> list[str]:
     """Problems with one metrics.jsonl ([] = clean)."""
     errs = []
@@ -97,6 +137,8 @@ def check_tree(root: str) -> list[str]:
     errs = []
     for path in sorted(glob.glob(os.path.join(root, "benchmarks", "*.json"))):
         errs.extend(check_benchmark_json(path))
+        if os.path.basename(path) == "router_microbench.json":
+            errs.extend(check_router_microbench(path))
     for path in sorted(
         glob.glob(os.path.join(root, "runs", "**", "metrics.jsonl"),
                   recursive=True)
