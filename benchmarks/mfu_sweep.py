@@ -18,21 +18,28 @@ claim checkable rather than asserted:
    mlp256 / B >= 512 shapes where points 1-3 measured the 9% -> 53% MFU
    headroom — the data plane that exists to close exactly that gap, with
    ``transfer_bytes_per_grad_step`` 0 by construction and ``mfu`` from the
-   same single-step XLA cost model as every other row.
+   same single-step XLA cost model as every other row;
+5. the SHARDED megastep (``--replay-placement device --dp N``): the same
+   loop spanning the dp mesh (striped sharded ring, shard-local draws,
+   deterministic grad mean — ROADMAP item 2) at the wide shapes where tp/
+   stack sharding is load-bearing, transfer bytes still 0.
 
 Points 1-3 run through ``bench.bench_tpu`` (device-resident pool, fused
-K-step scan); point 4 through ``bench.bench_megastep`` (device ring +
-in-kernel draw) — the SAME pinned timing protocol (pipelined dispatches,
-donated state, value-transfer sync), parameterized rather than copied, so
-the rows can never drift apart.
+K-step scan); points 4-5 through ``bench.bench_megastep`` (device ring +
+in-kernel draw; ``dp=`` for the sharded rows) — the SAME pinned timing
+protocol (pipelined dispatches, donated state, value-transfer sync),
+parameterized rather than copied, so the rows can never drift apart.
 
 Run on the real chip:        python benchmarks/mfu_sweep.py
 CPU-interpret megastep rows: JAX_PLATFORMS=cpu \
                              python benchmarks/mfu_sweep.py --megastep-only
-(--megastep-only keeps the committed on-chip rows for points 1-3 — the
-TPU tunnel has been down since round 5 — and replaces only the megastep
-rows, each tagged with the backend that produced it; rerun WITHOUT the
-flag on the TPU VM to refresh everything on-chip.)
+CPU sharded rows:            JAX_PLATFORMS=cpu \
+                             python benchmarks/mfu_sweep.py --sharded-only
+(--megastep-only / --sharded-only keep the committed on-chip rows — the
+TPU tunnel has been down since round 5 — and replace only their own row
+family, each tagged with the backend that produced it; rerun WITHOUT the
+flags on the TPU VM to refresh everything on-chip. ``--sharded`` adds
+the sharded rows to a full refresh.)
 
 Prints one JSON line per point and writes benchmarks/mfu_sweep_results.json.
 """
@@ -123,11 +130,75 @@ def megastep_rows() -> list[dict]:
     return rows
 
 
+def sharded_point(batch: int, dp: int, *, hidden: int = 256,
+                  k_steps: int = 32, steps: int = 4) -> dict:
+    """One SHARDED megastep row (runtime/megastep.py:
+    make_megastep_uniform_sharded): dp-sharded ring + shard-local draws,
+    transfer bytes 0 by construction. Wide-shape points because that is
+    where sharding is load-bearing (53% MFU only at MXU-friendly widths,
+    mfu_sweep_results.json) — on CPU the steps/s is a placeholder like
+    every other cpu-tagged row; the zero-transfer column is the
+    chip-independent half."""
+    import jax
+
+    if jax.device_count() < dp:
+        raise RuntimeError(
+            f"sharded_point(dp={dp}) needs {dp} devices, have "
+            f"{jax.device_count()} — on CPU run via the __main__ entry "
+            "(it configures the virtual mesh) or set "
+            "--xla_force_host_platform_device_count"
+        )
+    out = bench_megastep(
+        placement="device", batch=batch, k=k_steps, steps=steps,
+        hidden=hidden, dp=dp,
+    )
+    row = {
+        "bench": "mfu_sweep",
+        "config": f"sharded_megastep_mlp{hidden}",
+        "batch": batch,
+        "dp": dp,
+        "compute_dtype": "float32",
+        "backend": jax.default_backend(),
+        "steps_per_sec": round(out["steps_per_sec"], 1),
+        "transfer_bytes_per_grad_step": out["transfer_bytes_per_grad_step"],
+    }
+    if jax.default_backend() == "cpu":
+        row["note"] = (
+            "CPU virtual-mesh placeholder (TPU tunnel down); rerun "
+            "benchmarks/mfu_sweep.py --sharded on a multi-chip VM for "
+            "real scaling"
+        )
+    return row
+
+
+def sharded_rows() -> list[dict]:
+    rows = []
+    # The wide shapes the sharding exists for: flagship width at large
+    # batch, then the MXU width (hidden 1024 shards 128-wide per tp rank
+    # at dp=8... dp-only mesh: batch splits 8-way, ring splits 8-way).
+    for batch, hidden in ((512, 256), (1024, 512)):
+        rows.append(sharded_point(batch, dp=8, hidden=hidden))
+        print(json.dumps(rows[-1]), flush=True)
+    return rows
+
+
+def _replace_family(rows: list[dict], prefix: str, new_rows: list[dict]) -> list[dict]:
+    """Drop rows whose config starts with ``prefix`` and append the fresh
+    ones — the committed on-chip rows for every OTHER family survive a
+    partial regen (the --megastep-only precedent)."""
+    kept = [r for r in rows if not str(r.get("config", "")).startswith(prefix)]
+    return kept + new_rows
+
+
 def main(argv=None) -> None:
     argv = sys.argv[1:] if argv is None else argv
-    if "--megastep-only" in argv:
-        # Keep the committed on-chip rows (points 1-3) and replace only
-        # the megastep rows — the artifact stays a list of sweep rows.
+    if "--sharded-only" in argv:
+        with open(RESULTS) as f:
+            rows = _replace_family(json.load(f), "sharded_megastep", sharded_rows())
+    elif "--megastep-only" in argv:
+        # Keep the committed on-chip rows and replace only the megastep
+        # family — sharded_megastep rows survive too (prefix-disjoint:
+        # "megastep" filters on the exact family, not the substring).
         with open(RESULTS) as f:
             rows = [
                 r for r in json.load(f)
@@ -154,10 +225,24 @@ def main(argv=None) -> None:
         print(json.dumps(rows[-1]), flush=True)
         # 4. the megastep data plane at the headroom shapes
         rows.extend(megastep_rows())
+        # 5. the sharded megastep at the wide shapes (opt-in on a full
+        #    refresh: needs a multi-device backend)
+        if "--sharded" in argv:
+            rows.extend(sharded_rows())
     with open(RESULTS, "w") as f:
         json.dump(rows, f, indent=1)
     print(f"[mfu_sweep] wrote {RESULTS}", file=sys.stderr)
 
 
 if __name__ == "__main__":
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu" and (
+        "--sharded" in sys.argv or "--sharded-only" in sys.argv
+    ):
+        # CPU virtual mesh for the sharded rows (before any jax backend
+        # init — bench.py imports jax lazily inside its functions).
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
     main()

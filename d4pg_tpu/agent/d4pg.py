@@ -71,24 +71,52 @@ def support_of(config: D4PGConfig) -> CategoricalSupport:
     return make_support(config.dist.v_min, config.dist.v_max, config.dist.num_atoms)
 
 
+def _stacked_critics(config: D4PGConfig) -> int:
+    """Leading critic-stack size: 2 (twin), E (ensemble), or 0 (single).
+
+    Twin and ensemble are mutually exclusive — the ensemble subsumes the
+    twin (E=2, M=2 is exactly clipped double-Q with a per-step subset
+    redraw that happens to always pick both)."""
+    if config.critic_ensemble:
+        if config.twin_critic:
+            raise ValueError(
+                "critic_ensemble and twin_critic are mutually exclusive: "
+                "an E=2, ensemble_min_targets=2 ensemble IS the twin"
+            )
+        if config.critic_ensemble < 2:
+            raise ValueError(
+                f"critic_ensemble must be >= 2 (got "
+                f"{config.critic_ensemble}); 0 disables"
+            )
+        if not 1 <= config.ensemble_min_targets <= config.critic_ensemble:
+            raise ValueError(
+                f"ensemble_min_targets must be in [1, critic_ensemble="
+                f"{config.critic_ensemble}], got {config.ensemble_min_targets}"
+            )
+        return config.critic_ensemble
+    return 2 if config.twin_critic else 0
+
+
 def create_train_state(config: D4PGConfig, key: jax.Array) -> TrainState:
     """Initialize params, hard-copy targets (reference ``ddpg.py:57-64,92-94``).
 
     With ``config.twin_critic`` the critic pytree carries a leading [2]
     axis (two independent inits); Adam moments and Polyak targets stack
     along with it, and :func:`train_step` vmaps the critic over it.
+    ``config.critic_ensemble`` generalizes the same stacking to E
+    independent inits (REDQ).
     """
     actor, critic = build_networks(config)
     k_actor, k_critic, k_state = jax.random.split(key, 3)
     obs = jnp.zeros((1, config.obs_dim))
     action = jnp.zeros((1, config.action_dim))
     actor_params = actor.init(k_actor, obs)
-    if config.twin_critic:
-        k_c1, k_c2 = jax.random.split(k_critic)
+    n_stack = _stacked_critics(config)
+    if n_stack:
+        stack_keys = jax.random.split(k_critic, n_stack)
         critic_params = jax.tree_util.tree_map(
-            lambda a, b: jnp.stack([a, b]),
-            critic.init(k_c1, obs, action),
-            critic.init(k_c2, obs, action),
+            lambda *leaves: jnp.stack(leaves),
+            *[critic.init(k, obs, action) for k in stack_keys],
         )
     else:
         critic_params = critic.init(k_critic, obs, action)
@@ -233,6 +261,7 @@ def train_step(
     state: TrainState,
     batch: Mapping[str, jax.Array],
     axis_name: str | None = None,
+    sync_fn=None,
 ) -> tuple[TrainState, Mapping[str, jax.Array], jax.Array]:
     """One full D4PG SGD step (the reference §3.2 hot loop, fused).
 
@@ -249,12 +278,20 @@ def train_step(
         ``shared_adam.py``): each device computes grads on its batch shard,
         one AllReduce over ICI averages them, every replica applies the same
         Adam update. ``None`` → single-device semantics.
+      sync_fn: overrides the cross-shard combine entirely (a ``tree ->
+        tree`` callable). The sharded megastep passes the DETERMINISTIC
+        mean (``parallel.dp.det_pmean``: all_gather + fixed-order sum),
+        whose bits a single-device vmap oracle can replay exactly —
+        ``pmean``'s backend AllReduce cannot be (its accumulation order is
+        the backend's choice). ``None`` keeps the pmean/axis_name path.
 
     Returns:
       (new_state, metrics, priorities[B] — local shard under shard_map).
     """
 
     def _sync(tree):
+        if sync_fn is not None:
+            return sync_fn(tree)
         if axis_name is None:
             return tree
         return jax.lax.pmean(tree, axis_name)
@@ -310,7 +347,27 @@ def train_step(
 
     # ---- target: y = Φ(r + γ_eff · Z_target(s', μ_target(s'))) ----
     next_action = actor.apply(tgt_actor_params, batch["next_obs"])
-    if config.twin_critic:
+    if config.critic_ensemble:
+        # REDQ in-target minimization, distributionally: back up whichever
+        # member of a per-step RANDOM SUBSET of M target critics has the
+        # smallest expected value, per sample — the whole distribution of
+        # the argmin member, same rationale as the twin branch below
+        # (an elementwise min of probs would not be a distribution).
+        E = config.critic_ensemble
+        M = config.ensemble_min_targets
+        heads = jax.vmap(
+            lambda p: critic.apply(p, batch["next_obs"], next_action)
+        )(tgt_critic_params)                                    # [E, B, H]
+        vals = jax.vmap(lambda h: _critic_value(config, support, h))(heads)
+        k_subset, new_key = jax.random.split(new_key)
+        subset = jax.random.permutation(k_subset, E)[:M]        # [M]
+        sub_vals = vals[subset]                                 # [M, B]
+        sub_heads = heads[subset]                               # [M, B, H]
+        which = jnp.argmin(sub_vals, axis=0)                    # [B]
+        target_head = jnp.take_along_axis(
+            sub_heads, which[None, :, None], axis=0
+        )[0]                                                    # [B, H]
+    elif config.twin_critic:
         # Clipped double-Q, distributionally: back up whichever target
         # critic's WHOLE distribution has the smaller mean, per sample —
         # the distributional analogue of TD3's min(Q1, Q2) (taking an
@@ -433,10 +490,11 @@ def train_step(
     else:
         raise ValueError(config.dist.kind)
 
-    if config.twin_critic:
-        # Both critics regress the same clipped-min target; one vmap over
-        # the stacked params turns the single-critic loss into both. PER
-        # priority = mean of the two TD magnitudes (less noisy than either).
+    if config.twin_critic or config.critic_ensemble:
+        # Every stacked critic (twin pair or E-wide ensemble) regresses
+        # the same min target; one vmap over the stacked params turns the
+        # single-critic loss into all of them. PER priority = mean of the
+        # stack's TD magnitudes (less noisy than any one member).
         _single_loss_fn = critic_loss_fn
 
         def critic_loss_fn(stacked_params):
@@ -453,7 +511,9 @@ def train_step(
     critic_params = optax.apply_updates(state.critic_params, critic_updates)
 
     # ---- actor: maximize E[Q(s, μ(s))] against the UPDATED critic ----
-    # (critic 0 under twin critics — TD3 convention)
+    # (critic 0 under twin critics — TD3 convention; the ensemble-MEAN
+    # value under REDQ — averaging E critics' gradients is what lets the
+    # aggressive min-subset target stay trainable)
     actor_critic_params = (
         jax.tree_util.tree_map(lambda x: x[0], critic_params)
         if config.twin_critic
@@ -462,8 +522,15 @@ def train_step(
 
     def actor_loss_fn(actor_params):
         a = actor.apply(actor_params, batch["obs"])
-        head = critic.apply(actor_critic_params, batch["obs"], a)
-        q_mean = jnp.mean(_critic_value(config, support, head))
+        if config.critic_ensemble:
+            heads = jax.vmap(
+                lambda p: critic.apply(p, batch["obs"], a)
+            )(critic_params)                                    # [E, B, H]
+            q = jax.vmap(lambda h: _critic_value(config, support, h))(heads)
+            q_mean = jnp.mean(q)          # mean over members AND batch
+        else:
+            head = critic.apply(actor_critic_params, batch["obs"], a)
+            q_mean = jnp.mean(_critic_value(config, support, head))
         loss = -q_mean
         if config.action_l2:
             # HER-DDPG action regularizer (Andrychowicz et al. 2017, §4.4:
@@ -502,11 +569,12 @@ def train_step(
         actor_opt_state=actor_opt_state,
         critic_opt_state=critic_opt_state,
     )
+    n_stack = _stacked_critics(config)
     step_metrics = {
-        # Per-critic scale: the twin loss SUMS both critics (right for
+        # Per-critic scale: the stacked loss SUMS its members (right for
         # the gradient), but the logged metric must stay comparable to
         # single-critic runs.
-        "critic_loss": critic_loss / 2 if config.twin_critic else critic_loss,
+        "critic_loss": critic_loss / n_stack if n_stack else critic_loss,
         "actor_loss": actor_loss,
         "priority_mean": jnp.mean(priorities),
         # From the loss aux, NOT -actor_loss: with action_l2 the loss
@@ -554,17 +622,21 @@ def fused_train_scan(
     state: TrainState,
     batches: dict,
     axis_name: str | None = None,
+    sync_fn=None,
 ):
     """Scan ``train_step`` over pre-gathered [K, B] batches — the shared
     inner loop of the on-device trainer, the benchmark, and the host
     trainer's ``steps_per_dispatch`` mode (one dispatch per K grad steps
     amortizes per-call latency, which dominates on remote/tunneled TPUs).
-    ``axis_name`` threads through to each step's gradient pmean (DP under
-    shard_map). Returns (state, metrics pytree with leading K axis,
+    ``axis_name``/``sync_fn`` thread through to each step's gradient
+    combine (DP under shard_map; the sharded megastep's deterministic
+    mean). Returns (state, metrics pytree with leading K axis,
     priorities [K, B])."""
 
     def body(st, batch):
-        st, metrics, priorities = train_step(config, st, batch, axis_name=axis_name)
+        st, metrics, priorities = train_step(
+            config, st, batch, axis_name=axis_name, sync_fn=sync_fn
+        )
         return st, (metrics, priorities)
 
     state, (metrics, priorities) = jax.lax.scan(body, state, batches)

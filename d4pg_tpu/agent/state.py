@@ -102,6 +102,23 @@ class D4PGConfig:
     # a leading [2] axis; the actor trains against critic 0 (TD3
     # convention); PER priorities average the two critics' TD magnitudes.
     twin_critic: bool = False
+    # REDQ-style critic ensemble (Chen et al. 2021), the capacity arc the
+    # sharded learner unlocks (ROADMAP item 2): E independent critics
+    # stacked on a leading [E] axis (params/targets/opt-state — the twin
+    # stack generalized), each Bellman target taking the min over a RANDOM
+    # SUBSET of ``ensemble_min_targets`` target critics (redrawn per grad
+    # step from the TrainState key), the actor ascending the ensemble-MEAN
+    # value. 0 disables (the single/twin paths are byte-unchanged); E >= 2
+    # enables and is mutually exclusive with twin_critic (the ensemble
+    # subsumes it). The stack axis is a first-class mesh-shardable dim in
+    # the partition rules (parallel/partition.py:stack_axes_for), so wide
+    # ensembles shard members across the mesh instead of replicating E×
+    # the params.
+    critic_ensemble: int = 0
+    # Size M of the random target subset (REDQ's in-target minimization):
+    # min over M of E controls the under/overestimation trade — M=2 is
+    # the paper's setting; M=E recovers "min over all".
+    ensemble_min_targets: int = 2
 
 
 class TrainState(struct.PyTreeNode):

@@ -9,23 +9,36 @@ optimizer state and the step counter living in the train state itself.
 """
 
 from d4pg_tpu.parallel.mesh import make_mesh
-from d4pg_tpu.parallel.dp import make_dp_train_step
+from d4pg_tpu.parallel.dp import det_pmean, make_dp_train_step
 from d4pg_tpu.parallel.partition import (
     DEFAULT_RULES,
+    DEFAULT_STACK_AXES,
+    RING_RULES,
+    apply_fns,
     auto_parallel_train_step,
+    make_shard_and_gather_fns,
     match_partition_rules,
+    ring_partition_specs,
     shard_batch,
     shard_train_state,
+    stack_axes_for,
 )
 from d4pg_tpu.parallel.distributed import initialize_distributed
 
 __all__ = [
     "make_mesh",
     "make_dp_train_step",
+    "det_pmean",
     "DEFAULT_RULES",
+    "DEFAULT_STACK_AXES",
+    "RING_RULES",
+    "apply_fns",
     "auto_parallel_train_step",
+    "make_shard_and_gather_fns",
     "match_partition_rules",
+    "ring_partition_specs",
     "shard_batch",
     "shard_train_state",
+    "stack_axes_for",
     "initialize_distributed",
 ]

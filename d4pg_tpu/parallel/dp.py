@@ -71,6 +71,36 @@ def make_dp_fused_train_step(config: D4PGConfig, mesh: Mesh, donate: bool = True
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
 
+def det_pmean(tree, axis_name: str, size: int):
+    """Deterministic cross-shard mean: ``all_gather`` + FIXED-ORDER
+    sequential sum + divide, in place of ``pmean``.
+
+    ``pmean`` lowers to the backend's AllReduce, whose f32 accumulation
+    order is the backend's choice — measured on this container's XLA CPU
+    it happens to accumulate in device order, but nothing pins that, and
+    on real ICI it is a ring/tree. This combine makes the order part of
+    the PROGRAM: the gather is exact (no arithmetic), the sum runs shard
+    0→N−1 unrolled, so the identical function under a single-device
+    ``vmap`` with the same ``axis_name`` replays the sharded math
+    BIT-EXACTLY — the byte-identity contract of the sharded megastep's
+    parity oracle (runtime/megastep.py). ``size`` is the static axis size
+    (the unroll bound; shard count, so single digits).
+
+    Cost vs pmean: the gather moves ``size``× the bytes of a reduce —
+    irrelevant for this model family's grads on ICI, and the price of a
+    replayable reduction.
+    """
+
+    def _mean(t):
+        g = jax.lax.all_gather(t, axis_name)  # [size, ...] exact
+        acc = g[0]
+        for i in range(1, size):
+            acc = acc + g[i]
+        return acc / size
+
+    return jax.tree.map(_mean, tree)
+
+
 def _pmean_floats(tree, axis_name: str):
     """pmean the float leaves; pass integer leaves (Adam's step count, the
     TrainState step counter) through unchanged — every replica advanced

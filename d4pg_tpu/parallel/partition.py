@@ -39,6 +39,45 @@ DEFAULT_RULES: Sequence[tuple[str, P]] = (
     (r".*", P()),
 )
 
+# Stacked-variant declarations: ``(size, stack_axis)`` pairs. The rules
+# above are written against a param's own [in, out] (or [out]) shape; a
+# leaf whose rank exceeds its matched rule's by EXACTLY ONE and whose
+# leading dim equals a DECLARED size is a stacked variant of that param
+# (twin critics stack two critics on axis 0, a REDQ ensemble stacks E —
+# agent/state.py), so the declared ``stack_axis`` (None = replicate, or a
+# mesh axis name to spread members across it) becomes the leading spec
+# entry and the rule applies to the trailing dims. Undeclared leading
+# sizes (e.g. a conv kernel's width matching a dense-written rule) fall
+# through to the replication fallback instead of silently gaining a
+# stacked axis — the declaration IS the gate (the old hardcoded
+# ``shape[0] == 2`` check, made rule-data instead of code).
+DEFAULT_STACK_AXES: Sequence[tuple[int, str | None]] = ((2, None),)
+
+# The device replay ring (replay/device_ring.py:DeviceRing): transition
+# rows shard over "dp" on the capacity axis — each dp shard owns its row
+# slice and the megastep's gathers stay shard-local; the fill-count
+# scalar replicates. Matched against the DeviceRing FIELD NAMES.
+RING_RULES: Sequence[tuple[str, P]] = (
+    (r"obs|action|next_obs", P("dp", None)),
+    (r"reward|discount", P("dp")),
+    (r"size", P()),
+    (r".*", P()),
+)
+
+
+def stack_axes_for(config, ensemble_axis: str | None = None):
+    """The stacked-variant declarations for a config: the twin pair always
+    (its stack replicates), plus — when ``config.critic_ensemble`` is set —
+    the E-wide ensemble stack, optionally sharded over ``ensemble_axis``
+    ("tp" spreads members across the tensor axis: each device holds E/tp
+    whole critics, the expert-parallel layout; members are data-independent
+    so GSPMD inserts no per-layer collectives for them)."""
+    axes = list(DEFAULT_STACK_AXES)
+    ensemble = getattr(config, "critic_ensemble", 0)
+    if ensemble:
+        axes.append((int(ensemble), ensemble_axis))
+    return tuple(axes)
+
 
 def _spec_fits(spec: P, shape, mesh: Mesh | None) -> bool:
     """A spec fits iff every sharded dimension divides its mesh axis size."""
@@ -54,7 +93,12 @@ def _spec_fits(spec: P, shape, mesh: Mesh | None) -> bool:
     return True
 
 
-def match_partition_rules(rules: Sequence[tuple[str, P]], tree, mesh: Mesh | None = None):
+def match_partition_rules(
+    rules: Sequence[tuple[str, P]],
+    tree,
+    mesh: Mesh | None = None,
+    stack_axes: Sequence[tuple[int, str | None]] = DEFAULT_STACK_AXES,
+):
     """Map each param leaf to the PartitionSpec of its first matching rule
     (pattern as in public fmengine/EasyLM-style ``match_partition_rules``).
 
@@ -62,8 +106,16 @@ def match_partition_rules(rules: Sequence[tuple[str, P]], tree, mesh: Mesh | Non
     (e.g. the critic's concat layer whose fan-in is hidden+action_dim) falls
     back to replication instead of erroring — odd-shaped leaves replicate,
     big regular matmuls shard.
+
+    ``stack_axes`` declares which leading-dim sizes are stacked variants of
+    a dense-written rule and how the stack axis shards (see
+    ``DEFAULT_STACK_AXES``): an E-wide critic ensemble declares ``(E,
+    axis)`` via :func:`stack_axes_for`, and any UNdeclared extra leading
+    dim falls back to replication rather than silently gaining a stacked
+    axis.
     """
 
+    declared_stacks = dict(stack_axes)
     flat = jax.tree_util.tree_flatten_with_path(tree)
     specs = []
     for path, leaf in flat[0]:
@@ -76,25 +128,34 @@ def match_partition_rules(rules: Sequence[tuple[str, P]], tree, mesh: Mesh | Non
             continue
         for pattern, spec in rules:
             if re.search(pattern, name):
-                # Rules are written against a param's own [in, out] (or
-                # [out]) shape. A leaf with ONE extra leading dim of
-                # EXACTLY 2 is a stacked variant of the same param (twin
-                # critics stack two critics on axis 0, agent/state.py):
-                # replicate the stack axis and apply the rule to the
-                # trailing dims — otherwise the specs would silently shard
-                # the wrong dimensions. The shape[0]==2 gate keeps future
-                # higher-rank params (e.g. a conv kernel matching a
-                # dense-written rule) out of this branch — they fall to the
-                # _spec_fits replication fallback instead of silently
-                # gaining a replicated leading axis (ADVICE round-3).
+                # A leaf with ONE extra leading dim of a DECLARED stack
+                # size is a stacked variant of the matched param: the
+                # declared axis leads the spec (None = replicate the
+                # stack, a mesh axis = shard members over it) and the rule
+                # applies to the trailing dims — otherwise the spec would
+                # silently shard the wrong dimensions.
                 if (
                     len(spec)
                     and np.ndim(leaf) == len(spec) + 1
-                    and shape[0] == 2
+                    and shape[0] in declared_stacks
                 ):
-                    spec = P(None, *spec)
+                    stack_ax = declared_stacks[shape[0]]
+                    trailing = tuple(spec)
+                    if stack_ax is not None:
+                        # Member-parallel layout: sharding the stack axis
+                        # over a mesh axis keeps each member WHOLE on its
+                        # devices, so trailing uses of the same axis are
+                        # dropped (a NamedSharding may name an axis once).
+                        trailing = tuple(
+                            None
+                            if a == stack_ax
+                            or (isinstance(a, tuple) and stack_ax in a)
+                            else a
+                            for a in trailing
+                        )
+                    spec = P(stack_ax, *trailing)
                 if len(spec) not in (0, np.ndim(leaf)):
-                    # Rank still disagrees after the twin-stack gate (a
+                    # Rank still disagrees after the stack gate (a
                     # higher-rank param matching a dense-written rule):
                     # replicate rather than let a short spec silently
                     # shard whichever leading dims it happens to prefix.
@@ -106,13 +167,16 @@ def match_partition_rules(rules: Sequence[tuple[str, P]], tree, mesh: Mesh | Non
     return jax.tree_util.tree_unflatten(flat[1], specs)
 
 
-def _state_specs(state: TrainState, rules, mesh: Mesh | None = None) -> TrainState:
+def _state_specs(
+    state: TrainState, rules, mesh: Mesh | None = None,
+    stack_axes=DEFAULT_STACK_AXES,
+) -> TrainState:
     """PartitionSpecs for a whole TrainState: params & targets & optimizer
     moments follow the param rules (optax moments mirror param pytrees);
     step/key replicated."""
 
     def spec_like(tree):
-        return match_partition_rules(rules, tree, mesh)
+        return match_partition_rules(rules, tree, mesh, stack_axes)
 
     return TrainState(
         step=P(),
@@ -126,15 +190,60 @@ def _state_specs(state: TrainState, rules, mesh: Mesh | None = None) -> TrainSta
     )
 
 
-def shard_train_state(state: TrainState, mesh: Mesh, rules=DEFAULT_RULES) -> TrainState:
-    """Place a TrainState onto the mesh per the partition rules."""
-    specs = _state_specs(state, rules, mesh)
-    return jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-        state,
+def make_shard_and_gather_fns(specs, mesh: Mesh):
+    """``(shard_fns, gather_fns)`` pytrees from a pytree of PartitionSpecs
+    (the public EasyLM/fmengine ``make_shard_and_gather_fns`` shape).
+
+    ``shard_fns``: leaf-wise callables placing a host (or differently-
+    placed) array onto the mesh under its rule's ``NamedSharding`` — the
+    ``--resume`` re-shard path (Orbax hands back host-resident leaves; a
+    bare ``device_put`` would commit them UNsharded and the first sharded
+    dispatch would silently reshard every step).
+    ``gather_fns``: leaf-wise callables fetching a (possibly sharded)
+    array fully assembled to host numpy — the checkpoint-save path, so
+    Orbax always serializes whole logical arrays regardless of mesh
+    layout and a checkpoint written on one mesh restores onto any other.
+    """
+    is_spec = lambda x: isinstance(x, P)  # noqa: E731 - tree_map leaf test
+    shard_fns = jax.tree_util.tree_map(
+        lambda s: partial(jax.device_put, device=NamedSharding(mesh, s)),
         specs,
-        is_leaf=lambda x: isinstance(x, P),
+        is_leaf=is_spec,
     )
+    gather_fns = jax.tree_util.tree_map(
+        lambda s: lambda x: np.asarray(jax.device_get(x)),
+        specs,
+        is_leaf=is_spec,
+    )
+    return shard_fns, gather_fns
+
+
+def apply_fns(fns, tree):
+    """Apply a pytree of leaf-wise callables (from
+    :func:`make_shard_and_gather_fns`) to a matching pytree of arrays."""
+    return jax.tree_util.tree_map(lambda f, x: f(x), fns, tree)
+
+
+def shard_train_state(
+    state: TrainState, mesh: Mesh, rules=DEFAULT_RULES,
+    stack_axes=DEFAULT_STACK_AXES,
+) -> TrainState:
+    """Place a TrainState onto the mesh per the partition rules."""
+    specs = _state_specs(state, rules, mesh, stack_axes)
+    shard_fns, _ = make_shard_and_gather_fns(specs, mesh)
+    return apply_fns(shard_fns, state)
+
+
+def ring_partition_specs(ring) -> "DeviceRing":  # noqa: F821 - duck-typed
+    """PartitionSpecs for a :class:`~d4pg_tpu.replay.device_ring.DeviceRing`
+    from the ``RING_RULES`` registry: rows shard over "dp" on the capacity
+    axis, the fill-count scalar replicates. Returns the same NamedTuple
+    type filled with specs (usable as shard_map in/out_specs and, through
+    ``NamedSharding``, as jit in/out_shardings)."""
+    fields = type(ring)._fields
+    as_dict = {name: getattr(ring, name) for name in fields}
+    specs = match_partition_rules(RING_RULES, as_dict)
+    return type(ring)(**{name: specs[name] for name in fields})
 
 
 def shard_batch(batch, mesh: Mesh):
@@ -144,7 +253,8 @@ def shard_batch(batch, mesh: Mesh):
 
 
 def auto_parallel_train_step(
-    config: D4PGConfig, mesh: Mesh, rules=DEFAULT_RULES, donate: bool = True
+    config: D4PGConfig, mesh: Mesh, rules=DEFAULT_RULES, donate: bool = True,
+    ensemble_axis: str | None = None,
 ):
     """jit(train_step) with dp×tp shardings; GSPMD inserts all collectives.
 
@@ -152,10 +262,15 @@ def auto_parallel_train_step(
     gradients here are synchronized implicitly by GSPMD because the loss is a
     mean over the full (sharded) batch — the AllReduce appears in the lowered
     HLO. Use this path when tensor parallelism is on.
+
+    ``ensemble_axis`` (with ``config.critic_ensemble``) shards the critic
+    stack axis over that mesh axis — the expert-parallel layout for wide
+    ensembles (each device holds E/axis whole members).
     """
+    stack_axes = stack_axes_for(config, ensemble_axis)
     # Build spec templates from an abstract state (no device memory).
     dummy = jax.eval_shape(lambda k: _abstract_state(config, k), jax.random.PRNGKey(0))
-    state_specs = _state_specs(dummy, rules, mesh)
+    state_specs = _state_specs(dummy, rules, mesh, stack_axes)
     state_shardings = jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s),
         state_specs,

@@ -57,19 +57,44 @@ class DeviceRing(NamedTuple):
     size: jax.Array       # scalar int32
 
 
-def device_ring_init(capacity: int, obs_dim: int, action_dim: int) -> DeviceRing:
+def device_ring_init(
+    capacity: int, obs_dim: int, action_dim: int, mesh=None
+) -> DeviceRing:
     # device_put COMMITS the fresh arrays: an uncommitted jnp.zeros ring
     # and the committed output of the first ingest would be distinct jit
     # cache keys — two compiles of the same program, tripping the
     # recompile sentinel's budget of 1.
-    return jax.device_put(
-        DeviceRing(
-            obs=jnp.zeros((capacity, obs_dim), jnp.float32),
-            action=jnp.zeros((capacity, action_dim), jnp.float32),
-            reward=jnp.zeros((capacity,), jnp.float32),
-            next_obs=jnp.zeros((capacity, obs_dim), jnp.float32),
-            discount=jnp.zeros((capacity,), jnp.float32),
-            size=jnp.zeros((), jnp.int32),
+    #
+    # With ``mesh``, fields are placed SHARDED over "dp" on the capacity
+    # axis per the partition registry (parallel/partition.py:RING_RULES):
+    # each dp shard owns capacity/dp rows, in the STRIPED host↔device row
+    # mapping (see ShardedDeviceRingSync) so every shard fills evenly from
+    # the first rows of experience.
+    ring = DeviceRing(
+        obs=jnp.zeros((capacity, obs_dim), jnp.float32),
+        action=jnp.zeros((capacity, action_dim), jnp.float32),
+        reward=jnp.zeros((capacity,), jnp.float32),
+        next_obs=jnp.zeros((capacity, obs_dim), jnp.float32),
+        discount=jnp.zeros((capacity,), jnp.float32),
+        size=jnp.zeros((), jnp.int32),
+    )
+    if mesh is None:
+        return jax.device_put(ring)
+    from jax.sharding import NamedSharding
+
+    from d4pg_tpu.parallel.partition import ring_partition_specs
+
+    n_shards = int(mesh.shape["dp"])
+    if capacity % n_shards:
+        raise ValueError(
+            f"sharded ring: capacity {capacity} not divisible by dp="
+            f"{n_shards}"
+        )
+    specs = ring_partition_specs(ring)
+    return DeviceRing(
+        *(
+            jax.device_put(leaf, NamedSharding(mesh, spec))
+            for leaf, spec in zip(ring, specs)
         )
     )
 
@@ -174,6 +199,234 @@ class DeviceRingSync:
             ring = self._ingest(
                 ring, dev_chunk, jax.device_put(slots),
                 jax.device_put(new_size),
+            )
+            self.bytes_ingested += sum(v.nbytes for v in chunk.values())
+            self.bytes_ingested += slots.nbytes + new_size.nbytes
+            self.chunks_ingested += 1
+        self._synced = total
+        return ring
+
+
+# --------------------------------------------------------- sharded variant
+def striped_perm(capacity: int, n_shards: int) -> np.ndarray:
+    """``[n_shards, capacity // n_shards]`` host-slot indices per shard
+    lane: lane ``d`` local row ``i`` holds host slot ``i * n_shards + d``.
+
+    This is the sharded ring's row layout contract, shared by the flusher
+    (mirror mapping), the megastep's parity oracle (lane construction from
+    the host buffer), and the tests. STRIPED rather than block-contiguous
+    on purpose: host writes land round-robin across shards, so every
+    shard's slice fills evenly from the first rows of experience — with
+    contiguous blocks, shard ``d`` would stay EMPTY until a fraction d/D
+    of capacity had ever been written, and the shard-local uniform draw
+    would have nothing to sample."""
+    cl = capacity // n_shards
+    return (np.arange(cl)[None, :] * n_shards + np.arange(n_shards)[:, None])
+
+
+def striped_lanes(buffer, n_shards: int) -> DeviceRing:
+    """Build the parity oracle's lane view of a HOST buffer: a DeviceRing
+    whose row fields carry a leading ``[n_shards]`` lane axis laid out by
+    :func:`striped_perm` — lane ``d`` holds exactly the rows shard ``d``
+    of a sharded ring mirrors, in the same local order. ``size`` is the
+    global fill count (replicated in the oracle's vmap)."""
+    perm = striped_perm(int(buffer.capacity), n_shards)
+    return DeviceRing(
+        obs=jnp.asarray(buffer.obs[perm]),
+        action=jnp.asarray(buffer.action[perm]),
+        reward=jnp.asarray(buffer.reward[perm]),
+        next_obs=jnp.asarray(buffer.next_obs[perm]),
+        discount=jnp.asarray(buffer.discount[perm]),
+        size=jnp.int32(min(buffer.total_added, int(buffer.capacity))),
+    )
+
+
+def sharded_ingest_body(ring: DeviceRing, chunk: dict, slots: jax.Array,
+                        new_size: jax.Array) -> DeviceRing:
+    """Per-shard chunk scatter (the shard_map body of the sharded ingest).
+
+    ``ring`` is the shard's LOCAL slice (``[capacity/dp, ...]`` rows);
+    ``chunk``/``slots`` arrive ``[1, chunk_local, ...]`` (the leading
+    shard axis shard_map split to 1): real rows carry their LOCAL slot
+    index, pad rows carry ``capacity/dp`` — out of the local bounds,
+    dropped by ``mode="drop"``. One fixed compiled shape per shard covers
+    every flush, exactly like the unsharded ingest. In the d4pglint
+    ``MEGASTEP_FUNCTIONS`` manifest: jit-traced, so host numpy or
+    ``.item()`` here would smuggle a per-flush host sync into the device
+    loop."""
+    sl = slots[0]
+    return DeviceRing(
+        obs=ring.obs.at[sl].set(chunk["obs"][0], mode="drop"),
+        action=ring.action.at[sl].set(chunk["action"][0], mode="drop"),
+        reward=ring.reward.at[sl].set(chunk["reward"][0], mode="drop"),
+        next_obs=ring.next_obs.at[sl].set(chunk["next_obs"][0], mode="drop"),
+        discount=ring.discount.at[sl].set(chunk["discount"][0], mode="drop"),
+        size=new_size,
+    )
+
+
+def sharded_chunk_specs():
+    """PartitionSpecs for a flush chunk's fields (leading axis = the shard
+    axis, placed ``P("dp", ...)`` so each dp shard receives exactly its
+    sub-chunk). ONE definition on purpose: the jitted ingest's in_shardings
+    and the flusher's explicit ``device_put`` staging must agree, or every
+    flush silently reshards — the phantom-transfer class the sentinel
+    budgets exist to catch."""
+    from jax.sharding import PartitionSpec as P
+
+    return {
+        "obs": P("dp", None, None),
+        "action": P("dp", None, None),
+        "reward": P("dp", None),
+        "next_obs": P("dp", None, None),
+        "discount": P("dp", None),
+    }
+
+
+def make_sharded_ingest(mesh, chunk_local: int, obs_dim: int, action_dim: int):
+    """The jitted donated-buffer SHARDED ingest: one compiled program per
+    (mesh, chunk shape) — the flusher uses a single fixed ``chunk_local``,
+    so exactly one compile for the run (sentinel budget 1, same contract
+    as :func:`make_ingest`). In/out shardings come from the partition-rule
+    registry (``RING_RULES`` via ``ring_partition_specs``); the chunk's
+    leading axis is the shard axis, placed ``P("dp", ...)`` so each dp
+    shard receives exactly its sub-chunk — ingest stays shard-local, no
+    collectives in the lowered program."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from d4pg_tpu.parallel.compat import shard_map
+    from d4pg_tpu.parallel.partition import ring_partition_specs
+
+    template = DeviceRing(
+        obs=np.zeros((2, obs_dim), np.float32),
+        action=np.zeros((2, action_dim), np.float32),
+        reward=np.zeros((2,), np.float32),
+        next_obs=np.zeros((2, obs_dim), np.float32),
+        discount=np.zeros((2,), np.float32),
+        size=np.zeros((), np.int32),
+    )
+    ring_specs = ring_partition_specs(template)
+    chunk_specs = sharded_chunk_specs()
+    mapped = shard_map(
+        sharded_ingest_body,
+        mesh=mesh,
+        in_specs=(ring_specs, chunk_specs, P("dp", None), P()),
+        out_specs=ring_specs,
+        check_vma=False,
+    )
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        (ring_specs, chunk_specs, P("dp", None), P()),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return jax.jit(
+        mapped,
+        in_shardings=shardings,
+        out_shardings=shardings[0],
+        donate_argnums=(0,),
+    )
+
+
+class ShardedDeviceRingSync:
+    """The dp-sharded flusher: mirrors a host buffer's ring slots into a
+    :class:`DeviceRing` whose rows are sharded over "dp" (ROADMAP item 2 —
+    the scale-out of :class:`DeviceRingSync`).
+
+    Layout is STRIPED (:func:`striped_perm`): host slot ``j`` lives on
+    shard ``j % dp`` at local row ``j // dp``, so collection fills every
+    shard evenly and the megastep's shard-local uniform draw over
+    ``[0, size // dp)`` rows is always backed by mirrored data. Each flush
+    ships ONE fixed-shape ``[dp, chunk_local, ...]`` chunk per round —
+    every shard's sub-chunk padded to the same ``chunk_local`` (pad slot
+    = local capacity, dropped by the scatter) — placed per-shard with an
+    explicit ``NamedSharding`` ``device_put``; the donated shard_map
+    ingest then scatters locally. Same contract as the unsharded sync:
+    one compiled ingest program ever, explicit staging is the only
+    steady-state H2D, more than ``capacity`` pending writes collapse to a
+    full resync.
+    """
+
+    def __init__(self, buffer, mesh, chunk_cap: int = 4096):
+        self._buffer = buffer
+        self._mesh = mesh
+        self.n_shards = int(mesh.shape["dp"])
+        self.capacity = int(buffer.capacity)
+        if self.capacity % self.n_shards:
+            raise ValueError(
+                f"sharded ring: capacity {self.capacity} not divisible "
+                f"by dp={self.n_shards}"
+            )
+        self.local_capacity = self.capacity // self.n_shards
+        self.chunk_local = int(
+            min(max(1, chunk_cap // self.n_shards), self.local_capacity)
+        )
+        self._synced = 0
+        obs_dim = buffer.obs.shape[1]
+        act_dim = buffer.action.shape[1]
+        self._ingest = make_sharded_ingest(
+            mesh, self.chunk_local, obs_dim, act_dim
+        )
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        # Built from the SAME spec dict the jitted ingest's in_shardings
+        # use (sharded_chunk_specs) — staging and program can never drift.
+        self._chunk_sharding = {
+            k: NamedSharding(mesh, s) for k, s in sharded_chunk_specs().items()
+        }
+        self._slots_sharding = NamedSharding(mesh, P("dp", None))
+        self._scalar_sharding = NamedSharding(mesh, P())
+        self.bytes_ingested = 0
+        self.chunks_ingested = 0
+
+    @property
+    def ingest_fn(self):
+        """The jitted ingest entry point (recompile-sentinel tracking)."""
+        return self._ingest
+
+    def pending(self) -> int:
+        return min(self._buffer.total_added - self._synced, self.capacity)
+
+    def flush(self, ring: DeviceRing) -> DeviceRing:
+        """Mirror all pending host writes into the sharded ``ring``;
+        returns the updated ring (the argument is consumed — donated)."""
+        buf = self._buffer
+        total = buf.total_added
+        n_pending = min(total - self._synced, self.capacity)
+        if n_pending <= 0:
+            return ring
+        first = total - n_pending
+        new_size = np.int32(min(total, self.capacity))
+        D, cl = self.n_shards, self.chunk_local
+        # Pending host slots in write order, dealt to their owner shards.
+        pend = (first + np.arange(n_pending)) % self.capacity
+        by_shard = [pend[pend % D == d] // D for d in range(D)]
+        rounds = max(1, -(-max(len(b) for b in by_shard) // cl))
+        for r in range(rounds):
+            slots = np.full((D, cl), self.local_capacity, np.int32)
+            gidx = np.zeros((D, cl), np.int64)
+            for d in range(D):
+                part = by_shard[d][r * cl:(r + 1) * cl]
+                slots[d, : len(part)] = part
+                # Pad index rows re-read the shard's slot 0 so gather()
+                # returns the fixed shape; their scatter targets are out
+                # of local bounds and dropped.
+                gidx[d, : len(part)] = part * D + d
+            chunk = {
+                k: np.asarray(v).reshape((D, cl) + v.shape[1:])
+                for k, v in dict(buf.gather(gidx.ravel())).items()
+            }
+            # Explicit per-shard staging (exempt from the transfer guard):
+            # the NamedSharding device_put hands each dp shard exactly its
+            # sub-chunk.
+            dev_chunk = {
+                k: jax.device_put(v, self._chunk_sharding[k])
+                for k, v in chunk.items()
+            }
+            ring = self._ingest(
+                ring,
+                dev_chunk,
+                jax.device_put(slots, self._slots_sharding),
+                jax.device_put(new_size, self._scalar_sharding),
             )
             self.bytes_ingested += sum(v.nbytes for v in chunk.values())
             self.bytes_ingested += slots.nbytes + new_size.nbytes

@@ -112,3 +112,163 @@ def make_megastep_hybrid(config: D4PGConfig):
     return jax.jit(
         partial(megastep_hybrid_body, config), donate_argnums=(0,)
     )
+
+
+# ------------------------------------------------------------ sharded (dp)
+def sharded_megastep_uniform_body(
+    config: D4PGConfig, k: int, b_local: int, n_shards: int,
+    state: TrainState, ring: DeviceRing, key: jax.Array,
+):
+    """The per-shard megastep: K grad steps on shard-LOCAL uniform draws,
+    gradients combined with the deterministic mean (ROADMAP item 2 — the
+    PR-6 megastep spanning a dp mesh).
+
+    Runs under TWO harnesses with the SAME bits (tests pin it):
+
+    - ``shard_map`` over the dp mesh (:func:`make_megastep_uniform_sharded`)
+      — ``ring`` is this shard's ``[capacity/dp, ...]`` row slice, the
+      gather is physically shard-local, ``all_gather``/``axis_index`` ride
+      the mesh axis;
+    - single-device ``vmap`` with the same axis name
+      (:func:`make_megastep_uniform_oracle`) — the parity oracle: lanes
+      are the striped host-slot slices (``striped_perm``), the axis
+      primitives act on the lane axis.
+
+    Byte-identity between the two holds because everything per-shard is
+    identical math on identical rows and the ONLY cross-shard arithmetic
+    is :func:`~d4pg_tpu.parallel.dp.det_pmean`'s fixed-order sum — which
+    is why this body must never use ``pmean`` directly (the backend
+    AllReduce's accumulation order is not part of the program).
+
+    Per-shard draw: split the replicated key, ``fold_in`` the shard index,
+    draw ``[k, b_local]`` rows from the shard's ``size // n_shards``
+    mirrored local rows (striping guarantees every shard has exactly that
+    many FULLY-synced rows whenever ``size >= n_shards``). The global
+    batch is the concatenation of the shard batches — B = b_local · dp —
+    and the returned key threads forward exactly like the unsharded body.
+    """
+    shard = jax.lax.axis_index("dp")
+    key, k_idx = jax.random.split(key)
+    local_n = ring.size // n_shards
+    idx = jax.random.randint(
+        jax.random.fold_in(k_idx, shard), (k, b_local), 0, local_n
+    )
+    batches = gather_batches(ring, idx)
+    # Same determinism contract as megastep_uniform_body: the uniform
+    # path carries NO weights key on either side.
+    del batches["weights"]
+    from d4pg_tpu.parallel.dp import det_pmean
+
+    sync = partial(det_pmean, axis_name="dp", size=n_shards)
+    state, metrics, _ = fused_train_scan(config, state, batches, sync_fn=sync)
+    return state, key, jax.tree.map(lambda x: x.mean(), metrics)
+
+
+def make_megastep_uniform_sharded(
+    config: D4PGConfig, k: int, batch: int, mesh, rules=None,
+):
+    """Jitted donated-buffer SHARDED uniform megastep over a dp mesh:
+    ``(state, ring, key) -> (state, key', metrics)``, in/out shardings
+    from the partition-rule registry.
+
+    The state's shardings come from ``match_partition_rules`` over the
+    param tree (ensemble stacks included via ``stack_axes_for``); the
+    ring's from ``RING_RULES`` (rows over "dp"); key and metrics
+    replicate. The mesh must be dp-only (tp=1): inside ``shard_map``
+    every mesh axis is manual, and the megastep's manual axis is "dp" —
+    compose tp via the GSPMD host path instead. Zero per-grad-step
+    transfers survive scale-out: state, ring, and key all live sharded on
+    the mesh between dispatches, and the dispatch site runs under the
+    same ``no_transfers`` budget as the single-device megastep."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from d4pg_tpu.parallel.compat import shard_map
+    from d4pg_tpu.parallel.partition import (
+        DEFAULT_RULES,
+        _abstract_state,
+        _state_specs,
+        ring_partition_specs,
+        stack_axes_for,
+    )
+
+    n_shards = int(mesh.shape["dp"])
+    if int(mesh.shape.get("tp", 1)) != 1:
+        raise ValueError(
+            "sharded megastep mesh must be dp-only (tp=1); tensor "
+            "parallelism composes via the GSPMD host path "
+            f"(got tp={mesh.shape['tp']})"
+        )
+    if batch % n_shards:
+        raise ValueError(
+            f"sharded megastep: batch {batch} not divisible by dp={n_shards}"
+        )
+    dummy = jax.eval_shape(
+        lambda kk: _abstract_state(config, kk), jax.random.PRNGKey(0)
+    )
+    state_specs = _state_specs(
+        dummy, rules or DEFAULT_RULES, mesh, stack_axes_for(config)
+    )
+    ring_template = DeviceRing(
+        obs=jnp.zeros((2, config.obs_dim)),
+        action=jnp.zeros((2, config.action_dim)),
+        reward=jnp.zeros((2,)),
+        next_obs=jnp.zeros((2, config.obs_dim)),
+        discount=jnp.zeros((2,)),
+        size=jnp.zeros((), jnp.int32),
+    )
+    ring_specs = ring_partition_specs(ring_template)
+    body = partial(
+        sharded_megastep_uniform_body, config, k, batch // n_shards, n_shards
+    )
+    mapped = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(state_specs, ring_specs, P()),
+        out_specs=(state_specs, P(), P()),
+        check_vma=False,
+    )
+    to_shardings = lambda specs: jax.tree_util.tree_map(  # noqa: E731
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    key_sharding = NamedSharding(mesh, P())
+    return jax.jit(
+        mapped,
+        in_shardings=(to_shardings(state_specs), to_shardings(ring_specs),
+                      key_sharding),
+        out_shardings=(to_shardings(state_specs), key_sharding,
+                       NamedSharding(mesh, P())),
+        donate_argnums=(0,),
+    )
+
+
+def make_megastep_uniform_oracle(config: D4PGConfig, k: int, batch: int,
+                                 n_shards: int):
+    """The sharded megastep's SINGLE-DEVICE parity oracle: the same
+    :func:`sharded_megastep_uniform_body` under ``vmap(axis_name="dp")``
+    over striped host-slot lanes (``replay.device_ring.striped_perm``).
+
+    ``(state, ring_lanes, key) -> (state, key', metrics)`` where
+    ``ring_lanes`` is a DeviceRing whose row fields carry a leading
+    ``[n_shards]`` lane axis and whose ``size`` stays the global scalar.
+    Because the body's only cross-shard arithmetic is ``det_pmean``
+    (all_gather + fixed-order sum — exact under both harnesses), the
+    oracle's TrainState is BYTE-IDENTICAL to the mesh path's, which is
+    the acceptance contract tests/test_sharded_megastep.py pins."""
+    body = partial(
+        sharded_megastep_uniform_body, config, k, batch // n_shards, n_shards
+    )
+    lane_axes = DeviceRing(
+        obs=0, action=0, reward=0, next_obs=0, discount=0, size=None
+    )
+    vm = jax.vmap(body, in_axes=(None, lane_axes, None), out_axes=0,
+                  axis_name="dp")
+
+    def run(state, ring_lanes, key):
+        st, keys, metrics = vm(state, ring_lanes, key)
+        # Every lane's outputs are identical (det_pmean-synced); lane 0
+        # IS the result.
+        first = lambda t: jax.tree.map(lambda x: x[0], t)  # noqa: E731
+        return first(st), keys[0], first(metrics)
+
+    return jax.jit(run)

@@ -266,10 +266,41 @@ class Trainer:
                     "entirely; use float32"
                 )
             if config.dp:
-                raise ValueError(
-                    "replay_placement=device/hybrid is single-device for "
-                    "now (a sharded ring is ROADMAP item 2 territory)"
-                )
+                # The sharded megastep (ROADMAP item 2): the uniform ring
+                # shards over a dp mesh — rows striped across shards,
+                # in-kernel shard-local draws, deterministic gradient mean
+                # (runtime/megastep.py:make_megastep_uniform_sharded).
+                if placement == "hybrid":
+                    raise ValueError(
+                        "replay_placement=hybrid is single-device: the "
+                        "host sum-tree's [K, B] index blocks are global, "
+                        "so shard-local gathers can't serve them; use "
+                        "--replay-placement device for the sharded "
+                        "(uniform) megastep"
+                    )
+                if config.tp != 1:
+                    raise ValueError(
+                        "the sharded megastep mesh is dp-only (tp=1); "
+                        "tensor parallelism composes via the host-path "
+                        "GSPMD step (--replay-placement host --tp N)"
+                    )
+                if config.dp_hogwild:
+                    raise ValueError(
+                        "--dp-hogwild is a host-path DP mode; the sharded "
+                        "megastep syncs gradients every step"
+                    )
+                if config.batch_size % config.dp:
+                    raise ValueError(
+                        f"--batch-size {config.batch_size} must be "
+                        f"divisible by --dp {config.dp} (each shard draws "
+                        "batch/dp rows)"
+                    )
+                if config.replay_capacity % config.dp:
+                    raise ValueError(
+                        f"replay capacity {config.replay_capacity} must "
+                        f"be divisible by --dp {config.dp} (each shard "
+                        "owns capacity/dp ring rows)"
+                    )
             if config.prefetch:
                 print(
                     "[replay] --prefetch double-buffers the host batch "
@@ -338,7 +369,15 @@ class Trainer:
         self.key, init_key = jax.random.split(self.key)
         self.state = create_train_state(agent_cfg, init_key)
         self._fused_step = None  # set iff steps_per_dispatch > 1
-        if config.dp:
+        if config.dp and placement != "host":
+            # Sharded-megastep mode: the dp mesh belongs to the megastep
+            # (built in the device-ring block below); none of the host-path
+            # shard_map train steps apply. The single-device jit stays
+            # constructed for the acting/eval paths, same as single-device
+            # device placement.
+            self.mesh = None
+            self._train_step = jit_train_step(agent_cfg)
+        elif config.dp:
             from d4pg_tpu.parallel import make_dp_train_step, make_mesh
             from d4pg_tpu.parallel.dp import (
                 make_dp_fused_train_step,
@@ -430,31 +469,87 @@ class Trainer:
         self._ring_sync = None
         self._megastep = None
         self._megastep_warm = False  # first dispatch compiled (guards)
+        self._mega_mesh = None
+        self._state_shard_fns = None
+        self._state_gather_fns = None
         if self._placement != "host":
             from d4pg_tpu.replay.device_ring import (
                 DeviceRingSync,
+                ShardedDeviceRingSync,
                 device_ring_init,
             )
             from d4pg_tpu.runtime.megastep import (
                 make_megastep_hybrid,
                 make_megastep_uniform,
+                make_megastep_uniform_sharded,
             )
 
+            if config.dp:
+                from d4pg_tpu.parallel import make_mesh
+
+                self._mega_mesh = make_mesh(dp=config.dp, tp=1)
             self._ring = device_ring_init(
-                config.replay_capacity, obs_dim, act_dim
+                config.replay_capacity, obs_dim, act_dim,
+                mesh=self._mega_mesh,
             )
-            self._ring_sync = DeviceRingSync(self.buffer)
-            if self._placement == "device":
-                self._megastep = make_megastep_uniform(
-                    agent_cfg,
-                    max(1, config.steps_per_dispatch),
-                    config.batch_size,
+            if self._mega_mesh is not None:
+                self._ring_sync = ShardedDeviceRingSync(
+                    self.buffer, self._mega_mesh
                 )
+            else:
+                self._ring_sync = DeviceRingSync(self.buffer)
+            if self._placement == "device":
+                if self._mega_mesh is not None:
+                    # Sharded megastep (ROADMAP item 2): state placed per
+                    # the partition-rule registry, ring rows striped over
+                    # "dp", in/out shardings on the jit from the same
+                    # rules; the shard/gather fns also serve the
+                    # checkpoint path (gather whole arrays to host on
+                    # save, re-shard onto the mesh on --resume).
+                    from d4pg_tpu.parallel import (
+                        DEFAULT_RULES,
+                        make_shard_and_gather_fns,
+                        stack_axes_for,
+                    )
+                    from d4pg_tpu.parallel.partition import _state_specs
+
+                    specs = _state_specs(
+                        jax.eval_shape(lambda s: s, self.state),
+                        DEFAULT_RULES,
+                        self._mega_mesh,
+                        stack_axes_for(agent_cfg),
+                    )
+                    (
+                        self._state_shard_fns,
+                        self._state_gather_fns,
+                    ) = make_shard_and_gather_fns(specs, self._mega_mesh)
+                    from d4pg_tpu.parallel import apply_fns
+
+                    self.state = apply_fns(self._state_shard_fns, self.state)
+                    self._megastep = make_megastep_uniform_sharded(
+                        agent_cfg,
+                        max(1, config.steps_per_dispatch),
+                        config.batch_size,
+                        self._mega_mesh,
+                    )
+                else:
+                    self._megastep = make_megastep_uniform(
+                        agent_cfg,
+                        max(1, config.steps_per_dispatch),
+                        config.batch_size,
+                    )
                 # The megastep's index-draw key lives ON DEVICE and is
                 # split inside the jitted call — steady state has no host
                 # operand at all (this one device_put is setup, not loop).
                 self.key, mk = jax.random.split(self.key)
-                self._megastep_key = jax.device_put(mk)
+                if self._mega_mesh is not None:
+                    from jax.sharding import NamedSharding, PartitionSpec
+
+                    self._megastep_key = jax.device_put(
+                        mk, NamedSharding(self._mega_mesh, PartitionSpec())
+                    )
+                else:
+                    self._megastep_key = jax.device_put(mk)
             else:
                 self._megastep = make_megastep_hybrid(agent_cfg)
 
@@ -555,7 +650,17 @@ class Trainer:
             self.state, restored_step, fallbacks = self.ckpt.restore_verified(
                 self.state
             )
-            if not config.dp:
+            if self._state_shard_fns is not None:
+                # Sharded-megastep resume: Orbax hands back host-resident
+                # WHOLE arrays (the gather fns saved them that way);
+                # re-shard each leaf onto the mesh under its rule's
+                # NamedSharding — a bare device_put would commit the state
+                # unsharded and the first dispatch would silently reshard
+                # (and trip the transfer/recompile guards).
+                from d4pg_tpu.parallel import apply_fns
+
+                self.state = apply_fns(self._state_shard_fns, self.state)
+            elif not config.dp:
                 # Orbax hands back host-resident leaves; commit them to the
                 # device HERE (setup, not loop) so the first guarded
                 # dispatch doesn't see an implicit host->device transfer of
@@ -2048,7 +2153,16 @@ class Trainer:
         return os.path.join(self.config.log_dir, "checkpoints", "replay.npz")
 
     def _save_checkpoint(self) -> None:
-        self.ckpt.save(self.grad_steps, self.state)
+        state = self.state
+        if self._state_gather_fns is not None:
+            # Sharded-megastep runs: gather every leaf fully to host
+            # (make_shard_and_gather_fns) so Orbax serializes WHOLE
+            # logical arrays — a checkpoint written on one mesh layout
+            # restores onto any other (or onto a single device).
+            from d4pg_tpu.parallel import apply_fns
+
+            state = apply_fns(self._state_gather_fns, state)
+        self.ckpt.save(self.grad_steps, state)
         # Finalize the (async) Orbax write before the side files: a crash
         # between them must never leave meta/replay newer than the newest
         # restorable checkpoint.

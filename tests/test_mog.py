@@ -144,6 +144,89 @@ def test_mog_critic_fits_known_bimodal_distribution():
     np.testing.assert_allclose(sorted(mf), [2 - 2.4, 2 + 2.4], atol=0.15)
 
 
+def test_std_floor_keeps_terminal_quadrature_finite():
+    """The std floor is the invariant the terminal collapse leans on: at
+    d=0 every projected component std is exactly the floor (not 0 — the
+    log-density would be -inf and the CE NaN), and the loss through it
+    stays finite."""
+    from d4pg_tpu.ops.mog import _STD_FLOOR
+
+    head = _head([[0.0, 0.0]], [[3.0, -3.0]], [[2.0, 0.5]])
+    y, w = mog_bellman_targets(
+        head, jnp.asarray([1.0]), jnp.asarray([0.0]), num_mixtures=2,
+        quadrature_points=8,
+    )
+    # node spread of each component == sqrt(2)·floor·(x_max - x_min): the
+    # floor, not zero, sets the terminal width
+    import numpy.polynomial.hermite as H
+
+    nodes, _ = H.hermgauss(8)
+    want_spread = np.sqrt(2.0) * _STD_FLOOR * (nodes.max() - nodes.min())
+    got_spread = np.asarray(y.max(axis=-1) - y.min(axis=-1))
+    np.testing.assert_allclose(got_spread, want_spread, rtol=1e-4)
+    ce = mog_cross_entropy(head, y, w, num_mixtures=2)
+    assert np.isfinite(np.asarray(ce)).all()
+
+
+def test_quadrature_matches_monte_carlo_at_high_q():
+    """Gauss-Hermite CE vs a large Monte-Carlo estimate of
+    -E_{y~TZ'}[log p_online(y)]: agreement within MC error at high Q —
+    the quadrature is an integral estimator, not a heuristic."""
+    rng = np.random.default_rng(0)
+    target = _head([[0.5, -0.5]], [[-2.0, 4.0]], [[0.7, 1.3]])
+    online = _head([[0.1, -0.1]], [[0.0, 3.0]], [[1.0, 1.5]])
+    r, d = jnp.asarray([1.5]), jnp.asarray([0.8])
+    y, w = mog_bellman_targets(target, r, d, num_mixtures=2,
+                               quadrature_points=32)
+    ce_quad = float(mog_cross_entropy(online, y, w, num_mixtures=2)[0])
+    # MC: sample the transformed target mixture directly
+    from d4pg_tpu.models.critic import mixture_gaussian_params
+
+    log_wt, m_t, s_t = mixture_gaussian_params(jnp.asarray(target), 2)
+    wt = np.exp(np.asarray(log_wt))[0]
+    m_proj = 1.5 + 0.8 * np.asarray(m_t)[0]
+    s_proj = np.maximum(0.8 * np.asarray(s_t)[0], 1e-3)
+    n = 200_000
+    comp = rng.choice(2, size=n, p=wt / wt.sum())
+    ys = rng.normal(m_proj[comp], s_proj[comp]).astype(np.float32)
+    log_p = mog_log_prob(online, jnp.asarray(ys)[None, :], num_mixtures=2)
+    ce_mc = float(-jnp.mean(log_p))
+    se = float(jnp.std(-log_p)) / np.sqrt(n)
+    assert abs(ce_quad - ce_mc) < 5 * se + 5e-3, (ce_quad, ce_mc, se)
+
+
+def test_grad_flows_through_all_head_components():
+    """The CE loss the train step minimizes must carry gradient to EVERY
+    online head component — logits, means, and log-stds; a dead slice
+    here would silently freeze a third of the head (the exact failure
+    mode of a stop_gradient landing on the wrong side)."""
+    target = _head([[0.0, 0.0]], [[-1.0, 2.0]], [[0.5, 1.0]])
+    online = _head([[0.2, -0.2]], [[0.5, 1.5]], [[0.8, 1.2]])
+    y, w = mog_bellman_targets(
+        target, jnp.asarray([0.3]), jnp.asarray([0.9]), num_mixtures=2
+    )
+    g = jax.grad(
+        lambda h: jnp.mean(mog_cross_entropy(h, y, w, num_mixtures=2))
+    )(online)
+    g = np.asarray(g)[0]
+    assert np.isfinite(g).all()
+    M = 2
+    for sl, name in ((slice(0, M), "logits"), (slice(M, 2 * M), "means"),
+                     (slice(2 * M, 3 * M), "log_stds")):
+        assert np.abs(g[sl]).max() > 0, f"dead gradient slice: {name}"
+    # and the TARGET side carries none (stop_gradient contract)
+    gt = jax.grad(
+        lambda t: jnp.mean(
+            mog_cross_entropy(
+                online, *mog_bellman_targets(
+                    t, jnp.asarray([0.3]), jnp.asarray([0.9]), 2
+                ), num_mixtures=2,
+            )
+        )
+    )(target)
+    assert float(jnp.abs(gt).max()) == 0.0
+
+
 @pytest.mark.slow
 def test_on_device_mog_head_learns_pendulum_signal():
     """The head is not just well-posed — an agent LEARNS with it (VERDICT

@@ -137,15 +137,16 @@ def test_match_partition_rules_stacked_twin_axis():
     assert specs["params"]["out"]["kernel"] == P(None, "tp", None)
 
 
-def test_match_partition_rules_gates_non_twin_leading_dims():
-    """The stacked-axis prepend fires ONLY for twin stacks (leading dim
-    exactly 2): a rank-3 leaf with another leading size matching a
-    dense-written rule must fall back to replication, not silently gain a
-    replicated leading axis (ADVICE round-3)."""
+def test_match_partition_rules_gates_undeclared_leading_dims():
+    """The stacked-axis prepend fires ONLY for DECLARED stack sizes (the
+    twin pair by default): a rank-3 leaf with an undeclared leading size
+    matching a dense-written rule must fall back to replication, not
+    silently gain a replicated leading axis (ADVICE round-3; the gate is
+    now rule-data — DEFAULT_STACK_AXES — not a hardcoded ==2)."""
     tree = {
         "params": {
             # conv-like [width=5, in, out] leaf under a name a dense rule
-            # matches: not a twin stack
+            # matches: not a declared stack
             "hidden_0": {"kernel": np.zeros((5, 4, 8))},
         }
     }
@@ -153,6 +154,94 @@ def test_match_partition_rules_gates_non_twin_leading_dims():
 
     specs = match_partition_rules(DEFAULT_RULES, tree)
     assert specs["params"]["hidden_0"]["kernel"] == P()
+
+
+def test_match_partition_rules_declared_ensemble_stack():
+    """An E≠2 ensemble stack declared via stack_axes gets the stacked
+    treatment the twin pair gets — the satellite fix: before, E=4 leaves
+    silently fell to full replication because the gate was ==2."""
+    from d4pg_tpu.parallel import DEFAULT_RULES
+
+    tree = {
+        "params": {
+            "hidden_0": {"kernel": np.zeros((4, 8, 16)), "bias": np.zeros((4, 16))},
+            "out": {"kernel": np.zeros((4, 16, 2))},
+        }
+    }
+    specs = match_partition_rules(
+        DEFAULT_RULES, tree, stack_axes=((2, None), (4, None))
+    )
+    assert specs["params"]["hidden_0"]["kernel"] == P(None, None, "tp")
+    assert specs["params"]["hidden_0"]["bias"] == P(None, "tp")
+    assert specs["params"]["out"]["kernel"] == P(None, "tp", None)
+    # undeclared (default stack_axes): the E=4 stack replicates — the old
+    # silent behavior, now an explicit declaration decision
+    specs_default = match_partition_rules(DEFAULT_RULES, tree)
+    assert specs_default["params"]["hidden_0"]["kernel"] == P()
+
+
+def test_match_partition_rules_mesh_sharded_stack_axis():
+    """A stack declared over a mesh axis becomes the member-parallel
+    layout: the stack axis shards, trailing uses of the SAME axis drop
+    (each member stays whole on its devices; a NamedSharding may name an
+    axis once)."""
+    from d4pg_tpu.parallel import DEFAULT_RULES
+
+    tree = {
+        "params": {
+            "hidden_0": {"kernel": np.zeros((4, 8, 16)), "bias": np.zeros((4, 16))},
+            "hidden_1": {"kernel": np.zeros((4, 16, 16))},
+        }
+    }
+    specs = match_partition_rules(
+        DEFAULT_RULES, tree, stack_axes=((2, None), (4, "tp"))
+    )
+    assert specs["params"]["hidden_0"]["kernel"] == P("tp", None, None)
+    assert specs["params"]["hidden_0"]["bias"] == P("tp", None)
+    assert specs["params"]["hidden_1"]["kernel"] == P("tp", None, None)
+
+
+def test_stack_axes_for_config():
+    from d4pg_tpu.agent import D4PGConfig
+    from d4pg_tpu.parallel import DEFAULT_STACK_AXES, stack_axes_for
+
+    assert stack_axes_for(D4PGConfig()) == DEFAULT_STACK_AXES
+    assert stack_axes_for(D4PGConfig(critic_ensemble=8)) == (
+        (2, None), (8, None),
+    )
+    assert stack_axes_for(D4PGConfig(critic_ensemble=8), "tp") == (
+        (2, None), (8, "tp"),
+    )
+
+
+def test_make_shard_and_gather_fns_roundtrip():
+    """The EasyLM-shape port: shard_fns place leaves under their rule's
+    NamedSharding; gather_fns fetch them WHOLE to host numpy; the
+    roundtrip is lossless. This pair is the sharded trainer's checkpoint
+    contract (gather on save, re-shard on --resume)."""
+    from d4pg_tpu.parallel import DEFAULT_RULES, make_shard_and_gather_fns
+    from d4pg_tpu.parallel.partition import _state_specs
+    from d4pg_tpu.agent import D4PGConfig, create_train_state
+
+    config = D4PGConfig(obs_dim=3, action_dim=1, hidden_sizes=(64, 64))
+    mesh = make_mesh(dp=4, tp=2)
+    state = create_train_state(config, jax.random.PRNGKey(0))
+    specs = _state_specs(
+        jax.eval_shape(lambda s: s, state), DEFAULT_RULES, mesh
+    )
+    shard_fns, gather_fns = make_shard_and_gather_fns(specs, mesh)
+    from d4pg_tpu.parallel import apply_fns
+
+    sharded = apply_fns(shard_fns, state)
+    k = sharded.critic_params["params"]["hidden_0"]["kernel"]
+    assert {s.data.shape for s in k.addressable_shards} == {(3, 32)}
+    gathered = apply_fns(gather_fns, sharded)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(jax.device_get(state)),
+        jax.tree_util.tree_leaves(gathered),
+    ):
+        assert isinstance(b, np.ndarray)
+        np.testing.assert_array_equal(np.asarray(a), b)
 
 
 @pytest.mark.slow  # compile-heavy (conftest fast-tier budget)

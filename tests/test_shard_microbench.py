@@ -1,0 +1,104 @@
+"""Tier-1 smokes for the sharded-megastep microbench (ISSUE 9 acceptance).
+
+Two halves, mirroring the other benchmark smokes:
+
+- the GENERATOR runs end-to-end at tiny shapes (so a refactor that breaks
+  ``bench_megastep(dp=)``/``bench_ensemble_capacity``/``run_microbench``
+  fails here, not at artifact-regen time) — timing ratios are NOT
+  asserted at this scale (8 virtual devices over ~2 cores measure thread
+  thrash, not the mesh);
+- the COMMITTED artifact (``benchmarks/shard_microbench.json``) keeps its
+  schema and the chip-independent half of the headline: dp=1 AND dp>1
+  megastep rows both at ZERO per-grad-step transfer bytes, plus the
+  ensemble/MoG wide-shape capacity row — enforced both here and by
+  ``tools.d4pglint.schema_check.check_shard_microbench`` (the lint gate
+  covers hand-edits; this smoke covers regeneration drift).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+pytest.importorskip("jax")
+
+ARTIFACT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "benchmarks",
+    "shard_microbench.json",
+)
+
+
+def test_generator_runs_at_small_shape(tmp_path):
+    from benchmarks.shard_microbench import run_microbench
+
+    out_path = str(tmp_path / "shard_microbench.json")
+    out = run_microbench(
+        out_path, batch=16, k=2, hidden=32, rows=512, steps=2, dp=4,
+        repeats=1, ens_hidden=32, ens_batch=16, ensemble=4,
+    )
+    assert os.path.exists(out_path)
+    for name in ("megastep_dp1", "megastep_dp4"):
+        row = out[name]
+        assert row["steps_per_sec"] > 0
+        # the chip-independent half of the claim holds at ANY shape: the
+        # sharded steady state stages/fetches NOTHING per grad step
+        assert row["transfer_bytes_per_grad_step"] == 0.0
+    assert out["megastep_dp4"]["dp"] == 4
+    ens = out["ensemble_mog_wide"]
+    assert ens["ensemble"] == 4 and ens["steps_per_sec"] > 0
+    with open(out_path) as f:
+        json.load(f)  # artifact is valid JSON
+    # the lint-side schema check accepts what the generator writes
+    from tools.d4pglint.schema_check import check_shard_microbench
+
+    assert check_shard_microbench(out_path) == []
+
+
+def test_committed_artifact_schema_and_headline():
+    with open(ARTIFACT) as f:
+        doc = json.load(f)
+    assert doc["metric"] == "shard_microbench"
+    assert "backend" in doc and "on_chip_recipe" in doc
+    dp_rows = {
+        k: v for k, v in doc.items()
+        if k.startswith("megastep_dp") and isinstance(v, dict)
+    }
+    assert "megastep_dp1" in dp_rows
+    assert any(v["dp"] > 1 for v in dp_rows.values())
+    for row in dp_rows.values():
+        assert row["steps_per_sec"] > 0
+        assert row["steps_per_sec_repeats"]
+        assert row["transfer_bytes_per_grad_step"] == 0.0
+    ens = doc["ensemble_mog_wide"]
+    assert ens["ensemble"] >= 4
+    assert ens["hidden"] >= 512  # the WIDE shape, where sharding is load-bearing
+    assert ens["tp"] >= 2 and ens["ensemble_axis"] == "tp"
+    assert ens["steps_per_sec"] > 0
+    # and the lint gate agrees with the committed bytes
+    from tools.d4pglint.schema_check import check_shard_microbench
+
+    assert check_shard_microbench(ARTIFACT) == []
+
+
+def test_committed_mfu_sweep_has_sharded_rows():
+    sweep = os.path.join(os.path.dirname(ARTIFACT), "mfu_sweep_results.json")
+    with open(sweep) as f:
+        rows = json.load(f)
+    sharded = [
+        r for r in rows
+        if str(r.get("config", "")).startswith("sharded_megastep")
+    ]
+    assert sharded, "mfu_sweep_results.json lost its sharded rows"
+    for r in sharded:
+        assert r["bench"] == "mfu_sweep"
+        assert "backend" in r  # CPU placeholders must be distinguishable
+        assert r["dp"] > 1
+        assert r["transfer_bytes_per_grad_step"] == 0.0
+        assert r["steps_per_sec"] > 0
+    # the plain-megastep family survived the --sharded-only regen
+    assert any(
+        str(r.get("config", "")) == "megastep_mlp256" for r in rows
+    ), "--sharded-only regen clobbered the megastep rows"

@@ -113,7 +113,13 @@ MEGASTEP_FUNCTIONS = (
     "d4pg_tpu/runtime/megastep.py::megastep_uniform_body",
     "d4pg_tpu/runtime/megastep.py::megastep_hybrid_body",
     "d4pg_tpu/runtime/megastep.py::draw_uniform_indices",
+    "d4pg_tpu/runtime/megastep.py::sharded_megastep_uniform_body",
     "d4pg_tpu/replay/device_ring.py::ingest_body",
+    "d4pg_tpu/replay/device_ring.py::sharded_ingest_body",
+    # The sharded megastep's deterministic cross-shard combine: traced
+    # into every sharded dispatch, so a host coercion here would smuggle
+    # a sync into the zero-transfer loop exactly like the bodies above.
+    "d4pg_tpu/parallel/dp.py::det_pmean",
 )
 
 # numpy allocators flagged inside hot-path functions (np.asarray is

@@ -95,6 +95,56 @@ def check_router_microbench(path: str) -> list[str]:
     return errs
 
 
+def check_shard_microbench(path: str) -> list[str]:
+    """Shape check for ``benchmarks/shard_microbench.json`` beyond the
+    generic benchmark rule: the ISSUE-9 acceptance parses these exact
+    fields — dp=1 vs dp>1 grad-steps/s, per-step transfer bytes (which
+    MUST be 0 for device placement: a committed artifact can never attest
+    the sharded megastep paying per-step traffic), and the ensemble/MoG
+    wide-shape capacity row."""
+    errs = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: unreadable/invalid JSON ({e})"]
+    for key in ("backend", "device_count", "on_chip_recipe", "megastep_dp1"):
+        if key not in doc:
+            errs.append(f"{path}: missing top-level key {key!r}")
+    dp_rows = [
+        (k, v) for k, v in doc.items()
+        if k.startswith("megastep_dp") and isinstance(v, dict)
+    ]
+    if len(dp_rows) < 2:
+        errs.append(
+            f"{path}: needs a dp=1 AND a dp>1 megastep row "
+            f"(found {[k for k, _ in dp_rows]})"
+        )
+    for name, row in dp_rows:
+        for key in ("steps_per_sec", "transfer_bytes_per_grad_step", "dp",
+                    "steps_per_sec_repeats"):
+            if key not in row:
+                errs.append(f"{path}: {name} missing {key!r}")
+        if row.get("transfer_bytes_per_grad_step", 1) != 0:
+            errs.append(
+                f"{path}: {name}.transfer_bytes_per_grad_step is "
+                f"{row.get('transfer_bytes_per_grad_step')!r}, must be 0 — "
+                "device placement's zero-transfer contract"
+            )
+    if not any(v.get("dp", 1) > 1 for _, v in dp_rows):
+        errs.append(f"{path}: no megastep row with dp > 1")
+    ens = doc.get("ensemble_mog_wide")
+    if not isinstance(ens, dict):
+        errs.append(f"{path}: missing 'ensemble_mog_wide' capacity row")
+    else:
+        for key in ("ensemble", "mixtures", "hidden", "tp", "steps_per_sec"):
+            if key not in ens:
+                errs.append(f"{path}: ensemble_mog_wide missing {key!r}")
+        if ens.get("ensemble", 0) < 2:
+            errs.append(f"{path}: ensemble_mog_wide.ensemble must be >= 2")
+    return errs
+
+
 def check_metrics_jsonl(path: str, max_rows: int | None = None) -> list[str]:
     """Problems with one metrics.jsonl ([] = clean)."""
     errs = []
@@ -139,6 +189,8 @@ def check_tree(root: str) -> list[str]:
         errs.extend(check_benchmark_json(path))
         if os.path.basename(path) == "router_microbench.json":
             errs.extend(check_router_microbench(path))
+        if os.path.basename(path) == "shard_microbench.json":
+            errs.extend(check_shard_microbench(path))
     for path in sorted(
         glob.glob(os.path.join(root, "runs", "**", "metrics.jsonl"),
                   recursive=True)

@@ -119,7 +119,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "critics; fixes the single-critic plateau on "
                         "Hopper/Walker2d-class tasks")
     p.add_argument("--critic-head", choices=["categorical", "scalar", "mixture_gaussian"],
-                   default="categorical")
+                   default="categorical",
+                   help="critic value-distribution head: categorical (C51, "
+                        "the default and the oracle), scalar (plain DDPG), "
+                        "or mixture_gaussian (MoG with Gauss-Hermite CE "
+                        "Bellman backup, ops/mog.py — the head the paper "
+                        "names and the reference leaves TODO-empty)")
+    p.add_argument("--num-mixtures", type=int, default=5,
+                   help="mixture components M for --critic-head "
+                        "mixture_gaussian")
+    p.add_argument("--critic-ensemble", type=int, default=0,
+                   help="REDQ-style critic ensemble width E (0 = off): E "
+                        "independent critics stacked on a mesh-shardable "
+                        "axis, Bellman targets min over a random subset, "
+                        "actor ascends the ensemble mean; mutually "
+                        "exclusive with --twin-critic")
+    p.add_argument("--ensemble-min-targets", type=int, default=2,
+                   help="size M of the random target subset the ensemble "
+                        "backup minimizes over (REDQ in-target "
+                        "minimization; M=E recovers min-over-all)")
     p.add_argument("--compute-dtype", choices=["float32", "bfloat16"], default="float32")
     p.add_argument("--projection", choices=["xla", "pallas", "pallas_fused"],
                    default="xla",
@@ -265,6 +283,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
     dist = DistConfig(
         kind=args.critic_head,
         num_atoms=args.n_atoms,
+        num_mixtures=args.num_mixtures,
         v_min=args.v_min if args.v_min is not None else -10.0,
         v_max=args.v_max if args.v_max is not None else 10.0,
     )
@@ -288,6 +307,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         compute_dtype=args.compute_dtype,
         projection_backend=args.projection,
         twin_critic=args.twin_critic,
+        critic_ensemble=args.critic_ensemble,
+        ensemble_min_targets=args.ensemble_min_targets,
     )
     if args.hidden_sizes:
         agent = dataclasses.replace(
