@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import threading
 from typing import Optional
+from d4pg_tpu.analysis import lockwitness
 
 
 class StagingReuseError(RuntimeError):
@@ -89,7 +90,7 @@ class StagingLedger:
 
     def __init__(self, name: str = "staging"):
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = lockwitness.named_lock("StagingLedger._lock")
         self._gen: dict = {}     # (group, index) -> write generation
         self._holds: dict = {}   # (group, index) -> list[Hold] (active)
         self._writes = 0

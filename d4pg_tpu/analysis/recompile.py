@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import threading
 from typing import Optional
+from d4pg_tpu.analysis import lockwitness
 
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
@@ -68,7 +69,7 @@ class RecompileSentinel:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockwitness.named_lock("RecompileSentinel._lock")
         self._entries: dict[str, _Entry] = {}
         self._listener = None
         self._total = 0

@@ -77,6 +77,7 @@ import os
 import threading
 from dataclasses import dataclass, field
 from typing import Optional
+from d4pg_tpu.analysis import lockwitness
 
 # Sites whose faults run INSIDE a pool worker process (entries for them
 # are shipped to the worker as plain tuples at spawn).
@@ -212,7 +213,7 @@ class ChaosInjector:
     fired: list = field(default_factory=list)
 
     def __post_init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockwitness.named_lock("ChaosInjector._lock")
         self._counts: dict = {}
         self._by_site: dict = {}
         for e in self.plan.entries:

@@ -57,6 +57,7 @@ from d4pg_tpu.replay.nstep_writer import NStepWriter
 from d4pg_tpu.serve import protocol
 from d4pg_tpu.serve.protocol import ProtocolError
 from d4pg_tpu.utils.retry import Backoff
+from d4pg_tpu.analysis import lockwitness
 
 STAT_KEYS = (
     "env_steps",
@@ -177,7 +178,9 @@ class FleetLink:
         self._rfile = self._sock.makefile("rb")
         self._credits = threading.Semaphore(self.max_inflight)
         self._pending: dict = {}  # req_id -> window count
-        self._pending_lock = threading.Lock()
+        self._pending_lock = lockwitness.named_lock(
+            "FleetLink._pending_lock"
+        )
         self._next_id = 0
         self._dead: Optional[Exception] = None
         self._closed = False
@@ -272,6 +275,16 @@ class FleetLink:
                     self._on_ack("dropped", n)
                     err = RuntimeError(payload.decode("utf-8", "replace"))
                     break
+                else:
+                    # An unexpected reply type for a KNOWN req_id: its
+                    # pending entry is already popped, so without this
+                    # branch the frame's windows would vanish from the
+                    # emitted==accounted identity (the zero-torn-windows
+                    # contract). Count them dropped and kill the link —
+                    # a peer speaking unexpected types is not one to
+                    # trust with framing.
+                    self._on_ack("dropped", n)
+                    raise ProtocolError(f"unexpected reply type {msg_type}")
                 self._credits.release()
         except (OSError, ProtocolError) as e:
             if not self._closed:
@@ -381,7 +394,8 @@ class FleetActor:
         self._retry_at = 0.0
         self._stats = dict.fromkeys(STAT_KEYS, 0)
         self._stats["generation"] = self.policy.generation
-        self._stats_lock = threading.Lock()  # reader thread acks vs main
+        # reader thread acks vs main
+        self._stats_lock = lockwitness.named_lock("FleetActor._stats_lock")
 
         from d4pg_tpu.envs.gym_adapter import make_host_env
 

@@ -60,6 +60,7 @@ from d4pg_tpu.fleet import wire
 from d4pg_tpu.replay.uniform import Transition
 from d4pg_tpu.serve import protocol
 from d4pg_tpu.serve.protocol import ProtocolError
+from d4pg_tpu.analysis import lockwitness
 
 # counter keys, in the order they appear in metrics rows / healthz
 COUNTER_KEYS = (
@@ -89,6 +90,11 @@ class IngestServer:
     # _staging_flip — writer thread is the ONLY writer (single-writer-
     #   thread design; readers never touch the rotation)
     _THREAD_SAFE = ("_thread_error", "_staging_flip")
+    # d4pglint thread-lifecycle: per-connection reader threads are not
+    # joined — close() shuts every socket in _conns (unblocking reads at
+    # once), and the read deadline (read_timeout_s) bounds the half-open
+    # zombie case even without a close.
+    _DETACHED_THREADS = ("fleet-ingest-conn",)
 
     def __init__(
         self,
@@ -127,7 +133,8 @@ class IngestServer:
         # writer thread drains. Bounded — admission past queue_limit sheds
         # at the reader with an explicit OVERLOADED reply.
         self._queue: deque = deque()
-        self._cond = threading.Condition()
+        # Witnessed under --debug-guards (static node ids, see lockwitness)
+        self._cond = lockwitness.named_condition("IngestServer._cond")
         self._stop = False  # guarded by _cond
 
         # Writer staging: two rotating sets of preallocated column arrays,
@@ -152,13 +159,15 @@ class IngestServer:
         self._staging_group = "fleet.ingest"
 
         self._counters = dict.fromkeys(COUNTER_KEYS, 0)
-        self._counters_lock = threading.Lock()
+        self._counters_lock = lockwitness.named_lock(
+            "IngestServer._counters_lock"
+        )
 
         self._listen_sock: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
         self._writer_thread: Optional[threading.Thread] = None
         self._conns: set = set()
-        self._conns_lock = threading.Lock()
+        self._conns_lock = lockwitness.named_lock("IngestServer._conns_lock")
         self._shutdown = threading.Event()
         self._thread_error: Optional[BaseException] = None
         self._started = False

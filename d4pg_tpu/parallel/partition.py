@@ -93,6 +93,78 @@ def _spec_fits(spec: P, shape, mesh: Mesh | None) -> bool:
     return True
 
 
+def _leaf_spec(
+    name: str, shape, rules, mesh: Mesh | None, declared_stacks: dict
+) -> tuple[P, dict]:
+    """First-match rule application for ONE leaf -> ``(spec, info)``.
+
+    The single source of truth for both :func:`match_partition_rules`
+    (which discards ``info``) and :func:`explain_partition_rules` (the
+    coverage gate's attribution surface) — sharing the leaf logic is what
+    guarantees the audit can never drift from the shipping behavior.
+
+    ``info['outcome']`` is one of: ``scalar`` (rank-0/size-1 leaves
+    replicate by construction), ``rule`` (a real rule's spec applied),
+    ``stack`` (a declared stacked variant), ``fallback_rank`` (matched a
+    rule whose rank disagrees — replicated), ``fallback_fit`` (matched
+    but the mesh doesn't divide the dim — replicated),
+    ``fallback_catchall`` (only the ``.*`` catch-all matched —
+    replicated), ``fallback_nomatch`` (no rule matched at all).
+    """
+    ndim = len(shape)
+    size = int(np.prod(shape)) if shape else 1
+    if ndim == 0 or size == 1:
+        return P(), {"outcome": "scalar", "rule": None}
+    for pattern, spec in rules:
+        if re.search(pattern, name):
+            outcome = "rule"
+            # A leaf with ONE extra leading dim of a DECLARED stack
+            # size is a stacked variant of the matched param: the
+            # declared axis leads the spec (None = replicate the
+            # stack, a mesh axis = shard members over it) and the rule
+            # applies to the trailing dims — otherwise the spec would
+            # silently shard the wrong dimensions.
+            if (
+                len(spec)
+                and ndim == len(spec) + 1
+                and shape[0] in declared_stacks
+            ):
+                stack_ax = declared_stacks[shape[0]]
+                trailing = tuple(spec)
+                if stack_ax is not None:
+                    # Member-parallel layout: sharding the stack axis
+                    # over a mesh axis keeps each member WHOLE on its
+                    # devices, so trailing uses of the same axis are
+                    # dropped (a NamedSharding may name an axis once).
+                    trailing = tuple(
+                        None
+                        if a == stack_ax
+                        or (isinstance(a, tuple) and stack_ax in a)
+                        else a
+                        for a in trailing
+                    )
+                spec = P(stack_ax, *trailing)
+                outcome = "stack"
+            if len(spec) not in (0, ndim):
+                # Rank still disagrees after the stack gate (a
+                # higher-rank param matching a dense-written rule):
+                # replicate rather than let a short spec silently
+                # shard whichever leading dims it happens to prefix.
+                spec = P()
+                outcome = "fallback_rank"
+            if not _spec_fits(spec, shape, mesh):
+                spec = P()
+                outcome = "fallback_fit"
+            if outcome == "rule" and pattern == r".*":
+                outcome = "fallback_catchall"
+            return spec, {"outcome": outcome, "rule": pattern}
+    return P(), {"outcome": "fallback_nomatch", "rule": None}
+
+
+def _leaf_name(path) -> str:
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+
+
 def match_partition_rules(
     rules: Sequence[tuple[str, P]],
     tree,
@@ -119,52 +191,41 @@ def match_partition_rules(
     flat = jax.tree_util.tree_flatten_with_path(tree)
     specs = []
     for path, leaf in flat[0]:
-        name = "/".join(
-            str(getattr(k, "key", getattr(k, "idx", k))) for k in path
+        spec, _info = _leaf_spec(
+            _leaf_name(path), tuple(getattr(leaf, "shape", ())), rules,
+            mesh, declared_stacks,
         )
-        shape = getattr(leaf, "shape", ())
-        if np.ndim(leaf) == 0 or np.size(leaf) == 1:
-            specs.append(P())
-            continue
-        for pattern, spec in rules:
-            if re.search(pattern, name):
-                # A leaf with ONE extra leading dim of a DECLARED stack
-                # size is a stacked variant of the matched param: the
-                # declared axis leads the spec (None = replicate the
-                # stack, a mesh axis = shard members over it) and the rule
-                # applies to the trailing dims — otherwise the spec would
-                # silently shard the wrong dimensions.
-                if (
-                    len(spec)
-                    and np.ndim(leaf) == len(spec) + 1
-                    and shape[0] in declared_stacks
-                ):
-                    stack_ax = declared_stacks[shape[0]]
-                    trailing = tuple(spec)
-                    if stack_ax is not None:
-                        # Member-parallel layout: sharding the stack axis
-                        # over a mesh axis keeps each member WHOLE on its
-                        # devices, so trailing uses of the same axis are
-                        # dropped (a NamedSharding may name an axis once).
-                        trailing = tuple(
-                            None
-                            if a == stack_ax
-                            or (isinstance(a, tuple) and stack_ax in a)
-                            else a
-                            for a in trailing
-                        )
-                    spec = P(stack_ax, *trailing)
-                if len(spec) not in (0, np.ndim(leaf)):
-                    # Rank still disagrees after the stack gate (a
-                    # higher-rank param matching a dense-written rule):
-                    # replicate rather than let a short spec silently
-                    # shard whichever leading dims it happens to prefix.
-                    spec = P()
-                specs.append(spec if _spec_fits(spec, shape, mesh) else P())
-                break
-        else:
-            specs.append(P())
+        specs.append(spec)
     return jax.tree_util.tree_unflatten(flat[1], specs)
+
+
+def explain_partition_rules(
+    rules: Sequence[tuple[str, P]],
+    tree,
+    mesh: Mesh | None = None,
+    stack_axes: Sequence[tuple[int, str | None]] = DEFAULT_STACK_AXES,
+) -> list[dict]:
+    """Per-leaf rule attribution for :func:`match_partition_rules` —
+    ``[{name, shape, spec, outcome, rule}]`` in flatten order, built from
+    the SAME leaf logic (``_leaf_spec``) the shipping matcher uses.
+
+    The shape-aware partition-coverage gate
+    (``tools/d4pglint/wholeprog/partition_coverage.py``) instantiates the
+    real param trees abstractly (``jax.eval_shape``) and fails lint on
+    any leaf whose outcome is a ``fallback_*`` replication that is not
+    declared in ``DECLARED_REPLICATED`` — the PR-9 silent-replication bug
+    class, caught before a run ever pays E× replicated params."""
+    declared_stacks = dict(stack_axes)
+    flat = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, leaf in flat[0]:
+        name = _leaf_name(path)
+        shape = tuple(getattr(leaf, "shape", ()))
+        spec, info = _leaf_spec(name, shape, rules, mesh, declared_stacks)
+        out.append(
+            {"name": name, "shape": shape, "spec": spec, **info}
+        )
+    return out
 
 
 def _state_specs(

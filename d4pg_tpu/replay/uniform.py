@@ -18,6 +18,7 @@ import threading
 from typing import Mapping, NamedTuple
 
 import numpy as np
+from d4pg_tpu.analysis import lockwitness
 
 
 class Transition(NamedTuple):
@@ -90,7 +91,8 @@ class ReplayBuffer:
         # (readers tolerate one-batch staleness: unmirrored rows simply
         # ship on the next flush).
         self._total_added = 0
-        self._lock = threading.Lock()
+        # Witnessed under --debug-guards (static node id, see lockwitness)
+        self._lock = lockwitness.named_lock("ReplayBuffer._lock")
 
     def _encode_obs(self, obs: np.ndarray) -> np.ndarray:
         obs = np.atleast_2d(np.asarray(obs, np.float32))

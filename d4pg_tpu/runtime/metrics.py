@@ -21,6 +21,7 @@ import os
 import threading
 import time
 from typing import Mapping
+from d4pg_tpu.analysis import lockwitness
 
 
 def interval_crossed(prev_step: int, step: int, interval: int) -> bool:
@@ -68,7 +69,7 @@ class MetricsLogger:
         # log() is called from the learner thread (replaced-request train
         # rows) AND the evaluator thread (completed evals); serialize so
         # jsonl lines never interleave mid-record.
-        self._log_lock = threading.Lock()
+        self._log_lock = lockwitness.named_lock("MetricsLogger._log_lock")
 
     def log(self, step: int, scalars: Mapping[str, float], timers=None) -> None:
         """``timers`` (a :class:`~d4pg_tpu.utils.profiling.StageTimers`)

@@ -71,6 +71,7 @@ from d4pg_tpu.runtime.checkpoint import (
 from d4pg_tpu.runtime.evaluator import evaluate
 from d4pg_tpu.runtime.metrics import MetricsLogger, interval_crossed
 from d4pg_tpu.utils.profiling import StageTimers, annotate
+from d4pg_tpu.analysis import lockwitness
 
 
 _warned_no_procfs = False
@@ -821,7 +822,8 @@ class Trainer:
         self._cpu_params_step = -1
 
         self.has_pool = False
-        self._buffer_lock = threading.Lock()
+        # Witnessed under --debug-guards (static node ids, see lockwitness)
+        self._buffer_lock = lockwitness.named_lock("Trainer._buffer_lock")
         self._stop_collect = threading.Event()
         self._collector: Optional[threading.Thread] = None
         self._collector_error: Optional[BaseException] = None
@@ -833,7 +835,7 @@ class Trainer:
         # Orders producer clear+put against flusher empty-check+set; without
         # it the flusher can see empty(), lose the CPU to a producer's
         # clear+put, then set() over a queued-but-unapplied item (TOCTOU).
-        self._wb_idle_lock = threading.Lock()
+        self._wb_idle_lock = lockwitness.named_lock("Trainer._wb_idle_lock")
         self._actor_pub = None  # published param copy the async collector acts on
         self._eval_pool = None  # lazy parallel eval envs (host pool mode)
         # Concurrent evaluator (host envs): a dedicated thread scores
@@ -842,7 +844,7 @@ class Trainer:
         self._eval_thread: Optional[threading.Thread] = None
         # latest pending (params, step, scalars, env_steps, norm_state)
         self._eval_req = None
-        self._eval_req_lock = threading.Lock()
+        self._eval_req_lock = lockwitness.named_lock("Trainer._eval_req_lock")
         self._eval_pending = threading.Event()
         self._eval_idle = threading.Event()
         self._eval_idle.set()
@@ -1330,7 +1332,10 @@ class Trainer:
         flusher keeps pace with any learner rate instead of gating it."""
         try:
             while True:
-                item = self._wb_queue.get()
+                # Sentinel-terminated by contract: _stop_writeback always
+                # puts None (even on error paths its caller re-raises), so
+                # the blocking get cannot outlive the producer.
+                item = self._wb_queue.get()  # d4pglint: disable=thread-lifecycle  -- sentinel-terminated queue
                 if self._chaos is not None:
                     # Chaos wb_stall: a slow flusher must only SLOW the
                     # guarded learner (hold pacing), never trip the ledger
@@ -2337,7 +2342,13 @@ class Trainer:
     def _eval_worker(self):
         try:
             while True:
-                self._eval_pending.wait()
+                # Bounded wait: a stop path that sets _eval_stop but
+                # forgets the _eval_pending wake must park this thread at
+                # most one tick, not forever (the wake-ordering trap the
+                # lifecycle analyzer exists to close).
+                while not self._eval_pending.wait(0.5):
+                    if self._eval_stop.is_set():
+                        return
                 if self._eval_stop.is_set():
                     return
                 with self._eval_req_lock:
@@ -2658,3 +2669,11 @@ class Trainer:
             self._eval_env.close()
         if hasattr(self.env, "close"):
             self.env.close()
+        if self.sentinel is not None:
+            # Runtime lock-order witness vs the committed static graph:
+            # nesting this run performed that contradicts
+            # benchmarks/lock_order_graph.json raises here. LAST on
+            # purpose (the PolicyServer.drain precedent): a witness trip
+            # must fail the close loudly WITHOUT leaking the teardown
+            # above — pool worker processes, metrics, checkpoints, envs.
+            lockwitness.check_against_committed(where="trainer close")

@@ -65,6 +65,12 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
+    if args.debug_guards:
+        # Arm the lock-order witness BEFORE the server builds its locks;
+        # drain() checks the recorded nesting against the committed graph.
+        from d4pg_tpu.analysis import lockwitness
+
+        lockwitness.enable()
     from d4pg_tpu.serve.bundle import load_bundle
     from d4pg_tpu.serve.server import PolicyServer
 

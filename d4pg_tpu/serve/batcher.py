@@ -46,6 +46,7 @@ from d4pg_tpu.agent.state import D4PGConfig
 from d4pg_tpu.analysis.ledger import NULL_LEDGER
 from d4pg_tpu.serve.stats import ServeStats
 from d4pg_tpu.utils.profiling import StageTimers
+from d4pg_tpu.analysis import lockwitness
 
 
 class ShedError(Exception):
@@ -237,7 +238,9 @@ class DynamicBatcher:
         self._test_force_flip: Optional[int] = None
 
         self._queue: deque[_Request] = deque()
-        self._cond = threading.Condition()
+        # Witnessed under --debug-guards: the name is the lock's static
+        # node id in benchmarks/lock_order_graph.json (lockwitness docs).
+        self._cond = lockwitness.named_condition("DynamicBatcher._cond")
         self._draining = False
         self._stopped = False
         self._thread: Optional[threading.Thread] = None
@@ -249,7 +252,9 @@ class DynamicBatcher:
         # overlap it instead. The device thread hands over the DEVICE
         # result array; the reply thread pays the D2H fetch too.
         self._reply_q: deque = deque()
-        self._reply_cond = threading.Condition()
+        self._reply_cond = lockwitness.named_condition(
+            "DynamicBatcher._reply_cond"
+        )
         self._reply_thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------ lifecycle
@@ -556,8 +561,16 @@ class DynamicBatcher:
         try:
             while True:
                 with self._reply_cond:
+                    # Bounded wait: the notifier (device thread) can die
+                    # without stop() ever pushing the sentinel — this
+                    # thread must wake on its own clock and EXIT once the
+                    # device thread is gone and the reply queue is drained
+                    # (its death sweep already failed everything queued
+                    # behind us).
                     while not self._reply_q:
-                        self._reply_cond.wait()
+                        if self._thread_error is not None:
+                            return
+                        self._reply_cond.wait(0.5)
                     item = self._reply_q.popleft()
                 if item is None:
                     return

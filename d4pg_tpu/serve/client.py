@@ -34,6 +34,7 @@ import numpy as np
 from d4pg_tpu.serve import protocol
 from d4pg_tpu.serve.protocol import ProtocolError
 from d4pg_tpu.utils.retry import Backoff
+from d4pg_tpu.analysis import lockwitness
 
 
 class Overloaded(RuntimeError):
@@ -79,10 +80,12 @@ class PolicyClient:
         self._retry_rng = random.Random(retry_seed)
         # Serializes _reconnect against concurrent act() retries; never
         # held while blocking on a reply (only during dial/teardown).
-        self._conn_lock = threading.Lock()
-        self._send_lock = threading.Lock()
+        self._conn_lock = lockwitness.named_lock("PolicyClient._conn_lock")
+        self._send_lock = lockwitness.named_lock("PolicyClient._send_lock")
         self._pending: dict[int, Future] = {}
-        self._pending_lock = threading.Lock()
+        self._pending_lock = lockwitness.named_lock(
+            "PolicyClient._pending_lock"
+        )
         self._next_id = 0
         self._closed = False
         self._connect()

@@ -80,6 +80,7 @@ from d4pg_tpu.serve.client import ConnectionClosed, Overloaded, PolicyClient
 from d4pg_tpu.serve.protocol import ProtocolError
 from d4pg_tpu.serve.stats import LatencyReservoir
 from d4pg_tpu.utils.retry import Backoff
+from d4pg_tpu.analysis import lockwitness
 
 # Bundle file names, duplicated from serve/bundle.py ON PURPOSE: that
 # module imports the agent config (and with it JAX) at module top, and the
@@ -102,7 +103,7 @@ class RouterStats:
     + replies_error == answered requests."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockwitness.named_lock("RouterStats._lock")
         self._t0 = time.monotonic()
         self.requests_total = 0
         self.replies_ok = 0
@@ -200,6 +201,10 @@ class Router:
         "_rollback_deadline", "_deploys", "_promote_done",
         "_rollback_dir", "_backed_up",
     )
+    # d4pglint thread-lifecycle: per-connection reader threads are not
+    # joined — drain() closes every socket in _conns, which unblocks the
+    # blocking read_frame immediately (same contract as PolicyServer).
+    _DETACHED_THREADS = ("router-conn",)
 
     def __init__(
         self,
@@ -254,7 +259,8 @@ class Router:
         self._requested_port = port
         self.port: Optional[int] = None
         self.stats = RouterStats()
-        self._lock = threading.Lock()
+        # Witnessed under --debug-guards (static node ids, see lockwitness)
+        self._lock = lockwitness.named_lock("Router._lock")
         self._seq = 0
         self._obs_dim: Optional[int] = None
 
@@ -305,7 +311,7 @@ class Router:
 
         self._events: deque = deque(maxlen=1000)
         self._events_total = 0
-        self._events_lock = threading.Lock()
+        self._events_lock = lockwitness.named_lock("Router._events_lock")
 
         self._chaos = chaos
         self._log_dir = log_dir
@@ -317,7 +323,7 @@ class Router:
         self._control_thread: Optional[threading.Thread] = None
         self._metrics_thread: Optional[threading.Thread] = None
         self._conns: set = set()
-        self._conns_lock = threading.Lock()
+        self._conns_lock = lockwitness.named_lock("Router._conns_lock")
         self._shutdown = threading.Event()
         self._started = False
 
@@ -369,7 +375,8 @@ class Router:
         self._shutdown.set()
 
     def serve_until_shutdown(self) -> None:
-        self._shutdown.wait()
+        # Park-until-signal is the design (same contract as PolicyServer).
+        self._shutdown.wait()  # d4pglint: disable=thread-lifecycle  -- blocking forever is the serve loop
         self.drain()
 
     def drain(self, timeout: float = 30.0) -> None:
@@ -722,7 +729,9 @@ class Router:
                     self.stats.inc("replies_ok")
                     self.stats.latency.add(lat)
                     reply(protocol.ACT_OK, req_id,
-                          protocol.encode_action(f.result()))
+                          # inside f's own done-callback: resolved by
+                          # definition, result() cannot block
+                          protocol.encode_action(f.result()))  # d4pglint: disable=thread-lifecycle  -- done-callback, future resolved
                     return
                 if isinstance(exc, (Overloaded, ConnectionClosed)):
                     bo = state["backoff"]
@@ -810,7 +819,7 @@ class Router:
             ).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
-        send_lock = threading.Lock()
+        send_lock = lockwitness.named_lock("Router._serve_conn.send_lock")
         rfile = conn.makefile("rb")
 
         def reply(msg_type: int, req_id: int, payload: bytes = b"") -> None:

@@ -44,6 +44,7 @@ from d4pg_tpu.serve import protocol
 from d4pg_tpu.serve.batcher import DynamicBatcher, ShedError
 from d4pg_tpu.serve.bundle import PolicyBundle, bundle_mtime, load_bundle
 from d4pg_tpu.serve.protocol import ProtocolError
+from d4pg_tpu.analysis import lockwitness
 
 
 def load_best_actor_params(run_dir: str, config):
@@ -76,6 +77,12 @@ class PolicyServer:
         "bundle", "_bundle_mtime", "_best_mtime", "_last_reload",
         "_serving_bundle_mtime",
     )
+    # d4pglint thread-lifecycle: per-connection reader threads are not
+    # joined — drain() closes every socket in _conns, which unblocks the
+    # blocking read_frame immediately, and daemon=True bounds interpreter
+    # exit. Joining N client threads would serialize the drain on the
+    # slowest client.
+    _DETACHED_THREADS = ("serve-conn",)
 
     def __init__(
         self,
@@ -165,7 +172,7 @@ class PolicyServer:
         self._watch_thread: Optional[threading.Thread] = None
         self._metrics_thread: Optional[threading.Thread] = None
         self._conns: set[socket.socket] = set()
-        self._conns_lock = threading.Lock()
+        self._conns_lock = lockwitness.named_lock("PolicyServer._conns_lock")
         self._shutdown = threading.Event()
         self._started = False
 
@@ -203,7 +210,9 @@ class PolicyServer:
         self._shutdown.set()
 
     def serve_until_shutdown(self) -> None:
-        self._shutdown.wait()
+        # The main thread's park-until-signal IS the design: nothing but
+        # the signal handler (request_shutdown) ends a serving process.
+        self._shutdown.wait()  # d4pglint: disable=thread-lifecycle  -- blocking forever is the serve loop
         self.drain()
 
     def drain(self, timeout: float = 30.0) -> None:
@@ -259,6 +268,10 @@ class PolicyServer:
             # leaking the shutdown path above (metrics flush, client
             # socket closes, thread joins).
             self.sentinel.check("serve drain")
+            # Runtime lock-order witness vs the committed static graph
+            # (benchmarks/lock_order_graph.json): a nesting this process
+            # performed that contradicts the graph fails the drain.
+            lockwitness.check_against_committed(where="serve drain")
 
     # ------------------------------------------------------------- hot reload
     def _stat_best(self) -> Optional[float]:
@@ -401,7 +414,7 @@ class PolicyServer:
             ).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
-        send_lock = threading.Lock()
+        send_lock = lockwitness.named_lock("PolicyServer._serve_conn.send_lock")
         # Buffered read side: one kernel read drains whatever frames are
         # pipelined instead of 2+ recv syscalls per frame (a measured large
         # slice of per-request cost at saturation). Writes stay on the raw
@@ -468,7 +481,9 @@ class PolicyServer:
                         reply(
                             protocol.ACT_OK,
                             req_id,
-                            protocol.encode_action(f.result()),
+                            # inside f's own done-callback: resolved by
+                            # definition, result() cannot block
+                            protocol.encode_action(f.result()),  # d4pglint: disable=thread-lifecycle  -- done-callback, future resolved
                         )
                     elif isinstance(exc, ShedError):
                         reply(protocol.OVERLOADED, req_id, exc.reason.encode())

@@ -24,6 +24,7 @@ import threading
 import time
 
 import jax
+from d4pg_tpu.analysis import lockwitness
 
 
 @contextlib.contextmanager
@@ -72,7 +73,7 @@ class StageTimers:
 
     def __init__(self, annotate_prefix: str | None = "host/"):
         self._prefix = annotate_prefix
-        self._lock = threading.Lock()
+        self._lock = lockwitness.named_lock("StageTimers._lock")
         self._acc: dict[str, float] = {}
         self._n: dict[str, int] = {}
 

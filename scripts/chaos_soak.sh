@@ -47,6 +47,10 @@ python train.py "${common[@]}" --log-dir "$DIR/faulty" \
   | tee "$DIR/faulty.log"
 grep -q "worker_restart" "$DIR/faulty.log" \
   || { echo "CHAOS_SOAK_FAIL: no worker restart under injected faults"; exit 1; }
+# the runtime lock-order witness ran and confirmed the committed static
+# graph (a contradiction would have failed the run before this grep)
+grep -q "\[lockwitness\].*0 contradictions" "$DIR/faulty.log" \
+  || { echo "CHAOS_SOAK_FAIL: no lock-order witness verdict under --debug-guards"; exit 1; }
 
 # ---- leg 2: kill -9 a checkpointing run at a random instant ----------------
 python train.py "${common[@]}" --log-dir "$DIR/killed" \
@@ -170,6 +174,8 @@ python train.py "${fleet_learner[@]}" --resume \
   || { cat "$DIR/fleet_learner2.log"; echo "CHAOS_SOAK_FAIL: resumed fleet learner exited non-zero"; exit 1; }
 grep -q "\[checkpoint\] resumed from step" "$DIR/fleet_learner2.log" \
   || { cat "$DIR/fleet_learner2.log"; echo "CHAOS_SOAK_FAIL: fleet resume did not report its restored step"; exit 1; }
+grep -q "\[lockwitness\].*0 contradictions" "$DIR/fleet_learner2.log" \
+  || { cat "$DIR/fleet_learner2.log"; echo "CHAOS_SOAK_FAIL: resumed fleet learner recorded no lock-order witness verdict"; exit 1; }
 
 kill -TERM "$FACTOR"
 wait "$FACTOR" \

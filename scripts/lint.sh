@@ -1,9 +1,14 @@
 #!/usr/bin/env bash
-# Repo lint gate: d4pglint (repo-specific AST invariants, zero findings
-# required) + the benchmark/metrics JSON schema check. Wired into tier-1
-# both directly (scripts/tier1.sh runs this first) and as a test
-# (tests/test_d4pglint.py::test_repo_lints_clean), so the driver's
-# verbatim ROADMAP pytest command enforces it too.
+# Repo lint gate: d4pglint (repo-specific invariants, zero findings
+# required — per-file AST checks, the whole-program pass [lock-order
+# graph, protocol conformance, thread lifecycle, unused suppressions],
+# the docs-catalog drift check, and the shape-aware partition-rule
+# coverage gate in a subprocess) + the benchmark/metrics JSON schema
+# check (which also pins benchmarks/lock_order_graph.json acyclic and
+# fresh). Wired into tier-1 both directly (scripts/tier1.sh runs this
+# first) and as tests (tests/test_d4pglint.py::test_repo_lints_clean,
+# tests/test_wholeprog.py), so the driver's verbatim ROADMAP pytest
+# command enforces it too.
 #
 # Usage: scripts/lint.sh            # lint the product-code manifest
 #        scripts/lint.sh --show-suppressed   # audit the justifications

@@ -20,6 +20,69 @@ from tools.d4pglint.schema_check import (
     check_metrics_jsonl,
 )
 
+# A minimal conforming model of serve/protocol.py: all ten wire ids, the
+# protocol-module codecs, MAX_PAYLOAD-bounded framing, and the prober
+# endpoint. Shared with tests/test_wholeprog.py (its multi-file endpoint
+# fixtures need a clean protocol module in the map) so the two files can
+# never drift on what "conforming" means.
+PROTOCOL_GOOD_SRC = """
+import struct
+
+MAX_PAYLOAD = 1 << 20
+PROTOCOL_VERSION = 1
+HEADER = struct.Struct("<2sBBII")
+
+ACT = 1
+ACT_OK = 2
+OVERLOADED = 3
+ERROR = 4
+HEALTHZ = 5
+HEALTHZ_OK = 6
+HELLO = 7
+HELLO_OK = 8
+WINDOWS = 9
+WINDOWS_OK = 10
+
+
+class ProtocolError(Exception):
+    pass
+
+
+def read_frame(stream):
+    length = 0
+    if length > MAX_PAYLOAD:
+        raise ProtocolError("oversized")
+    return HEALTHZ_OK, 0, b""
+
+
+def write_frame(sock, msg_type, req_id, payload=b""):
+    if len(payload) > MAX_PAYLOAD:
+        raise ProtocolError("oversized")
+
+
+def encode_act(obs, deadline_us=0):
+    return b""
+
+
+def decode_act(payload, obs_dim):
+    return payload, 0
+
+
+def encode_action(action):
+    return b""
+
+
+def decode_action(payload):
+    return payload
+
+
+def probe_healthz(host, port):
+    msg_type, _req_id, payload = read_frame(None)
+    if msg_type != HEALTHZ_OK:
+        raise ProtocolError("unexpected healthz reply")
+    return {}
+"""
+
 # (check_id, relpath, bad_src, good_src) — relpath matters: several checks
 # key on the manifests in tools/d4pglint/config.py.
 FIXTURES = [
@@ -307,6 +370,114 @@ FIXTURES = [
 
         def host_helper(x):
             return np.asarray(x).item()  # not in the manifest: fine
+        """,
+    ),
+    (
+        "lock-order",
+        "d4pg_tpu/runtime/x.py",
+        """
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._alock = threading.Lock()
+                self._block = threading.Lock()
+
+            def forward(self):
+                with self._alock:
+                    with self._block:
+                        pass
+
+            def backward(self):
+                with self._block:
+                    with self._alock:
+                        pass
+        """,
+        """
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._alock = threading.Lock()
+                self._block = threading.Lock()
+
+            def forward(self):
+                with self._alock:
+                    with self._block:  # consistent global order
+                        pass
+
+            def backward(self):
+                with self._alock:
+                    pass
+                with self._block:  # sequential, never nested inverted
+                    pass
+        """,
+    ),
+    (
+        "protocol-conformance",
+        "d4pg_tpu/serve/protocol.py",
+        """
+        ACT = 1
+        ACT_OK = 1
+        """,
+        PROTOCOL_GOOD_SRC,
+    ),
+    (
+        "thread-lifecycle",
+        "d4pg_tpu/runtime/x.py",
+        """
+        import threading
+
+        class Pump:
+            def start(self):
+                self._t = threading.Thread(
+                    target=self._loop, name="pump", daemon=True
+                )
+                self._t.start()
+
+            def _loop(self):
+                self._cond.wait()
+
+            def close(self):
+                pass
+        """,
+        """
+        import threading
+
+        class Pump:
+            _DETACHED_THREADS = ("pump-conn",)  # unblocked by close()'s socket close
+
+            def start(self):
+                self._t = threading.Thread(
+                    target=self._loop, name="pump", daemon=True
+                )
+                self._t.start()
+                threading.Thread(
+                    target=self._loop, name="pump-conn", daemon=True
+                ).start()
+
+            def _loop(self):
+                with self._cond:
+                    self._cond.wait(0.5)
+
+            def close(self):
+                self._t.join(timeout=5)
+        """,
+    ),
+    (
+        "unused-suppression",
+        "d4pg_tpu/runtime/x.py",
+        """
+        import time
+
+        def f():
+            return time.monotonic()  # d4pglint: disable=wall-clock-deadline  -- stale: the fix landed
+        """,
+        """
+        import time
+
+        def g():
+            return time.time()  # d4pglint: disable=wall-clock-deadline  -- human-facing timestamp
         """,
     ),
 ]

@@ -1,0 +1,634 @@
+"""Whole-program analysis self-tests (tools/d4pglint/wholeprog/).
+
+Per the established convention each analyzer has bad-fires / good-clean /
+suppression fixtures in tests/test_d4pglint.py:FIXTURES (single-file);
+this file covers what single-file fixtures cannot:
+
+- CROSS-FILE lock-order cycles (the whole point of the whole-program
+  pass), the committed ``benchmarks/lock_order_graph.json`` artifact and
+  its schema_check pins (shape, acyclicity, freshness);
+- the runtime lock-order witness, including the seeded synthetic
+  deadlock that the witness catches at run time AND the static pass
+  flags in the equivalent source;
+- the shape-aware partition-coverage gate: passes on the real model zoo,
+  FAILS on the injected undeclared-stack fixture (the PR-9 bug, seeded);
+- the docs-catalog drift check and the remaining analyzer sub-rules
+  (bounded-queue admission, protocol silent-drop, unused-suppression
+  pass B);
+- regression tests for the real findings the repo sweep surfaced
+  (FleetLink's silently-unaccounted unexpected reply type).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tools.d4pglint.core import lint_source, lint_sources
+from tools.d4pglint.schema_check import check_lock_order_graph
+from tools.d4pglint.wholeprog.docscheck import check_docs
+from tools.d4pglint.wholeprog.lockgraph import (
+    build_lock_graph,
+    find_cycles,
+    is_acyclic,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _files(sources: dict) -> dict:
+    return {
+        rel: (ast.parse(textwrap.dedent(src)), textwrap.dedent(src).splitlines())
+        for rel, src in sources.items()
+    }
+
+
+# ------------------------------------------------------- cross-file lock order
+_CROSS_A = """
+import threading
+
+
+class Source:
+    def __init__(self):
+        self._alock = threading.Lock()
+        self.sink = Sink()
+
+    def push(self):
+        with self._alock:
+            self.sink.write()
+
+    def lock_a(self):
+        with self._alock:
+            pass
+"""
+
+_CROSS_B_GOOD = """
+import threading
+
+
+class Sink:
+    def __init__(self):
+        self._block = threading.Lock()
+
+    def write(self):
+        with self._block:
+            pass
+"""
+
+_CROSS_B_BAD = _CROSS_B_GOOD + """
+
+    def bind(self):
+        from d4pg_tpu.runtime.a import Source
+
+        self.owner = Source()
+
+    def reverse(self):
+        with self._block:
+            self.owner.lock_a()
+"""
+
+
+def test_lock_order_cycle_across_files_fires():
+    findings, _ = lint_sources(
+        {"d4pg_tpu/runtime/a.py": textwrap.dedent(_CROSS_A),
+         "d4pg_tpu/runtime/b.py": textwrap.dedent(_CROSS_B_BAD)},
+        checks=["lock-order"],
+    )
+    assert findings, "cross-file inversion not detected"
+    assert all(f.check == "lock-order" for f in findings)
+    assert "Source._alock" in findings[0].message
+    assert "Sink._block" in findings[0].message
+
+
+def test_lock_order_cross_file_nesting_without_cycle_is_clean():
+    findings, _ = lint_sources(
+        {"d4pg_tpu/runtime/a.py": textwrap.dedent(_CROSS_A),
+         "d4pg_tpu/runtime/b.py": textwrap.dedent(_CROSS_B_GOOD)},
+        checks=["lock-order"],
+    )
+    assert findings == [], findings
+    # ...but the EDGE is in the graph (the nesting was seen, just acyclic)
+    graph = build_lock_graph(_files(
+        {"d4pg_tpu/runtime/a.py": _CROSS_A,
+         "d4pg_tpu/runtime/b.py": _CROSS_B_GOOD}
+    ))
+    pairs = {(e["from"], e["to"]) for e in graph["edges"]}
+    assert ("Source._alock", "Sink._block") in pairs
+
+
+def test_find_cycles_and_acyclicity_primitives():
+    assert find_cycles([("a", "b"), ("b", "a")])
+    assert find_cycles([("a", "a")]) == [["a", "a"]]
+    assert not find_cycles([("a", "b"), ("b", "c")])
+    assert is_acyclic(["a", "b", "c"], [("a", "b"), ("b", "c")])
+    assert not is_acyclic(["a", "b"], [("a", "b"), ("b", "a")])
+    assert not is_acyclic(["a"], [("a", "a")])
+
+
+# ----------------------------------------------------- committed graph artifact
+def test_committed_lock_graph_is_valid_acyclic_and_fresh():
+    path = f"{REPO}/benchmarks/lock_order_graph.json"
+    assert check_lock_order_graph(path, root=REPO) == []
+
+
+def test_lock_graph_schema_rejects_cyclic_and_malformed(tmp_path):
+    base = {
+        "schema": "lock_order_graph/v1",
+        "generated_by": "test",
+        "nodes": ["A", "B"],
+    }
+    cyclic = dict(base, edges=[
+        {"from": "A", "to": "B", "sites": ["x.py"]},
+        {"from": "B", "to": "A", "sites": ["x.py"]},
+    ])
+    p = tmp_path / "g.json"
+    p.write_text(json.dumps(cyclic))
+    errs = check_lock_order_graph(str(p))
+    assert any("CYCLIC" in e for e in errs), errs
+
+    dangling = dict(base, edges=[
+        {"from": "A", "to": "C", "sites": ["x.py"]},
+    ])
+    p.write_text(json.dumps(dangling))
+    errs = check_lock_order_graph(str(p))
+    assert any("not in 'nodes'" in e for e in errs), errs
+
+    ok = dict(base, edges=[{"from": "A", "to": "B", "sites": ["x.py"]}])
+    p.write_text(json.dumps(ok))
+    assert check_lock_order_graph(str(p)) == []
+
+    p.write_text("{")
+    assert check_lock_order_graph(str(p))
+
+
+def test_lock_graph_freshness_detects_drift(tmp_path):
+    # an artifact claiming zero locks against the real repo = stale
+    p = tmp_path / "g.json"
+    p.write_text(json.dumps({
+        "schema": "lock_order_graph/v1", "generated_by": "test",
+        "nodes": [], "edges": [],
+    }))
+    errs = check_lock_order_graph(str(p), root=REPO)
+    assert any("stale" in e and "--write" in e for e in errs), errs
+
+
+# ------------------------------------------------------------ runtime witness
+_SEEDED_DEADLOCK_SRC = """
+import threading
+
+
+class Fix:
+    def __init__(self):
+        self.a_lock = threading.Lock()
+        self.b_lock = threading.Lock()
+
+    def forward(self):
+        with self.a_lock:
+            with self.b_lock:
+                pass
+
+    def backward(self):
+        with self.b_lock:
+            with self.a_lock:
+                pass
+"""
+
+
+def test_seeded_deadlock_caught_by_witness_and_static_pass():
+    """The acceptance fixture: ONE seeded inversion, flagged by BOTH
+    halves — the runtime witness when the nesting executes, the static
+    pass when the equivalent source is linted."""
+    from d4pg_tpu.analysis import lockwitness
+
+    lockwitness.reset()
+    lockwitness.enable()
+    try:
+        a = lockwitness.named_lock("Fix.a_lock")
+        b = lockwitness.named_lock("Fix.b_lock")
+        with a:            # the exact nesting _SEEDED_DEADLOCK_SRC encodes
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        with pytest.raises(lockwitness.LockOrderWitnessError) as ei:
+            lockwitness.check_against({"nodes": ["Fix.a_lock", "Fix.b_lock"],
+                                       "edges": []})
+        assert "Fix.a_lock" in str(ei.value)
+    finally:
+        lockwitness.reset()
+    findings, _ = lint_source(
+        textwrap.dedent(_SEEDED_DEADLOCK_SRC), "d4pg_tpu/runtime/x.py",
+        checks=["lock-order"],
+    )
+    assert findings, "static pass missed the seeded deadlock fixture"
+
+
+def test_witness_consistent_nesting_passes_and_counts():
+    from d4pg_tpu.analysis import lockwitness
+
+    lockwitness.reset()
+    lockwitness.enable()
+    try:
+        outer = lockwitness.named_condition("W.outer_cond")
+        inner = lockwitness.named_lock("W.inner_lock")
+        for _ in range(3):
+            with outer:
+                with inner:
+                    pass
+        summary = lockwitness.check_against({
+            "nodes": ["W.outer_cond", "W.inner_lock"],
+            "edges": [{"from": "W.outer_cond", "to": "W.inner_lock",
+                       "sites": ["x.py"]}],
+        })
+        assert summary["contradictions"] == 0
+        assert summary["observed_edges"] == 1
+        assert summary["novel_edges"] == 0
+        # a novel edge the static pass missed is tolerated, not fatal
+        with inner:
+            pass
+        with outer:
+            pass
+    finally:
+        lockwitness.reset()
+
+
+def test_witness_reentrant_rlock_is_not_a_contradiction():
+    """Regression: reentrant acquisition of one RLock object must record
+    no self-edge (legal), while nesting two DIFFERENT instances sharing
+    a node name is a real two-instance ordering hazard and stays fatal."""
+    from d4pg_tpu.analysis import lockwitness
+
+    lockwitness.reset()
+    lockwitness.enable()
+    try:
+        r = lockwitness.named_rlock("R.r_lock")
+        with r:
+            with r:  # reentrant: same object
+                pass
+        assert lockwitness.check_against({"nodes": ["R.r_lock"],
+                                          "edges": []})["contradictions"] == 0
+        a = lockwitness.named_lock("Pair.p_lock")
+        b = lockwitness.named_lock("Pair.p_lock")  # second INSTANCE
+        with a:
+            with b:
+                pass
+        with pytest.raises(lockwitness.LockOrderWitnessError):
+            lockwitness.check_against({"nodes": ["Pair.p_lock"], "edges": []})
+    finally:
+        lockwitness.reset()
+
+
+def test_witness_disabled_returns_plain_primitives():
+    from d4pg_tpu.analysis import lockwitness
+
+    lockwitness.reset()
+    lock = lockwitness.named_lock("X.lock")
+    assert type(lock).__name__ != "_Witnessed"
+    with lock:
+        pass
+    assert lockwitness.observed_edges() == {}
+
+
+def test_witness_condition_proxy_supports_wait_notify():
+    from d4pg_tpu.analysis import lockwitness
+
+    lockwitness.reset()
+    lockwitness.enable()
+    try:
+        cond = lockwitness.named_condition("W.cond")
+        hit = []
+
+        def waiter():
+            with cond:
+                while not hit:
+                    cond.wait(0.2)
+
+        t = threading.Thread(target=waiter, name="w", daemon=True)
+        t.start()
+        time.sleep(0.05)
+        with cond:
+            hit.append(1)
+            cond.notify_all()
+        t.join(timeout=5)
+        assert not t.is_alive()
+    finally:
+        lockwitness.reset()
+
+
+def test_witness_names_in_product_code_match_static_graph_nodes():
+    """Every named_lock/named_condition id wired into d4pg_tpu must BE a
+    node of the committed static graph — the two halves share one
+    identity space or the comparison is meaningless."""
+    with open(f"{REPO}/benchmarks/lock_order_graph.json") as f:
+        nodes = set(json.load(f)["nodes"])
+    wired = set()
+    for dirpath, _dirs, fnames in os.walk(f"{REPO}/d4pg_tpu"):
+        for fn in fnames:
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, fn), encoding="utf-8") as fh:
+                tree = ast.parse(fh.read())
+            for node in ast.walk(tree):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in (
+                        "named_lock", "named_rlock", "named_condition"
+                    )
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                ):
+                    wired.add(str(node.args[0].value))
+    assert wired, "no witness wiring found in d4pg_tpu"
+    missing = wired - nodes
+    assert not missing, (
+        f"witness names with no static-graph node: {sorted(missing)} — "
+        "regenerate benchmarks/lock_order_graph.json or fix the name"
+    )
+
+
+# --------------------------------------------------------- partition coverage
+def test_partition_gate_passes_on_real_model_zoo():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.d4pglint.wholeprog.partition_coverage"],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "partition-coverage: OK" in proc.stdout
+
+
+def test_partition_gate_fails_on_injected_undeclared_stack():
+    """The PR-9 bug, seeded: an E=5 ensemble with its stack declaration
+    withheld must be FLAGGED (the CLI exits 0 iff the gate caught it)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.d4pglint.wholeprog.partition_coverage",
+         "--inject-undeclared-stack"],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "caught" in proc.stdout
+
+
+def test_explain_partition_rules_matches_shipping_matcher():
+    """The audit's attribution and the shipping matcher share _leaf_spec;
+    prove the specs agree leaf-for-leaf on a concrete tree."""
+    import jax
+
+    from d4pg_tpu.parallel.partition import (
+        DEFAULT_RULES,
+        explain_partition_rules,
+        match_partition_rules,
+    )
+
+    params = {
+        "hidden_0": {"kernel": np.zeros((8, 16)), "bias": np.zeros(16)},
+        "hidden_1": {"kernel": np.zeros((16, 16))},
+        "out": {"kernel": np.zeros((16, 4)), "bias": np.zeros(4)},
+        # an UNdeclared 3-stack over a dense-written rule: rank mismatch
+        # survives the stack gate and must replicate (the PR-9 shape)
+        "hidden_2": {"kernel": np.zeros((3, 16, 16))},
+    }
+    specs = jax.tree_util.tree_leaves(
+        match_partition_rules(DEFAULT_RULES, params),
+        is_leaf=lambda x: hasattr(x, "index") or x == () or True,
+    )
+    rows = explain_partition_rules(DEFAULT_RULES, params)
+    assert len(rows) == 6
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    by_name = {r["name"]: r for r in rows}
+    from jax.sharding import PartitionSpec as P
+
+    matched = match_partition_rules(DEFAULT_RULES, params)
+    for path, _leaf in flat:
+        name = "/".join(str(getattr(k, "key", k)) for k in path)
+        node = matched
+        for k in path:
+            node = node[getattr(k, "key", k)]
+        assert by_name[name]["spec"] == node, name
+    assert by_name["hidden_2/kernel"]["outcome"] == "fallback_rank"
+    assert by_name["hidden_2/kernel"]["spec"] == P()
+    assert by_name["hidden_0/kernel"]["outcome"] == "rule"
+    del specs  # silence linters: the tree comparison above is the check
+
+
+# ---------------------------------------------------------------- docs drift
+def test_docs_catalog_is_in_sync():
+    assert check_docs(REPO) == []
+
+
+def test_docs_drift_detected_when_row_or_heading_missing(tmp_path):
+    with open(f"{REPO}/docs/analysis.md", encoding="utf-8") as f:
+        text = f.read()
+    # drop a check row
+    p = tmp_path / "analysis.md"
+    p.write_text("\n".join(
+        l for l in text.splitlines() if not l.startswith("| `lock-order`")
+    ))
+    errs = check_docs(REPO, docs_path=str(p))
+    assert any("`lock-order`" in e for e in errs), errs
+    # drop a runtime-guard heading
+    p.write_text(text.replace("### Lock-order witness", "### renamed"))
+    errs = check_docs(REPO, docs_path=str(p))
+    assert any("Lock-order witness" in e for e in errs), errs
+
+
+# ------------------------------------------------- remaining analyzer sub-rules
+def test_bounded_queue_put_without_admission_control_fires():
+    bad = """
+    class DynamicBatcher:
+        def submit(self, req):
+            self._queue.append(req)
+    """
+    findings, _ = lint_source(
+        textwrap.dedent(bad), "d4pg_tpu/serve/batcher.py",
+        checks=["thread-lifecycle"],
+    )
+    assert any("admission control" in f.message for f in findings), findings
+    good = """
+    class DynamicBatcher:
+        def submit(self, req):
+            if len(self._queue) >= self.queue_limit:
+                raise ShedError("queue_full")
+            self._queue.append(req)
+    """
+    findings, _ = lint_source(
+        textwrap.dedent(good), "d4pg_tpu/serve/batcher.py",
+        checks=["thread-lifecycle"],
+    )
+    assert findings == [], findings
+
+
+# the shared conforming protocol model (single source: test_d4pglint.py)
+from tests.test_d4pglint import PROTOCOL_GOOD_SRC as _MINIMAL_PROTOCOL  # noqa: E402
+
+
+def test_protocol_silent_drop_branch_fires():
+    server_bad = """
+    from d4pg_tpu.serve import protocol
+
+
+    class PolicyServer:
+        def _serve_conn(self, conn):
+            while True:
+                frame = protocol.read_frame(conn)
+                if frame is None:
+                    return
+                msg_type, req_id, payload = frame
+                if msg_type == protocol.HEALTHZ:
+                    continue
+                if msg_type != protocol.ACT:
+                    raise protocol.ProtocolError("bad")
+                protocol.write_frame(conn, protocol.ACT_OK, req_id, payload)
+    """
+    findings, _ = lint_sources(
+        {"d4pg_tpu/serve/protocol.py": textwrap.dedent(_MINIMAL_PROTOCOL),
+         "d4pg_tpu/serve/server.py": textwrap.dedent(server_bad)},
+        checks=["protocol-conformance"],
+    )
+    drops = [f for f in findings if "silent drop" in f.message]
+    assert drops, findings
+    assert drops[0].path == "d4pg_tpu/serve/server.py"
+
+
+def test_protocol_raw_recv_outside_protocol_module_fires():
+    client_bad = """
+    def read_reply(sock):
+        return sock.recv(4096)
+    """
+    findings, _ = lint_sources(
+        {"d4pg_tpu/serve/protocol.py": textwrap.dedent(_MINIMAL_PROTOCOL),
+         "d4pg_tpu/serve/client.py": textwrap.dedent(client_bad)},
+        checks=["protocol-conformance"],
+    )
+    assert any(".recv()" in f.message for f in findings), findings
+
+
+def test_unused_suppression_meta_comment_cannot_self_suppress():
+    src = "x = 1  # d4pglint: disable=unused-suppression  -- nothing here\n"
+    findings, _ = lint_source(
+        src, "d4pg_tpu/runtime/x.py", checks=["unused-suppression"]
+    )
+    assert len(findings) == 1
+    assert "unused-suppression" in findings[0].message
+
+
+def test_unknown_check_id_in_suppression_is_flagged():
+    src = "x = 1  # d4pglint: disable=wall-clock-dedline  -- typo'd id\n"
+    findings, _ = lint_source(
+        src, "d4pg_tpu/runtime/x.py", checks=["unused-suppression"]
+    )
+    assert len(findings) == 1
+    assert "unknown check id" in findings[0].message
+
+
+def test_repo_protocol_endpoints_manifest_is_not_stale():
+    """Every PROTOCOL_ENDPOINTS row resolves to a real function in the
+    real repo (a renamed receive loop must fail here, not silently
+    un-check itself)."""
+    from tools.d4pglint.core import parse_default_files
+    from tools.d4pglint.wholeprog.config import PROTOCOL_ENDPOINTS
+    from tools.d4pglint.wholeprog.protocolcheck import _function
+
+    files = parse_default_files(REPO)
+    for endpoint, (qual, _handled) in PROTOCOL_ENDPOINTS.items():
+        assert _function(files, qual) is not None, (endpoint, qual)
+
+
+# --------------------------------------------------- sweep-fix regression tests
+def _fake_ingest_server(reply_type: int, ready: threading.Event, out: dict):
+    """One-connection ingest impostor: real HELLO_OK handshake, then
+    answers the first WINDOWS frame with ``reply_type``."""
+    from d4pg_tpu.fleet import wire
+    from d4pg_tpu.serve import protocol
+
+    srv = socket.create_server(("127.0.0.1", 0))
+    out["port"] = srv.getsockname()[1]
+    ready.set()
+    conn, _ = srv.accept()
+    try:
+        rfile = conn.makefile("rb")
+        msg_type, req_id, payload = protocol.read_frame(rfile)
+        assert msg_type == protocol.HELLO
+        protocol.write_frame(
+            conn, protocol.HELLO_OK, req_id,
+            wire.encode_hello_ok(generation=0, max_windows=8, max_inflight=2),
+        )
+        msg_type, req_id, payload = protocol.read_frame(rfile)
+        assert msg_type == protocol.WINDOWS
+        protocol.write_frame(conn, reply_type, req_id, b"")
+        time.sleep(0.5)  # let the client reader process before teardown
+    finally:
+        conn.close()
+        srv.close()
+
+
+def test_fleet_link_accounts_unexpected_reply_type_as_dropped():
+    """Regression (whole-program sweep finding): an unexpected reply type
+    for a known req_id popped the pending entry WITHOUT any ack callback,
+    silently losing the frame from the emitted==accounted identity. The
+    link must now count the windows dropped and die loudly."""
+    from d4pg_tpu.fleet.actor import FleetLink
+    from d4pg_tpu.serve import protocol
+
+    ready = threading.Event()
+    out: dict = {}
+    srv = threading.Thread(
+        target=_fake_ingest_server, args=(protocol.ACT_OK, ready, out),
+        name="fake-ingest", daemon=True,
+    )
+    srv.start()
+    assert ready.wait(5)
+
+    acks: list = []
+    link = FleetLink(
+        "127.0.0.1", out["port"],
+        dict(actor_id="t", env="e", obs_dim=4, action_dim=2, n_step=1,
+             gamma=0.99, generation=0),
+        on_ack=lambda kind, n: acks.append((kind, n)),
+    )
+    try:
+        cols = {
+            "obs": np.zeros((3, 4), np.float32),
+            "action": np.zeros((3, 2), np.float32),
+            "reward": np.zeros(3, np.float32),
+            "next_obs": np.zeros((3, 4), np.float32),
+            "discount": np.ones(3, np.float32),
+        }
+        assert link.acquire_credit(5.0)
+        n = link.send_windows(0, cols)
+        assert n == 3
+        deadline = time.monotonic() + 5.0
+        while link.dead is None and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert link.dead is not None, "link survived an unexpected reply type"
+        # the identity: every window of the frame is accounted, as dropped
+        assert ("dropped", 3) in acks, acks
+        assert link.inflight() == 0
+    finally:
+        link.close()
+        srv.join(timeout=5)
+
+
+def test_repo_lock_graph_has_the_known_cross_file_edges():
+    """The committed graph carries the load-bearing cross-file nesting
+    facts (trainer holds its buffer lock across replay's lock; the
+    batcher's condition is held across the stats locks) — if resolution
+    regresses to per-file only, these vanish and this test fails before
+    the artifact quietly goes blind."""
+    with open(f"{REPO}/benchmarks/lock_order_graph.json") as f:
+        doc = json.load(f)
+    pairs = {(e["from"], e["to"]) for e in doc["edges"]}
+    assert ("Trainer._buffer_lock", "ReplayBuffer._lock") in pairs
+    assert ("DynamicBatcher._cond", "ServeStats._lock") in pairs
+    assert is_acyclic(doc["nodes"], list(pairs))

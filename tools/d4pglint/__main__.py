@@ -3,15 +3,24 @@
 ``--list-checks`` prints the catalog ids; ``--show-suppressed`` also
 prints findings that a ``# d4pglint: disable=`` comment silenced (audit
 mode for reviewing justifications).
+
+A default-manifest run (no explicit paths, no ``--check``) additionally
+runs the two whole-program gates that are not per-line source checks:
+the docs-catalog drift check (``wholeprog/docscheck.py``) and — in a
+subprocess, because it EXECUTES repo code to instantiate the real param
+trees under ``JAX_PLATFORMS=cpu`` — the shape-aware partition-rule
+coverage gate (``wholeprog/partition_coverage.py``). ``--static-only``
+skips both (the pure-AST fast path, what ``lint_paths()`` computes).
 """
 
 from __future__ import annotations
 
 import argparse
+import subprocess
 import sys
 
 from tools.d4pglint.config import ALL_CHECKS, DEFAULT_PATHS
-from tools.d4pglint.core import lint_paths
+from tools.d4pglint.core import lint_paths, repo_root
 
 
 def main(argv=None) -> int:
@@ -23,6 +32,9 @@ def main(argv=None) -> int:
     p.add_argument("--list-checks", action="store_true")
     p.add_argument("--show-suppressed", action="store_true",
                    help="also print findings silenced by disable= comments")
+    p.add_argument("--static-only", action="store_true",
+                   help="skip the docs-drift and partition-coverage gates "
+                        "that a default-manifest run adds")
     args = p.parse_args(argv)
     if args.list_checks:
         for c in ALL_CHECKS:
@@ -38,12 +50,30 @@ def main(argv=None) -> int:
     if args.show_suppressed:
         for f in suppressed:
             print(f"(suppressed) {f}")
-    n = len(findings)
+    extra = 0
+    if not args.paths and not args.checks and not args.static_only:
+        from tools.d4pglint.wholeprog.docscheck import check_docs
+
+        docs_errs = check_docs(repo_root())
+        for e in docs_errs:
+            print(e)
+        extra += len(docs_errs)
+        # The partition gate instantiates the real model zoo — repo code
+        # EXECUTES, so it runs isolated in its own CPU-pinned process
+        # (the lint process itself never imports linted code).
+        proc = subprocess.run(
+            [sys.executable, "-m",
+             "tools.d4pglint.wholeprog.partition_coverage"],
+            cwd=repo_root(),
+        )
+        if proc.returncode != 0:
+            extra += 1
+    n = len(findings) + extra
     print(
         f"d4pglint: {n} finding{'s' if n != 1 else ''}, "
         f"{len(suppressed)} suppressed"
     )
-    return 1 if findings else 0
+    return 1 if n else 0
 
 
 if __name__ == "__main__":

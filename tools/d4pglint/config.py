@@ -21,6 +21,12 @@ ALL_CHECKS = (
     "global-rng",            # seeded Generators only, no np.random module state
     "unbounded-retry",       # retry loops use the bounded Backoff util
     "device-loop-transfer",  # no host numpy / .item() in megastep bodies
+    # -- whole-program checks (tools/d4pglint/wholeprog/): the full parsed
+    #    file map at once, not one AST at a time --
+    "lock-order",            # global lock-acquisition-order graph is acyclic
+    "protocol-conformance",  # wire-id space: codecs, endpoints, MAX_PAYLOAD
+    "thread-lifecycle",      # bounded joins, shed answers, timed waits
+    "unused-suppression",    # disable= comments must still silence something
 )
 
 # What `python -m tools.d4pglint` lints when given no paths: the product
@@ -79,6 +85,10 @@ HOST_ONLY_MODULES = (
     "d4pg_tpu/chaos.py",
     "d4pg_tpu/analysis/__init__.py",
     "d4pg_tpu/analysis/ledger.py",
+    # The lock-order witness wraps locks in host-only modules (router,
+    # fleet hosts, the replay data plane) — a JAX import here would leak
+    # into every one of them.
+    "d4pg_tpu/analysis/lockwitness.py",
 )
 
 # JAX-runtime packages whose top-level import violates host-only-ness.

@@ -5,6 +5,18 @@ comment on the finding's line or the line directly above it (use the
 rest of the comment to say WHY — the repo convention is
 ``# d4pglint: disable=<id>  -- justification``). ``disable=all``
 suppresses every check for that line; use it never.
+
+Two check families run in one pass: the per-file checks
+(``tools/d4pglint/checks.py`` — one AST at a time) and the whole-program
+checks (``tools/d4pglint/wholeprog/`` — the full parsed file map at
+once: lock-order graph, protocol conformance, thread lifecycle). Both
+emit the same :class:`Finding` and answer to the same suppression
+mechanics.
+
+The driver also audits the suppressions themselves: a ``disable=``
+comment that no longer silences any finding (the check was fixed, the
+code moved, the id was typo'd) is an ``unused-suppression`` finding —
+stale suppressions are how real findings sneak back in unreviewed.
 """
 
 from __future__ import annotations
@@ -20,6 +32,9 @@ _SUPPRESS_RE = re.compile(
     r"#\s*d4pglint:\s*disable=([a-z0-9_\-]+(?:\s*,\s*[a-z0-9_\-]+)*)"
 )
 
+#: the meta check: audits the suppression comments themselves
+META_CHECK = "unused-suppression"
+
 
 @dataclass(frozen=True)
 class Finding:
@@ -33,12 +48,14 @@ class Finding:
 
 
 def _suppressions(src_lines: list[str]) -> dict[int, set[str]]:
-    """line (1-indexed) -> set of check ids disabled on that line."""
+    """line (1-indexed) -> set of check ids disabled on that line. All
+    ``disable=`` comments on a line contribute (finditer, not search)."""
     out: dict[int, set[str]] = {}
     for i, line in enumerate(src_lines, start=1):
-        m = _SUPPRESS_RE.search(line)
-        if m:
-            ids = {s.strip() for s in m.group(1).split(",") if s.strip()}
+        ids: set[str] = set()
+        for m in _SUPPRESS_RE.finditer(line):
+            ids |= {s.strip() for s in m.group(1).split(",") if s.strip()}
+        if ids:
             out[i] = ids
     return out
 
@@ -51,28 +68,155 @@ def _is_suppressed(f: Finding, sup: dict[int, set[str]]) -> bool:
     return False
 
 
+def _split_checks(selected):
+    """(per-file ids, whole-program ids) from a selection."""
+    from tools.d4pglint import checks as checks_mod
+    from tools.d4pglint import wholeprog
+
+    wholeprog._load()
+    per_file = [c for c in selected if c in checks_mod.REGISTRY]
+    whole = [c for c in selected if c in wholeprog.REGISTRY]
+    return per_file, whole
+
+
+def _raw_findings(files: dict, check_ids, root) -> list[Finding]:
+    """Run checks over the parsed file map; no suppression filtering."""
+    from tools.d4pglint import checks as checks_mod
+    from tools.d4pglint import wholeprog
+
+    per_file, whole = _split_checks(check_ids)
+    raw: list[Finding] = []
+    for rel, (tree, src_lines) in sorted(files.items()):
+        for check_id in per_file:
+            raw.extend(checks_mod.REGISTRY[check_id](tree, src_lines, rel))
+    if whole:
+        raw.extend(wholeprog.run_checks(files, whole, root))
+    return raw
+
+
+def _unused_suppression_findings(
+    files: dict, raw: list[Finding], sup_by_file: dict
+) -> tuple[list[Finding], list[Finding]]:
+    """``(pass_a, pass_b)``: pass A is one finding per suppression-comment
+    line whose ids silenced nothing (normal suppression mechanics apply);
+    pass B audits ``disable=unused-suppression`` comments themselves — a
+    meta suppression that silences no pass-A finding is stale, and pass-B
+    findings are reported unsuppressibly (else they could never fire)."""
+    used: set = set()  # (rel, line, id-or-'all')
+    for f in raw:
+        sup = sup_by_file.get(f.path, {})
+        for line in (f.line, f.line - 1):
+            ids = sup.get(line, ())
+            if f.check in ids:
+                used.add((f.path, line, f.check))
+            if "all" in ids:
+                used.add((f.path, line, "all"))
+    pass_a: list[Finding] = []
+    pass_b: list[Finding] = []
+    meta_lines: set = set()  # (rel, line) carrying a pass-A finding
+    for rel, sup in sorted(sup_by_file.items()):
+        for line, ids in sorted(sup.items()):
+            unused = []
+            for check_id in sorted(ids):
+                if check_id == META_CHECK:
+                    continue  # audited in pass B below
+                if (rel, line, check_id) in used:
+                    continue
+                if check_id != "all" and check_id not in ALL_CHECKS:
+                    unused.append(f"{check_id} (unknown check id)")
+                else:
+                    unused.append(check_id)
+            if unused:
+                meta_lines.add((rel, line))
+                pass_a.append(
+                    Finding(
+                        META_CHECK, rel, line,
+                        f"suppression silences nothing: disable="
+                        f"{','.join(unused)} no longer matches any "
+                        "finding on this line — the check was fixed or "
+                        "the code moved; delete the comment (stale "
+                        "suppressions are how findings sneak back in)",
+                    )
+                )
+    # pass B: a disable=unused-suppression that silences no pass-A
+    # finding is itself stale (reported unsuppressibly, else it could
+    # never fire)
+    for rel, sup in sorted(sup_by_file.items()):
+        for line, ids in sorted(sup.items()):
+            if META_CHECK not in ids:
+                continue
+            if (rel, line) in meta_lines or (rel, line + 1) in meta_lines:
+                continue
+            pass_b.append(
+                Finding(
+                    META_CHECK, rel, line,
+                    "suppression silences nothing: disable="
+                    f"{META_CHECK} with no unused-suppression finding "
+                    "on this line — delete the comment",
+                )
+            )
+    return pass_a, pass_b
+
+
+def _lint_files(
+    files: dict, root, checks=None
+) -> tuple[list[Finding], list[Finding]]:
+    sup_by_file = {
+        rel: _suppressions(src_lines)
+        for rel, (_tree, src_lines) in files.items()
+    }
+    selected = list(checks) if checks is not None else list(ALL_CHECKS)
+    run_meta = META_CHECK in selected
+    run_ids = [c for c in selected if c != META_CHECK]
+    # usage marking needs every check's raw findings, even when only the
+    # meta check was selected
+    usage_ids = (
+        [c for c in ALL_CHECKS if c != META_CHECK] if run_meta else run_ids
+    )
+    raw = _raw_findings(files, usage_ids, root)
+    report = [f for f in raw if f.check in run_ids]
+    meta_b: set = set()
+    if run_meta:
+        pass_a, pass_b = _unused_suppression_findings(files, raw, sup_by_file)
+        report.extend(pass_a)  # normal suppression mechanics apply
+        report.extend(pass_b)  # kept unsuppressible below
+        meta_b = {(f.path, f.line) for f in pass_b}
+    findings, suppressed = [], []
+    for f in report:
+        sup = sup_by_file.get(f.path, {})
+        if f.check == META_CHECK and (f.path, f.line) in meta_b:
+            findings.append(f)  # pass-B meta findings cannot self-suppress
+        elif _is_suppressed(f, sup):
+            suppressed.append(f)
+        else:
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.check))
+    suppressed.sort(key=lambda f: (f.path, f.line, f.check))
+    return findings, suppressed
+
+
 def lint_source(
-    src: str, relpath: str, checks=None
+    src: str, relpath: str, checks=None, root: str | None = None
 ) -> tuple[list[Finding], list[Finding]]:
     """Lint one file's source. Returns ``(findings, suppressed)``.
 
     ``relpath`` must be repo-root-relative with forward slashes — the
-    manifests in config.py key on it.
+    manifests in config.py (and wholeprog/config.py) key on it.
     """
-    from tools.d4pglint import checks as checks_mod
-
     tree = ast.parse(src, filename=relpath)
-    src_lines = src.splitlines()
-    sup = _suppressions(src_lines)
-    selected = checks if checks is not None else ALL_CHECKS
-    raw: list[Finding] = []
-    for check_id in selected:
-        fn = checks_mod.REGISTRY[check_id]
-        raw.extend(fn(tree, src_lines, relpath))
-    findings = [f for f in raw if not _is_suppressed(f, sup)]
-    suppressed = [f for f in raw if _is_suppressed(f, sup)]
-    findings.sort(key=lambda f: (f.path, f.line, f.check))
-    return findings, suppressed
+    return _lint_files({relpath: (tree, src.splitlines())}, root, checks)
+
+
+def lint_sources(
+    sources: dict[str, str], checks=None, root: str | None = None
+) -> tuple[list[Finding], list[Finding]]:
+    """Lint several in-memory files as one program (multi-file fixture
+    tests for the whole-program checks)."""
+    files = {
+        rel: (ast.parse(src, filename=rel), src.splitlines())
+        for rel, src in sources.items()
+    }
+    return _lint_files(files, root, checks)
 
 
 def iter_py_files(paths, root: str):
@@ -97,25 +241,41 @@ def repo_root() -> str:
     )
 
 
-def lint_paths(
-    paths=None, root: str | None = None, checks=None
-) -> tuple[list[Finding], list[Finding]]:
-    """Lint files/trees (default: the repo manifest). Returns
-    ``(findings, suppressed)`` across all files."""
+def parse_files(
+    paths=None, root: str | None = None
+) -> tuple[dict, list[Finding]]:
+    """Parse files/trees (default: the repo manifest) into the file map
+    the checks consume. Returns ``(files, parse_error_findings)``."""
     root = root or repo_root()
     paths = list(paths) if paths else list(DEFAULT_PATHS)
-    findings: list[Finding] = []
-    suppressed: list[Finding] = []
+    files: dict = {}
+    errors: list[Finding] = []
     for ap, rel in iter_py_files(paths, root):
         with open(ap, encoding="utf-8") as f:
             src = f.read()
         try:
-            got, sup = lint_source(src, rel, checks=checks)
+            files[rel] = (ast.parse(src, filename=rel), src.splitlines())
         except SyntaxError as e:
-            findings.append(
+            errors.append(
                 Finding("parse", rel, e.lineno or 0, f"syntax error: {e.msg}")
             )
-            continue
-        findings.extend(got)
-        suppressed.extend(sup)
+    return files, errors
+
+
+def parse_default_files(root: str | None = None) -> dict:
+    """The default-manifest file map (lockgraph CLI, schema_check)."""
+    return parse_files(None, root)[0]
+
+
+def lint_paths(
+    paths=None, root: str | None = None, checks=None
+) -> tuple[list[Finding], list[Finding]]:
+    """Lint files/trees (default: the repo manifest). Returns
+    ``(findings, suppressed)`` across all files — per-file checks AND the
+    whole-program pass over everything parsed together."""
+    root = root or repo_root()
+    files, errors = parse_files(paths, root)
+    findings, suppressed = _lint_files(files, root, checks)
+    findings = errors + findings
+    findings.sort(key=lambda f: (f.path, f.line, f.check))
     return findings, suppressed

@@ -145,6 +145,82 @@ def check_shard_microbench(path: str) -> list[str]:
     return errs
 
 
+def check_lock_order_graph(path: str, root: str | None = None) -> list[str]:
+    """Shape + invariants for ``benchmarks/lock_order_graph.json``:
+
+    - the committed artifact parses and carries the v1 schema fields;
+    - every edge endpoint is a declared node;
+    - the graph is ACYCLIC (Kahn) — the committed artifact is the repo's
+      standing claim that no lock-order deadlock exists, so a cyclic one
+      must never be committable;
+    - with ``root`` given, the artifact matches a fresh analysis of the
+      lint manifest (drift = someone changed lock nesting without
+      regenerating: ``python -m tools.d4pglint.wholeprog.lockgraph
+      --write``).
+    """
+    from tools.d4pglint.wholeprog.lockgraph import (
+        GRAPH_SCHEMA,
+        build_lock_graph,
+        is_acyclic,
+    )
+
+    errs = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: unreadable/invalid JSON ({e})"]
+    if not isinstance(doc, dict) or doc.get("schema") != GRAPH_SCHEMA:
+        return [f"{path}: missing/unknown schema (expected {GRAPH_SCHEMA!r})"]
+    nodes = doc.get("nodes")
+    edges = doc.get("edges")
+    if not (isinstance(nodes, list) and all(isinstance(n, str) for n in nodes)):
+        return [f"{path}: 'nodes' must be a list of lock ids"]
+    if not isinstance(edges, list):
+        return [f"{path}: 'edges' must be a list"]
+    pairs = []
+    for i, e in enumerate(edges):
+        if not (isinstance(e, dict) and "from" in e and "to" in e):
+            errs.append(f"{path}: edges[{i}] missing from/to")
+            continue
+        for end in (e["from"], e["to"]):
+            if end not in nodes:
+                errs.append(
+                    f"{path}: edges[{i}] endpoint {end!r} not in 'nodes'"
+                )
+        if not (isinstance(e.get("sites"), list) and e["sites"]):
+            errs.append(f"{path}: edges[{i}] needs non-empty 'sites'")
+        pairs.append((e["from"], e["to"]))
+    if not is_acyclic(nodes, pairs):
+        errs.append(
+            f"{path}: lock-order graph is CYCLIC — a committed artifact "
+            "must never attest a deadlock; fix the inversion, then "
+            "regenerate"
+        )
+    if root is not None:
+        from tools.d4pglint.core import parse_default_files
+
+        fresh = build_lock_graph(parse_default_files(root))
+        fresh_pairs = {(e["from"], e["to"]) for e in fresh["edges"]}
+        if set(nodes) != set(fresh["nodes"]) or set(pairs) != fresh_pairs:
+            gone_n = sorted(set(nodes) - set(fresh["nodes"]))
+            new_n = sorted(set(fresh["nodes"]) - set(nodes))
+            gone_e = sorted(set(pairs) - fresh_pairs)
+            new_e = sorted(fresh_pairs - set(pairs))
+            detail = "; ".join(
+                f"{k}: {v}" for k, v in (
+                    ("stale nodes", gone_n), ("new nodes", new_n),
+                    ("stale edges", gone_e), ("new edges", new_e),
+                ) if v
+            )
+            errs.append(
+                f"{path}: stale vs the current code ({detail}) — "
+                "regenerate with `python -m "
+                "tools.d4pglint.wholeprog.lockgraph --write`"
+            )
+    return errs
+
+
 def check_metrics_jsonl(path: str, max_rows: int | None = None) -> list[str]:
     """Problems with one metrics.jsonl ([] = clean)."""
     errs = []
@@ -186,6 +262,12 @@ def check_metrics_jsonl(path: str, max_rows: int | None = None) -> list[str]:
 def check_tree(root: str) -> list[str]:
     errs = []
     for path in sorted(glob.glob(os.path.join(root, "benchmarks", "*.json"))):
+        if os.path.basename(path) == "lock_order_graph.json":
+            # not a microbench artifact: its own schema (and acyclicity
+            # pin + freshness vs the current code) replaces the generic
+            # backend-key rule
+            errs.extend(check_lock_order_graph(path, root))
+            continue
         errs.extend(check_benchmark_json(path))
         if os.path.basename(path) == "router_microbench.json":
             errs.extend(check_router_microbench(path))

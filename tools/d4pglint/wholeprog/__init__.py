@@ -1,0 +1,72 @@
+"""Whole-program analyses: the repo as ONE system, not one file at a time.
+
+The per-file checks in ``tools/d4pglint/checks.py`` see a single AST;
+PRs 5-9 grew exactly the surface that per-file analysis cannot: ~30 locks
+spread over 15 files and four thread-heavy subsystems, one shared wire-id
+space consumed by eight receive loops, and a partition-rule registry whose
+previous incarnation silently replicated undeclared ensemble stacks. The
+checks here receive the WHOLE parsed file map and reason across files:
+
+- ``lock-order`` (lockgraph.py) — global lock-acquisition-order graph,
+  cycles are findings; the graph is committed as
+  ``benchmarks/lock_order_graph.json`` (regenerate:
+  ``python -m tools.d4pglint.wholeprog.lockgraph --write``) and the
+  runtime half (``d4pg_tpu/analysis/lockwitness.py``, behind
+  ``--debug-guards``) confirms or refutes the static edges at run time.
+- ``protocol-conformance`` (protocolcheck.py) — the serve/fleet wire-id
+  space: no collisions, codec pairs exist, every endpoint handles or
+  explicitly rejects every id, frame bytes flow only through the
+  MAX_PAYLOAD-enforcing ``protocol.read_frame``, no silent-drop branches.
+- ``thread-lifecycle`` (lifecycle.py) — every started thread has a
+  bounded join/stop path reachable from its owner's close/drain (or a
+  ``_DETACHED_THREADS`` declaration), bounded-queue puts carry an
+  explicit shed answer, blocking waits carry timeouts.
+
+Same ``Finding`` type, same ``# d4pglint: disable=`` suppression
+mechanics, same fixture-test conventions as the per-file checks. Two more
+analyses live beside the registry because they are not per-line source
+checks: the shape-aware partition-rule coverage gate
+(``partition_coverage.py`` — EXECUTES repo code under ``JAX_PLATFORMS=cpu``
+to instantiate the real param trees, so the lint driver runs it as a
+subprocess) and the docs-catalog drift check (``docscheck.py``).
+"""
+
+from __future__ import annotations
+
+# Whole-program check registry: id -> fn(files, root) -> [Finding] where
+# ``files`` maps repo-relative path -> (ast.Module, src_lines). Populated
+# by the @wholeprog_check decorator at import of the check modules below.
+REGISTRY: dict = {}
+
+
+def wholeprog_check(check_id: str):
+    def deco(fn):
+        REGISTRY[check_id] = fn
+        fn.check_id = check_id
+        return fn
+
+    return deco
+
+
+def run_checks(files: dict, check_ids, root: str | None = None) -> list:
+    """Run the selected whole-program checks over a parsed file map."""
+    _load()
+    out = []
+    for check_id in check_ids:
+        out.extend(REGISTRY[check_id](files, root))
+    return out
+
+
+def _load() -> None:
+    """Import the check modules (which self-register). Deferred so that
+    ``tools.d4pglint.core`` can import this package without a cycle."""
+    from tools.d4pglint.wholeprog import (  # noqa: F401
+        lifecycle,
+        lockgraph,
+        protocolcheck,
+    )
+
+
+def all_check_ids() -> tuple:
+    _load()
+    return tuple(sorted(REGISTRY))

@@ -1,0 +1,146 @@
+"""Whole-program manifests: the cross-file policy, reviewed like code.
+
+Same philosophy as ``tools/d4pglint/config.py``: these lists ARE the
+policy. Adding a message id without a codec row, an endpoint without its
+handled-id set, or a replicated leaf without a declaration is a lint
+failure — the manifests make the implicit system contracts explicit and
+machine-checked.
+"""
+
+from __future__ import annotations
+
+# --------------------------------------------------------------- protocol
+# The one shared wire-id module (serving AND fleet ingest speak it).
+PROTOCOL_MODULE = "d4pg_tpu/serve/protocol.py"
+
+# Names in the protocol module that look like frame-constants but are NOT
+# message-type ids.
+PROTOCOL_NON_IDS = ("PROTOCOL_VERSION", "MAX_PAYLOAD")
+
+# Message id -> (payload encoder, payload decoder). ``module.py::func``
+# names a codec function that must exist; the literals mean:
+#   "empty" — no payload; "utf8"  — bare utf-8 text (reason strings);
+#   "json"  — json.dumps/loads at the call site.
+# A new id in protocol.py without a row here fails lint (and vice versa):
+# the PR that adds a message type must say how its payload is encoded.
+PROTOCOL_CODECS = {
+    "ACT": ("d4pg_tpu/serve/protocol.py::encode_act",
+            "d4pg_tpu/serve/protocol.py::decode_act"),
+    "ACT_OK": ("d4pg_tpu/serve/protocol.py::encode_action",
+               "d4pg_tpu/serve/protocol.py::decode_action"),
+    "OVERLOADED": ("utf8", "utf8"),
+    "ERROR": ("utf8", "utf8"),
+    "HEALTHZ": ("empty", "empty"),
+    "HEALTHZ_OK": ("json", "json"),
+    "HELLO": ("d4pg_tpu/fleet/wire.py::encode_hello",
+              "d4pg_tpu/fleet/wire.py::decode_hello"),
+    "HELLO_OK": ("d4pg_tpu/fleet/wire.py::encode_hello_ok",
+                 "d4pg_tpu/fleet/wire.py::decode_hello_ok"),
+    "WINDOWS": ("d4pg_tpu/fleet/wire.py::encode_windows",
+                "d4pg_tpu/fleet/wire.py::decode_windows"),
+    "WINDOWS_OK": ("d4pg_tpu/fleet/wire.py::encode_windows_ok",
+                   "d4pg_tpu/fleet/wire.py::decode_windows_ok"),
+}
+
+# Every receive loop in the system: endpoint name ->
+# ("module.py::qualname", ids it must dispatch on). The checker verifies
+# the function (a) references every listed id in a ``msg_type``
+# comparison, (b) carries the explicit catch-all rejection (a
+# ``ProtocolError`` raise or a future failed with one) so an unlisted id
+# can never fall through silently, and (c) never silently consumes a
+# frame (every dispatch branch replies, resolves, raises, or carries a
+# justified suppression).
+PROTOCOL_ENDPOINTS = {
+    "server": ("d4pg_tpu/serve/server.py::PolicyServer._serve_conn",
+               ("HEALTHZ", "ACT")),
+    "router": ("d4pg_tpu/serve/router.py::Router._serve_conn",
+               ("HEALTHZ", "ACT")),
+    "ingest-handshake": ("d4pg_tpu/fleet/ingest.py::IngestServer._handshake",
+                         ("HEALTHZ", "HELLO")),
+    "ingest": ("d4pg_tpu/fleet/ingest.py::IngestServer._serve_conn",
+               ("HEALTHZ", "WINDOWS")),
+    "client": ("d4pg_tpu/serve/client.py::PolicyClient._read_loop",
+               ("ACT_OK", "HEALTHZ_OK", "OVERLOADED", "ERROR")),
+    "fleet-link": ("d4pg_tpu/fleet/actor.py::FleetLink._read_loop",
+                   ("WINDOWS_OK", "OVERLOADED", "ERROR")),
+    "fleet-handshake": ("d4pg_tpu/fleet/actor.py::FleetLink.__init__",
+                        ("HELLO_OK", "ERROR")),
+    "prober": ("d4pg_tpu/serve/protocol.py::probe_healthz",
+               ("HEALTHZ_OK",)),
+}
+
+# Modules that touch the wire: raw ``.recv(`` / header ``HEADER.unpack``
+# outside the protocol module bypasses the one MAX_PAYLOAD enforcement
+# point (``read_frame``), so it is a finding in any of these.
+PROTOCOL_WIRE_MODULES = (
+    "d4pg_tpu/serve/server.py",
+    "d4pg_tpu/serve/router.py",
+    "d4pg_tpu/serve/client.py",
+    "d4pg_tpu/fleet/ingest.py",
+    "d4pg_tpu/fleet/actor.py",
+    "d4pg_tpu/fleet/wire.py",
+)
+
+# ---------------------------------------------------------- thread lifecycle
+# Method-name fragments that mark a teardown root: a stored thread's
+# bounded join must be reachable (intra-class) from a method matching one
+# of these, so `close()`/`drain()`/`_stop_collector()` all qualify.
+TEARDOWN_NAME_FRAGMENTS = ("close", "drain", "stop", "shutdown", "__exit__")
+
+# Bounded queues whose every put must carry an explicit shed answer:
+# (module suffix, class, queue attr, limit attr). The rule: a method that
+# appends to the queue attr must also reference the limit attr and
+# contain a shed action (raise ShedError / OVERLOADED reply /
+# drop-oldest+counter) — admission control stays visible at every
+# enqueue site.
+BOUNDED_QUEUES = (
+    ("d4pg_tpu/serve/batcher.py", "DynamicBatcher", "_queue", "queue_limit"),
+    ("d4pg_tpu/fleet/ingest.py", "IngestServer", "_queue", "queue_limit"),
+    ("d4pg_tpu/fleet/actor.py", "_Spool", "rows", "limit"),
+)
+
+# --------------------------------------------------------------- lock graph
+# Attribute types the index cannot infer from assignments because the
+# object arrives as a constructor PARAMETER (`self._ledger = ledger if
+# ledger is not None else NULL_LEDGER`). Declaring them keeps the static
+# lock graph honest about dependency-injected components — the runtime
+# witness surfaced exactly this gap (Trainer._buffer_lock held across
+# the ledger's lock went unseen until a guarded run recorded it).
+# ("ClassName", "attr") -> type class name.
+KNOWN_ATTR_TYPES = (
+    (("PrioritizedReplayBuffer", "_ledger"), "StagingLedger"),
+    (("ReplayBuffer", "_ledger"), "StagingLedger"),
+    (("DynamicBatcher", "_ledger"), "StagingLedger"),
+    (("IngestServer", "_ledger"), "StagingLedger"),
+    (("Trainer", "_ledger"), "StagingLedger"),
+    (("Trainer", "buffer"), "PrioritizedReplayBuffer"),
+)
+
+# ------------------------------------------------------- partition coverage
+# Leaf paths (regex over "tree/path/to/leaf") that are DECLARED to land on
+# the replication fallback of parallel/partition.py's rule registry. Any
+# other leaf that falls through to replication fails the coverage gate —
+# the PR-9 silent-replication bug class (an E!=2 ensemble stack quietly
+# replicated E× params) caught at lint time. Each entry carries its
+# why-replicated justification.
+DECLARED_REPLICATED = (
+    # The conv pixel encoder (models/encoders.py:PixelEncoder): rank-4
+    # conv kernels have no mapping onto the Megatron column/row dense
+    # rules, its Dense projection and LayerNorm are ~1% of trunk params,
+    # and dp-replication is the intended layout (tp shards the trunk
+    # matmuls, not the convs). Covers params/targets and the optax
+    # mu/nu moments that mirror them.
+    (r"(^|/)PixelEncoder_\d+/",
+     "conv pixel encoder replicates by design (dp-parallel, small)"),
+)
+
+# ------------------------------------------------------------ docs catalog
+# Runtime guards that docs/analysis.md must document (one "### <title>"
+# heading each) — PR 6 found a missing catalog row by hand; this makes
+# the next one a lint failure.
+RUNTIME_GUARDS = (
+    ("recompile.py", "Recompile sentinel"),
+    ("transfer.py", "Transfer guard"),
+    ("ledger.py", "Staging ledger"),
+    ("lockwitness.py", "Lock-order witness"),
+)

@@ -1,0 +1,81 @@
+"""Docs-catalog drift check: docs/analysis.md must list every check.
+
+PR 6 found a missing catalog row by hand; this makes the next one a
+lint failure. Two surfaces:
+
+- the **check catalog table** must carry one ``| `<id>` |`` row per
+  registered check id (``ALL_CHECKS`` — per-file and whole-program);
+- the **runtime guards** section must carry one ``### <title>`` heading
+  per guard in ``wholeprog/config.py:RUNTIME_GUARDS`` (each names its
+  ``d4pg_tpu/analysis/`` module).
+
+Run by the default-manifest ``python -m tools.d4pglint`` invocation (and
+therefore by ``scripts/lint.sh`` and tier-1).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+from tools.d4pglint.config import ALL_CHECKS
+from tools.d4pglint.wholeprog.config import RUNTIME_GUARDS
+
+DOCS_PATH = "docs/analysis.md"
+
+_ROW_RE = re.compile(r"^\|\s*`([a-z0-9\-]+)`\s*\|", re.MULTILINE)
+_HEADING_RE = re.compile(r"^###\s+(.+?)\s*(?:\(|$)", re.MULTILINE)
+
+
+def check_docs(root: str, docs_path: str | None = None) -> list[str]:
+    """Problems with the analysis-doc catalog ([] = clean)."""
+    path = docs_path or os.path.join(root, DOCS_PATH)
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError as e:
+        return [f"{DOCS_PATH}: unreadable ({e})"]
+    errs = []
+    rows = set(_ROW_RE.findall(text))
+    for check_id in ALL_CHECKS:
+        if check_id not in rows:
+            errs.append(
+                f"{DOCS_PATH}: check catalog has no row for `{check_id}` — "
+                "every registered check id must be documented "
+                "(docs-catalog drift)"
+            )
+    for check_id in sorted(rows - set(ALL_CHECKS)):
+        errs.append(
+            f"{DOCS_PATH}: check catalog documents `{check_id}` which is "
+            "not a registered check id — stale row (docs-catalog drift)"
+        )
+    headings = {h.strip().lower() for h in _HEADING_RE.findall(text)}
+    for module, title in RUNTIME_GUARDS:
+        if title.lower() not in headings:
+            errs.append(
+                f"{DOCS_PATH}: runtime-guard section has no '### {title}' "
+                f"heading (d4pg_tpu/analysis/{module}) — every runtime "
+                "guard must be documented (docs-catalog drift)"
+            )
+    return errs
+
+
+def main(argv=None) -> int:
+    import sys
+
+    from tools.d4pglint.core import repo_root
+
+    args = argv if argv is not None else sys.argv[1:]
+    root = args[0] if args else repo_root()
+    errs = check_docs(root)
+    for e in errs:
+        print(e)
+    n = len(errs)
+    print(f"docs-check: {n} problem{'s' if n != 1 else ''}")
+    return 1 if errs else 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

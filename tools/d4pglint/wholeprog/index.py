@@ -1,0 +1,283 @@
+"""Repo-wide AST index shared by the whole-program checks.
+
+Pure AST — never imports or executes linted code (the same contract as
+``tools/d4pglint/checks.py``). The index answers the cross-file questions
+the per-file checks cannot:
+
+- which class does ``self.batcher`` hold? (attribute-type environment,
+  built from ``self.X = ClassName(...)`` assignments, two propagation
+  passes so ``self.stats = self.batcher.stats`` resolves too);
+- which function body does ``self.batcher.submit(...)`` or
+  ``protocol.write_frame(...)`` run? (intra-class methods, module-level
+  functions, and ``from pkg import module`` aliases);
+- which class OWNS ``self._lock``? (the class in the single-inheritance
+  chain that assigns it — so a subclass and its base agree on one lock
+  identity instead of splitting a runtime lock into two graph nodes).
+
+Resolution is deliberately conservative: an ambiguous name (two classes
+with the same simple name, an attribute assigned two different types)
+resolves to every candidate, and an unresolvable callee is skipped — the
+analyses over-approximate reachability, never invent it.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from tools.d4pglint.checks import _dotted, _terminal_name
+
+#: maximum inlining depth when following calls (bounds pathological chains)
+MAX_CALL_DEPTH = 8
+
+
+@dataclass
+class ClassInfo:
+    rel: str
+    node: ast.ClassDef
+    methods: dict = field(default_factory=dict)       # name -> FunctionDef
+    attr_types: dict = field(default_factory=dict)    # attr -> set[class name]
+    bases: list = field(default_factory=list)         # simple base names
+    decl_tuples: dict = field(default_factory=dict)   # _THREAD_SAFE etc.
+    lock_attrs: set = field(default_factory=set)      # attrs assigned Lock()
+
+
+class RepoIndex:
+    """Build once per lint run from the parsed file map."""
+
+    def __init__(self, files: dict):
+        self.files = files
+        # simple class name -> [ClassInfo] (usually exactly one)
+        self.classes: dict[str, list[ClassInfo]] = {}
+        # rel -> {name: FunctionDef} module-level functions
+        self.functions: dict[str, dict] = {}
+        # rel -> {alias: rel-of-module} for `from pkg import module` /
+        # `import pkg.module as alias` where the module is in the file map
+        self.module_aliases: dict[str, dict] = {}
+        # rel -> {name: rel} for `from pkg.module import name`
+        self.imported_names: dict[str, dict] = {}
+        self._build()
+
+    # ------------------------------------------------------------------ build
+    def _build(self) -> None:
+        mod_by_dotted = {
+            rel[:-3].replace("/", "."): rel for rel in self.files
+        }
+        for rel, (tree, _src) in self.files.items():
+            self.functions[rel] = {
+                n.name: n for n in tree.body if isinstance(n, ast.FunctionDef)
+            }
+            aliases: dict = {}
+            names: dict = {}
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Import):
+                    for a in node.names:
+                        target = mod_by_dotted.get(a.name)
+                        if target:
+                            aliases[(a.asname or a.name).split(".")[0]] = target
+                elif isinstance(node, ast.ImportFrom):
+                    base = node.module or ""
+                    for a in node.names:
+                        as_mod = mod_by_dotted.get(f"{base}.{a.name}")
+                        if as_mod:
+                            aliases[a.asname or a.name] = as_mod
+                        elif base in mod_by_dotted:
+                            names[a.asname or a.name] = mod_by_dotted[base]
+            self.module_aliases[rel] = aliases
+            self.imported_names[rel] = names
+            # phase 1: register every class NAME first — attr-type
+            # resolution below consults the full name set, so build order
+            # across files must not matter
+            for node in ast.walk(tree):
+                if isinstance(node, ast.ClassDef):
+                    self.classes.setdefault(node.name, []).append(
+                        ClassInfo(rel=rel, node=node)
+                    )
+        # phase 2: populate methods/attr-types now that every class name
+        # is known (a `self.stats = ServeStats(...)` in batcher.py must
+        # resolve even though stats.py parses later)
+        for infos in self.classes.values():
+            for info in infos:
+                self._fill_class_info(info)
+        # phase 3: attr-type propagation — resolve `self.a = self.b.c`
+        # through the types discovered in phase 2
+        for infos in self.classes.values():
+            for info in infos:
+                self._propagate_attr_types(info)
+        # declared types for dependency-injected attributes the
+        # assignments cannot reveal (wholeprog/config.py:KNOWN_ATTR_TYPES)
+        from tools.d4pglint.wholeprog.config import KNOWN_ATTR_TYPES
+
+        for (cls_name, attr), type_name in KNOWN_ATTR_TYPES:
+            for info in self.classes.get(cls_name, ()):
+                info.attr_types.setdefault(attr, set()).add(type_name)
+
+    def _fill_class_info(self, info: ClassInfo) -> None:
+        node = info.node
+        info.bases = [
+            b for b in (_terminal_name(base) for base in node.bases) if b
+        ]
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info.methods[item.name] = item
+            elif isinstance(item, ast.Assign):
+                for t in item.targets:
+                    if isinstance(t, ast.Name) and t.id.startswith("_") and (
+                        t.id.isupper() or t.id in ("_THREAD_SAFE",)
+                    ):
+                        vals = [
+                            str(e.value)
+                            for e in getattr(item.value, "elts", [])
+                            if isinstance(e, ast.Constant)
+                        ]
+                        info.decl_tuples[t.id] = tuple(vals)
+        for m in info.methods.values():
+            for sub in ast.walk(m):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                for t in sub.targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        for cls_name in self._value_classes(sub.value):
+                            info.attr_types.setdefault(t.attr, set()).add(
+                                cls_name
+                            )
+                        if self._is_lock_ctor(sub.value):
+                            info.lock_attrs.add(t.attr)
+
+    @staticmethod
+    def _is_lock_ctor(value) -> bool:
+        for sub in ast.walk(value):
+            if isinstance(sub, ast.Call):
+                tail = (_dotted(sub.func) or "").split(".")[-1]
+                # the lockwitness named_* helpers ARE lock constructors
+                # (they return the plain primitive unless --debug-guards
+                # armed the witness)
+                if tail in ("Lock", "RLock", "Condition",
+                            "named_lock", "named_rlock", "named_condition"):
+                    return True
+        return False
+
+    def _value_classes(self, value) -> set:
+        """Class names constructed anywhere in an assigned expression
+        (`x or ClassName(...)`, `A(...) if c else B(...)` all resolve)."""
+        out = set()
+        for sub in ast.walk(value):
+            if isinstance(sub, ast.Call):
+                tail = (_dotted(sub.func) or "").split(".")[-1]
+                if tail in self.classes:
+                    out.add(tail)
+        return out
+
+    def _propagate_attr_types(self, info: ClassInfo) -> None:
+        for m in info.methods.values():
+            for sub in ast.walk(m):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                for t in sub.targets:
+                    if not (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                        and t.attr not in info.attr_types
+                    ):
+                        continue
+                    # self.a = self.b.c  ->  type of attr c on type of b
+                    v = sub.value
+                    if (
+                        isinstance(v, ast.Attribute)
+                        and isinstance(v.value, ast.Attribute)
+                        and isinstance(v.value.value, ast.Name)
+                        and v.value.value.id == "self"
+                    ):
+                        for owner in info.attr_types.get(v.value.attr, ()):
+                            for oinfo in self.classes.get(owner, ()):
+                                for cls in oinfo.attr_types.get(v.attr, ()):
+                                    info.attr_types.setdefault(
+                                        t.attr, set()
+                                    ).add(cls)
+
+    # ------------------------------------------------------------ resolution
+    def class_infos(self, name: str) -> list:
+        return self.classes.get(name, [])
+
+    def method(self, cls_name: str, meth: str):
+        """(ClassInfo, FunctionDef) pairs for a method, walking single-
+        inheritance bases by simple name when the class itself lacks it."""
+        out = []
+        for info in self.classes.get(cls_name, ()):
+            if meth in info.methods:
+                out.append((info, info.methods[meth]))
+            else:
+                for base in info.bases:
+                    for binfo in self.classes.get(base, ()):
+                        if meth in binfo.methods:
+                            out.append((binfo, binfo.methods[meth]))
+        return out
+
+    def lock_owner(self, cls_name: str, attr: str) -> str:
+        """The class (self or base) that assigns ``self.<attr>`` a lock —
+        one graph node per runtime lock even across inheritance."""
+        for info in self.classes.get(cls_name, ()):
+            if attr in info.lock_attrs:
+                return cls_name
+            for base in info.bases:
+                for binfo in self.classes.get(base, ()):
+                    if attr in binfo.lock_attrs:
+                        return base
+        return cls_name
+
+    def attr_classes(self, cls_name: str, attr_chain) -> set:
+        """Resolve ``self.a.b`` (attr_chain=["a","b"]) to class names."""
+        current = {cls_name}
+        for attr in attr_chain:
+            nxt: set = set()
+            for cname in current:
+                for info in self.classes.get(cname, ()):
+                    nxt |= info.attr_types.get(attr, set())
+            current = nxt
+            if not current:
+                break
+        return current
+
+    def resolve_call(self, rel: str, cls_name, call: ast.Call) -> list:
+        """Resolve a call to [(rel, class_name_or_None, FunctionDef)] —
+        possibly several candidates, possibly none (unresolvable)."""
+        fn = call.func
+        out = []
+        # self.method(...) / self.a.b.method(...)
+        chain = []
+        node = fn
+        while isinstance(node, ast.Attribute):
+            chain.append(node.attr)
+            node = node.value
+        chain.reverse()
+        if isinstance(node, ast.Name) and node.id == "self" and cls_name:
+            *attrs, meth = chain
+            owners = (
+                {cls_name} if not attrs else self.attr_classes(cls_name, attrs)
+            )
+            for owner in owners:
+                for info, m in self.method(owner, meth):
+                    out.append((info.rel, owner, m))
+            return out
+        if isinstance(fn, ast.Name):
+            name = fn.id
+            if name in self.functions.get(rel, {}):
+                return [(rel, None, self.functions[rel][name])]
+            src = self.imported_names.get(rel, {}).get(name)
+            if src and name in self.functions.get(src, {}):
+                return [(src, None, self.functions[src][name])]
+            return []
+        if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+            mod = self.module_aliases.get(rel, {}).get(fn.value.id)
+            if mod and fn.attr in self.functions.get(mod, {}):
+                return [(mod, None, self.functions[mod][fn.attr])]
+        return out
+
+
+def build_index(files: dict) -> RepoIndex:
+    return RepoIndex(files)

@@ -1,0 +1,354 @@
+"""Thread/channel lifecycle reachability.
+
+Threads, bounded queues, and blocking waits are the three places a
+distributed process wedges instead of failing. Three rules, each the
+static half of a contract the chaos soak exercises dynamically:
+
+1. **every stored thread has a bounded join on a teardown path** — a
+   ``threading.Thread(...)`` assigned to ``self.X`` must have a
+   ``self.X.join(<timeout>)`` in a method reachable (intra-class) from a
+   teardown root (``close``/``drain``/``stop``/``shutdown``/
+   ``__exit__`` name fragment). Fire-and-forget threads (per-connection
+   readers unblocked by socket close at drain) are declared in the
+   class's ``_DETACHED_THREADS`` tuple by thread name, with the comment
+   saying what bounds them. Local threads must be joined in their
+   creating function (bounded) or declared.
+2. **bounded-queue puts carry an explicit shed answer** — for the queues
+   in ``BOUNDED_QUEUES``, a method appending to the queue must reference
+   the declared limit and contain a shed action (``ShedError``, an
+   ``OVERLOADED`` reply, or drop-oldest-with-counter); a new enqueue
+   site without admission control is how backpressure silently breaks.
+3. **blocking waits carry timeouts** — ``.wait()`` with no timeout,
+   thread ``.join()`` with no bound, ``.get()``/``.acquire()``/
+   ``.result()`` with no timeout on queue/semaphore/future-ish names:
+   each is an unbounded block that turns a dead peer thread into a hang
+   (suppress only where an unbounded block IS the design, e.g. the
+   signal-handler wait in ``serve_until_shutdown``, with the reason).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.d4pglint.checks import _dotted, _terminal_name
+from tools.d4pglint.core import Finding
+from tools.d4pglint.wholeprog import wholeprog_check
+from tools.d4pglint.wholeprog.config import (
+    BOUNDED_QUEUES,
+    TEARDOWN_NAME_FRAGMENTS,
+)
+
+_CHECK = "thread-lifecycle"
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    dotted = _dotted(call.func) or ""
+    return dotted.split(".")[-1] == "Thread" and "threading" in dotted
+
+
+def _thread_name(call: ast.Call):
+    for kw in call.keywords:
+        if kw.arg == "name":
+            v = kw.value
+            if isinstance(v, ast.Constant):
+                return str(v.value)
+            if isinstance(v, ast.JoinedStr) and v.values:
+                first = v.values[0]
+                if isinstance(first, ast.Constant):
+                    return str(first.value).rstrip("-_")
+    return None
+
+
+def _is_teardown_name(name: str) -> bool:
+    low = name.lower()
+    return any(frag in low for frag in TEARDOWN_NAME_FRAGMENTS)
+
+
+def _bounded_join_attrs(fn) -> set:
+    """self attrs joined with a bound (any positional arg or timeout=)
+    inside fn."""
+    out = set()
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"):
+            continue
+        owner = node.func.value
+        if not (isinstance(owner, ast.Attribute)
+                and isinstance(owner.value, ast.Name)
+                and owner.value.id == "self"):
+            continue
+        if node.args or any(k.arg == "timeout" for k in node.keywords):
+            out.add(owner.attr)
+    return out
+
+
+def _has_bounded_join(fn) -> bool:
+    """Any bounded ``.join(...)`` inside fn — local threads are commonly
+    collected into a list and joined through a loop variable, so the
+    bound is checked at function granularity, not per name."""
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "join"
+            and (node.args
+                 or any(k.arg == "timeout" for k in node.keywords))
+        ):
+            return True
+    return False
+
+
+def _class_call_graph(cls) -> dict:
+    methods = {
+        m.name
+        for m in cls.body
+        if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    calls: dict = {}
+    for m in cls.body:
+        if not isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        callees = set()
+        for node in ast.walk(m):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"
+                and node.func.attr in methods
+            ):
+                callees.add(node.func.attr)
+        calls[m.name] = callees
+    return calls
+
+
+def _reachable_from_teardown(cls) -> set:
+    calls = _class_call_graph(cls)
+    roots = [n for n in calls if _is_teardown_name(n)]
+    seen = set(roots)
+    frontier = list(roots)
+    while frontier:
+        for callee in calls.get(frontier.pop(), ()):
+            if callee not in seen:
+                seen.add(callee)
+                frontier.append(callee)
+    return seen
+
+
+def _check_threads(tree, relpath, out) -> None:
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        declared: set = set()
+        for item in cls.body:
+            if isinstance(item, ast.Assign):
+                for t in item.targets:
+                    if isinstance(t, ast.Name) and t.id == "_DETACHED_THREADS":
+                        declared = {
+                            str(e.value)
+                            for e in getattr(item.value, "elts", [])
+                            if isinstance(e, ast.Constant)
+                        }
+        teardown_methods = _reachable_from_teardown(cls)
+        joined_attrs: set = set()
+        for mname in teardown_methods:
+            m = next(
+                (
+                    x
+                    for x in cls.body
+                    if isinstance(x, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and x.name == mname
+                ),
+                None,
+            )
+            if m is not None:
+                joined_attrs |= _bounded_join_attrs(m)
+        for m in cls.body:
+            if not isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            fn_has_join = _has_bounded_join(m)
+            for node in ast.walk(m):
+                if not (isinstance(node, ast.Call) and _is_thread_ctor(node)):
+                    continue
+                tname = _thread_name(node) or "<unnamed>"
+                if tname in declared:
+                    continue
+                stored_attr = _stored_attr(m, node)
+                if stored_attr is not None:
+                    if stored_attr not in joined_attrs:
+                        out.append(
+                            Finding(
+                                _CHECK, relpath, node.lineno,
+                                f"thread {tname!r} stored in "
+                                f"`self.{stored_attr}` has no bounded "
+                                "join reachable from a teardown method "
+                                "(close/drain/stop/shutdown): join it "
+                                "with a timeout there, or declare the "
+                                "name in _DETACHED_THREADS with what "
+                                "bounds it",
+                            )
+                        )
+                else:
+                    if not fn_has_join:
+                        out.append(
+                            Finding(
+                                _CHECK, relpath, node.lineno,
+                                f"fire-and-forget thread {tname!r}: "
+                                "join it (bounded) in this function, or "
+                                "declare the name in _DETACHED_THREADS "
+                                "with what bounds its exit",
+                            )
+                        )
+
+
+def _stored_attr(fn, call: ast.Call):
+    """``self.X`` the Thread call is assigned to, if any."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and _contains(node.value, call):
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    return t.attr
+    return None
+
+
+def _contains(tree, needle) -> bool:
+    return any(n is needle for n in ast.walk(tree))
+
+
+_SHED_MARKERS = ("ShedError", "OVERLOADED", "popleft", "dropped", "shed")
+
+
+def _check_bounded_queues(tree, relpath, out) -> None:
+    wanted = [
+        (cls_name, qattr, lattr)
+        for suffix, cls_name, qattr, lattr in BOUNDED_QUEUES
+        if relpath.endswith(suffix)
+    ]
+    if not wanted:
+        return
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        for cls_name, qattr, lattr in wanted:
+            if cls.name != cls_name:
+                continue
+            for m in cls.body:
+                if not isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                puts = [
+                    n
+                    for n in ast.walk(m)
+                    if isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in ("append", "appendleft", "put")
+                    and isinstance(n.func.value, ast.Attribute)
+                    and n.func.value.attr == qattr
+                    and isinstance(n.func.value.value, ast.Name)
+                    and n.func.value.value.id == "self"
+                ]
+                if not puts:
+                    continue
+                src = ast.dump(m)
+                has_limit = lattr in src
+                has_shed = any(marker in src for marker in _SHED_MARKERS)
+                if not (has_limit and has_shed):
+                    out.append(
+                        Finding(
+                            _CHECK, relpath, puts[0].lineno,
+                            f"`{cls_name}.{m.name}` enqueues into the "
+                            f"bounded queue `{qattr}` without visible "
+                            f"admission control (check `{lattr}` and "
+                            "answer the full case: ShedError / "
+                            "OVERLOADED / drop-oldest-with-counter)",
+                        )
+                    )
+
+
+_QUEUEISH = ("queue", "_q")
+_SEMISH = ("sem", "credit", "inflight")
+
+
+def _check_blocking_waits(tree, relpath, out) -> None:
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            continue
+        attr = node.func.attr
+        recv = _terminal_name(node.func.value) or ""
+        low = recv.lower()
+        has_arg = bool(node.args) or any(
+            k.arg == "timeout" for k in node.keywords
+        )
+        if attr == "wait" and not has_arg:
+            if "ckpt" in low or "checkpoint" in low:
+                # checkpoint-manager .wait() finalizes an async DISK
+                # write (Orbax) — bounded by the filesystem, not a
+                # cross-thread handshake; aborting a slow-but-live save
+                # would be the bug
+                continue
+            out.append(
+                Finding(
+                    _CHECK, relpath, node.lineno,
+                    f"`{recv}.wait()` with no timeout: an unbounded block "
+                    "— a dead notifier thread turns this into a hang; "
+                    "wait in a bounded loop (suppress only where "
+                    "blocking forever IS the design, with the reason)",
+                )
+            )
+        elif attr == "join" and not has_arg and (
+            "thread" in low or low.endswith("_t") or "reader" in low
+            or "collector" in low or "proc" in low
+        ):
+            out.append(
+                Finding(
+                    _CHECK, relpath, node.lineno,
+                    f"`{recv}.join()` with no timeout: a wedged thread "
+                    "blocks its joiner forever — join with a bound and "
+                    "surface the failure",
+                )
+            )
+        elif attr == "get" and not has_arg and not node.keywords and any(
+            q in low for q in _QUEUEISH
+        ):
+            out.append(
+                Finding(
+                    _CHECK, relpath, node.lineno,
+                    f"`{recv}.get()` with no timeout: a producer that "
+                    "died without the sentinel leaves this consumer "
+                    "blocked forever — get with a timeout in a loop "
+                    "(suppress only with the sentinel-delivery argument)",
+                )
+            )
+        elif attr == "acquire" and not has_arg and any(
+            s in low for s in _SEMISH
+        ):
+            out.append(
+                Finding(
+                    _CHECK, relpath, node.lineno,
+                    f"`{recv}.acquire()` with no timeout: flow-control "
+                    "credits must time out so a dead releaser surfaces "
+                    "as an error, not a hang",
+                )
+            )
+        elif attr == "result" and not has_arg:
+            out.append(
+                Finding(
+                    _CHECK, relpath, node.lineno,
+                    f"`{recv}.result()` with no timeout: a future whose "
+                    "resolver died blocks forever — pass a timeout "
+                    "(suppress where the future is provably resolved, "
+                    "e.g. inside its own done-callback)",
+                )
+            )
+
+
+@wholeprog_check("thread-lifecycle")
+def thread_lifecycle(files: dict, root=None) -> list:
+    out = []
+    for relpath, (tree, _src) in sorted(files.items()):
+        _check_threads(tree, relpath, out)
+        _check_bounded_queues(tree, relpath, out)
+        _check_blocking_waits(tree, relpath, out)
+    out.sort(key=lambda f: (f.path, f.line))
+    return out

@@ -1,0 +1,420 @@
+"""Cross-file lock-order deadlock detection.
+
+Every lock acquisition site repo-wide (``with <lockish>`` — the same
+``*lock*``/``*cond*``/``*mutex*`` naming contract the per-file
+``lock-blocking-call`` check keys on) feeds a global acquisition-order
+graph: an edge ``A -> B`` means some execution path acquires ``B`` while
+holding ``A``, including paths that cross files through resolvable calls
+(``self.method``, ``self.attr.method`` via the attribute-type
+environment, ``module.func`` via the import map). A cycle in that graph
+is two code paths that can acquire the same locks in opposite orders —
+the classic deadlock shape — and is a ``lock-order`` finding.
+
+Lock identity: ``Class._attr`` for instance locks (attributed to the
+class in the inheritance chain that ASSIGNS the lock, so a subclass and
+its base share one node), ``module:NAME`` for module-level locks, and
+``Class.method.var`` for function-local locks (per-call instances, but
+their nesting order against shared locks is still a global constraint).
+
+Known under-approximations (documented, deliberate): callbacks
+(``add_done_callback``) run later on another thread and are not inlined
+— though every closure BODY is still traversed lock-free, and a closure
+called lexically (the ``reply()`` send-path pattern) is inlined under
+the caller's held set; calls through unresolvable receivers are
+skipped; ``.acquire()`` without ``with`` records an edge but is not
+tracked as held. The runtime witness
+(``d4pg_tpu/analysis/lockwitness.py``) covers the gap from the other
+side: it records ACTUAL nesting under ``--debug-guards`` and fails on
+any observed edge that contradicts the committed graph.
+
+The graph is committed as ``benchmarks/lock_order_graph.json`` and
+pinned acyclic + drift-free by ``tools/d4pglint/schema_check.py``.
+Regenerate with ``python -m tools.d4pglint.wholeprog.lockgraph --write``.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+
+from tools.d4pglint.checks import _dotted, _lockish, _terminal_name
+from tools.d4pglint.core import Finding
+from tools.d4pglint.wholeprog import wholeprog_check
+from tools.d4pglint.wholeprog.index import MAX_CALL_DEPTH, RepoIndex
+
+GRAPH_SCHEMA = "lock_order_graph/v1"
+
+
+def _mod_stem(rel: str) -> str:
+    return rel[:-3].replace("/", ".") if rel.endswith(".py") else rel
+
+
+class _Collector:
+    def __init__(self, index: RepoIndex):
+        self.index = index
+        # (from, to) -> set of "rel" example sites
+        self.edges: dict[tuple, set] = {}
+        self.nodes: set = set()
+        self._memo: set = set()
+
+    # ------------------------------------------------------- lock identities
+    def lock_id(self, expr, rel: str, cls_name, func_name: str):
+        """Resolve a lockish ``with`` context expression to a stable node
+        id, or None when unresolvable."""
+        name = _terminal_name(expr)
+        if not _lockish(name):
+            return None
+        if isinstance(expr, ast.Name):
+            # module-level lock or function-local lock
+            if expr.id in self._module_locks(rel):
+                return f"{_mod_stem(rel)}:{expr.id}"
+            owner = f"{cls_name}.{func_name}" if cls_name else (
+                f"{_mod_stem(rel)}.{func_name}"
+            )
+            return f"{owner}.{expr.id}"
+        chain = []
+        node = expr
+        while isinstance(node, ast.Attribute):
+            chain.append(node.attr)
+            node = node.value
+        chain.reverse()
+        if isinstance(node, ast.Name) and node.id == "self" and cls_name:
+            *attrs, attr = chain
+            if not attrs:
+                return f"{self.index.lock_owner(cls_name, attr)}.{attr}"
+            owners = self.index.attr_classes(cls_name, attrs)
+            if len(owners) == 1:
+                owner = next(iter(owners))
+                return f"{self.index.lock_owner(owner, attr)}.{attr}"
+            return None  # ambiguous receiver: skip rather than guess
+        if isinstance(node, ast.Name):
+            # e.g. ``with lock:`` on a local alias — treat as func-local
+            owner = f"{cls_name}.{func_name}" if cls_name else (
+                f"{_mod_stem(rel)}.{func_name}"
+            )
+            return f"{owner}.{chain[-1] if chain else node.id}"
+        return None
+
+    def _module_locks(self, rel: str) -> set:
+        """Module-level lock names in ``rel`` (cached)."""
+        cache = getattr(self, "_modlock_cache", None)
+        if cache is None:
+            cache = self._modlock_cache = {}
+        if rel not in cache:
+            locks = set()
+            tree = self.index.files[rel][0]
+            for node in tree.body:
+                if isinstance(node, ast.Assign) and RepoIndex._is_lock_ctor(
+                    node.value
+                ):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            locks.add(t.id)
+            cache[rel] = locks
+        return cache[rel]
+
+    # ------------------------------------------------------------- traversal
+    def collect(self) -> None:
+        for rel, (tree, _src) in sorted(self.index.files.items()):
+            for node in tree.body:
+                if isinstance(node, ast.FunctionDef):
+                    self._visit_fn(rel, None, node, (), 0)
+            for cls in [
+                n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)
+            ]:
+                for m in cls.body:
+                    if isinstance(m, ast.FunctionDef):
+                        self._visit_fn(rel, cls.name, m, (), 0)
+
+    @staticmethod
+    def _closures(fn) -> dict:
+        """name -> FunctionDef for every def nested anywhere inside fn:
+        the send-path pattern is a `reply()` closure invoked lexically,
+        and its lock acquisitions belong to the enclosing call graph."""
+        return {
+            n.name: n
+            for n in ast.walk(fn)
+            if isinstance(n, ast.FunctionDef) and n is not fn
+        }
+
+    def _visit_fn(self, rel, cls_name, fn, held: tuple, depth: int) -> None:
+        key = (rel, cls_name, fn.name, held)
+        if key in self._memo or depth > MAX_CALL_DEPTH:
+            return
+        self._memo.add(key)
+        closures = self._closures(fn)
+        self._visit_body(rel, cls_name, fn, fn, held, depth, closures)
+        # closure BODIES also run lock-free when invoked outside any held
+        # region (callbacks, later calls): traverse each once from a
+        # clean slate so nesting INSIDE a closure is never invisible
+        for name, node in closures.items():
+            ckey = (rel, cls_name, f"{fn.name}.{name}", ())
+            if ckey not in self._memo:
+                self._memo.add(ckey)
+                self._visit_body(rel, cls_name, fn, node, (), depth, closures)
+
+    def _visit_body(
+        self, rel, cls_name, fn, node, held, depth, closures
+    ) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                 ast.ClassDef),
+            ):
+                continue  # a def runs when CALLED, not here; lexical
+                # calls to closures are followed in _visit_call
+            child_held = held
+            if isinstance(child, ast.With):
+                acquired = []
+                for item in child.items:
+                    lid = self.lock_id(
+                        item.context_expr, rel, cls_name, fn.name
+                    )
+                    if lid:
+                        acquired.append((lid, child.lineno))
+                for lid, lineno in acquired:
+                    self.nodes.add(lid)
+                    for h in child_held:
+                        self._edge(h, lid, rel, lineno)
+                    child_held = child_held + (lid,)
+            if isinstance(child, ast.Call):
+                self._visit_call(
+                    rel, cls_name, fn, child, child_held, depth, closures
+                )
+            self._visit_body(
+                rel, cls_name, fn, child, child_held, depth, closures
+            )
+
+    def _visit_call(
+        self, rel, cls_name, fn, call, held, depth, closures
+    ) -> None:
+        # bare .acquire() on a lockish receiver: record the edge (held ->
+        # acquired) but do not track it as held past the statement.
+        f = call.func
+        if isinstance(f, ast.Attribute) and f.attr == "acquire":
+            lid = self.lock_id(f.value, rel, cls_name, fn.name)
+            if lid:
+                self.nodes.add(lid)
+                for h in held:
+                    self._edge(h, lid, rel, call.lineno)
+        if not held:
+            return  # nothing held: callee entered lock-free, its own
+            # top-level (or closure) traversal already covers it
+        if (
+            isinstance(f, ast.Name)
+            and f.id in closures
+            and depth <= MAX_CALL_DEPTH
+        ):
+            # lexical call to a local closure under held locks: its body
+            # runs HERE, under exactly these locks
+            self._visit_body(
+                rel, cls_name, fn, closures[f.id], held, depth + 1, closures
+            )
+        for crel, ccls, cfn in self.index.resolve_call(rel, cls_name, call):
+            self._visit_fn(crel, ccls, cfn, held, depth + 1)
+
+    def _edge(self, a: str, b: str, rel: str, lineno: int) -> None:
+        if a == b:
+            # re-acquisition of a held lock: a self-deadlock for a plain
+            # Lock — modeled as a self-loop, reported as a cycle
+            pass
+        self.nodes.add(a)
+        self.nodes.add(b)
+        self.edges.setdefault((a, b), set()).add(f"{rel}:{lineno}")
+
+
+def build_lock_graph(files: dict) -> dict:
+    """The acquisition-order graph for a parsed file map, JSON-shaped."""
+    c = _Collector(RepoIndex(files))
+    c.collect()
+    return {
+        "schema": GRAPH_SCHEMA,
+        "generated_by": "python -m tools.d4pglint.wholeprog.lockgraph --write",
+        "nodes": sorted(c.nodes),
+        "edges": [
+            {
+                "from": a,
+                "to": b,
+                # paths only (no line numbers): the artifact must not
+                # drift every time an unrelated edit shifts lines
+                "sites": sorted({s.rsplit(":", 1)[0] for s in sites}),
+            }
+            for (a, b), sites in sorted(c.edges.items())
+        ],
+        # line-bearing sites kept OUT of the committed artifact but
+        # returned for finding anchors
+        "_sites": {f"{a} -> {b}": sorted(sites)
+                   for (a, b), sites in c.edges.items()},
+    }
+
+
+def find_cycles(edges) -> list:
+    """Elementary cycles (as node lists) via iterative DFS over SCCs —
+    one representative cycle per strongly connected component, plus every
+    self-loop."""
+    adj: dict = {}
+    for a, b in edges:
+        adj.setdefault(a, set()).add(b)
+    cycles = []
+    for a, b in sorted(edges):
+        if a == b:
+            cycles.append([a, a])
+    # Tarjan SCC
+    index_of: dict = {}
+    low: dict = {}
+    on_stack: set = set()
+    stack: list = []
+    counter = [0]
+    sccs = []
+
+    def strongconnect(v):
+        work = [(v, iter(sorted(adj.get(v, ()))))]
+        index_of[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index_of:
+                    index_of[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(adj.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[node] = min(low[node], index_of[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                low[work[-1][0]] = min(low[work[-1][0]], low[node])
+            if low[node] == index_of[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                if len(scc) > 1:
+                    sccs.append(sorted(scc))
+
+    for v in sorted(adj):
+        if v not in index_of:
+            strongconnect(v)
+    for scc in sccs:
+        # one representative cycle: walk within the SCC from its smallest
+        # node back to itself
+        start = scc[0]
+        path = [start]
+        seen = {start}
+        node = start
+        closed = False
+        while True:
+            nxts = [w for w in sorted(adj.get(node, ())) if w in scc]
+            nxt = next((w for w in nxts if w == start), None)
+            if nxt is None:
+                nxt = next((w for w in nxts if w not in seen), None)
+            if nxt is None:
+                break
+            path.append(nxt)
+            if nxt == start:
+                cycles.append(path)
+                closed = True
+                break
+            seen.add(nxt)
+            node = nxt
+        if not closed:  # degenerate walk: report the SCC itself
+            cycles.append(scc + [start])
+    return cycles
+
+
+def is_acyclic(nodes, edges) -> bool:
+    """Kahn's algorithm over (from, to) pairs (self-loops count cyclic)."""
+    indeg = {n: 0 for n in nodes}
+    adj: dict = {n: [] for n in nodes}
+    for a, b in edges:
+        if a == b:
+            return False
+        adj.setdefault(a, []).append(b)
+        indeg[b] = indeg.get(b, 0) + 1
+        indeg.setdefault(a, 0)
+    queue = [n for n, d in indeg.items() if d == 0]
+    seen = 0
+    while queue:
+        n = queue.pop()
+        seen += 1
+        for w in adj.get(n, ()):
+            indeg[w] -= 1
+            if indeg[w] == 0:
+                queue.append(w)
+    return seen == len(indeg)
+
+
+@wholeprog_check("lock-order")
+def lock_order(files: dict, root=None) -> list:
+    """Cycles in the global lock-acquisition-order graph are deadlocks
+    waiting for the right interleaving. One finding per cycle, anchored
+    at the first acquisition site of the cycle's first edge."""
+    graph = build_lock_graph(files)
+    edge_pairs = [(e["from"], e["to"]) for e in graph["edges"]]
+    out = []
+    for cycle in find_cycles(edge_pairs):
+        a, b = cycle[0], cycle[1]
+        sites = graph["_sites"].get(f"{a} -> {b}", [])
+        rel, _, line = (sites[0] if sites else "unknown:0").rpartition(":")
+        pretty = " -> ".join(cycle)
+        out.append(
+            Finding(
+                "lock-order", rel or "unknown", int(line or 0),
+                f"lock-order cycle {pretty}: two paths can acquire these "
+                "locks in opposite orders (deadlock under the right "
+                "interleaving) — pick one global order and restructure, "
+                "or move the inner call outside the locked region",
+            )
+        )
+    return out
+
+
+def main(argv=None) -> int:
+    """CLI: print the graph, or ``--write`` it to the committed artifact."""
+    import argparse
+    import os
+
+    p = argparse.ArgumentParser(
+        prog="python -m tools.d4pglint.wholeprog.lockgraph"
+    )
+    p.add_argument("--write", action="store_true",
+                   help="write benchmarks/lock_order_graph.json")
+    args = p.parse_args(argv)
+    from tools.d4pglint.core import parse_default_files, repo_root
+
+    root = repo_root()
+    files = parse_default_files(root)
+    graph = build_lock_graph(files)
+    graph.pop("_sites")
+    doc = json.dumps(graph, indent=1, sort_keys=True) + "\n"
+    if args.write:
+        path = os.path.join(root, "benchmarks", "lock_order_graph.json")
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(doc)
+        print(f"wrote {path}: {len(graph['nodes'])} locks, "
+              f"{len(graph['edges'])} edges")
+    else:
+        print(doc, end="")
+    pairs = [(e["from"], e["to"]) for e in graph["edges"]]
+    if not is_acyclic(graph["nodes"], pairs):
+        print("lock-order: graph is CYCLIC")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,210 @@
+"""Shape-aware partition-rule coverage gate.
+
+PR 9's rule registry (``d4pg_tpu/parallel/partition.py``) maps every
+TrainState/DeviceRing leaf to a PartitionSpec by first-match regex, with
+a replication fallback for anything unmatched. The fallback is the
+footgun: the registry's previous incarnation silently replicated any
+E≠2 ensemble stack — E× the params on every device, no error, no
+warning. This gate turns that bug class into a lint-time failure:
+
+- it instantiates the REAL param trees of a model zoo (MLP, twin-critic,
+  REDQ ensemble, MoG head, pixel encoder) **abstractly** via
+  ``jax.eval_shape`` — true shapes, no device memory — under
+  ``JAX_PLATFORMS=cpu`` with a forced 4-device host platform so a 2x2
+  dp×tp mesh exercises the divisibility fallbacks;
+- every leaf must match a real rule (or a declared stack): any leaf
+  whose outcome is a ``fallback_*`` replication must be declared in
+  ``wholeprog/config.py:DECLARED_REPLICATED`` with its justification;
+- the DeviceRing field registry (``RING_RULES``) is audited the same
+  way against the ring's field layout.
+
+This module EXECUTES repo code, unlike every other d4pglint check — so
+the lint driver (``python -m tools.d4pglint``) runs it as a subprocess,
+keeping "linting never imports linted code" true for the lint process
+itself. ``--inject-undeclared-stack`` audits an ensemble tree while
+WITHHOLDING its stack declaration — the seeded PR-9 bug — and must fail
+(the fixture test asserts exactly that).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+from tools.d4pglint.wholeprog.config import DECLARED_REPLICATED
+
+_FORCE_DEVICES = 4  # dp=2 x tp=2: small, but every fallback path executes
+
+
+def _ensure_cpu() -> None:
+    """Pin the backend BEFORE jax imports: the gate must run identically
+    on a TPU host, a laptop, and CI."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={_FORCE_DEVICES}"
+        ).strip()
+
+
+def _declared(name: str, tree_name: str) -> bool:
+    full = f"{tree_name}/{name}"
+    return any(
+        re.search(pattern, full) or re.search(pattern, name)
+        for pattern, _why in DECLARED_REPLICATED
+    )
+
+
+def _model_zoo():
+    """(zoo_name, config, ensemble_axis) — every head/encoder/stack
+    variant the repo can train, so a new rule gap surfaces here first."""
+    from d4pg_tpu.agent.state import D4PGConfig
+    from d4pg_tpu.models.critic import DistConfig
+
+    return [
+        ("mlp", D4PGConfig(obs_dim=17, action_dim=6), None),
+        ("twin", D4PGConfig(obs_dim=17, action_dim=6, twin_critic=True),
+         None),
+        ("redq5", D4PGConfig(obs_dim=17, action_dim=6, critic_ensemble=5),
+         None),
+        ("redq4_tp", D4PGConfig(obs_dim=17, action_dim=6, critic_ensemble=4),
+         "tp"),
+        ("mog", D4PGConfig(
+            obs_dim=17, action_dim=6, twin_critic=True,
+            dist=DistConfig(kind="mixture_gaussian", num_mixtures=5),
+        ), None),
+        ("pixel", D4PGConfig(
+            obs_dim=24 * 24 * 3, action_dim=4, pixel_shape=(24, 24, 3),
+        ), None),
+    ]
+
+
+def audit(inject_undeclared_stack: bool = False) -> list[str]:
+    """Run the coverage audit; returns problems ([] = every leaf
+    accounted for). ``inject_undeclared_stack`` audits the ensemble
+    config while WITHHOLDING its stack declaration (the seeded PR-9
+    silent-replication bug) — the result must be non-empty."""
+    _ensure_cpu()
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from d4pg_tpu.agent.d4pg import create_train_state
+    from d4pg_tpu.agent.state import D4PGConfig
+    from d4pg_tpu.parallel.partition import (
+        DEFAULT_RULES,
+        DEFAULT_STACK_AXES,
+        RING_RULES,
+        explain_partition_rules,
+        stack_axes_for,
+    )
+
+    mesh = Mesh(
+        np.array(jax.devices()[:_FORCE_DEVICES]).reshape(2, 2), ("dp", "tp")
+    )
+    problems: list[str] = []
+    checked = 0
+
+    if inject_undeclared_stack:
+        # the seeded bug: an E=5 ensemble whose stack declaration is
+        # withheld — exactly the registry state that silently replicated
+        # in PR 9's first cut
+        zoo = [("redq5_undeclared",
+                D4PGConfig(obs_dim=17, action_dim=6, critic_ensemble=5),
+                None)]
+    else:
+        zoo = _model_zoo()
+
+    for zoo_name, config, ensemble_axis in zoo:
+        if inject_undeclared_stack:
+            stack_axes = DEFAULT_STACK_AXES  # the withheld declaration
+        else:
+            stack_axes = stack_axes_for(config, ensemble_axis)
+        state = jax.eval_shape(
+            lambda k, config=config: create_train_state(config, k),
+            jax.random.PRNGKey(0),
+        )
+        for tree_name in (
+            "actor_params", "critic_params", "target_actor_params",
+            "target_critic_params", "actor_opt_state", "critic_opt_state",
+        ):
+            rows = explain_partition_rules(
+                DEFAULT_RULES, getattr(state, tree_name), mesh, stack_axes
+            )
+            for row in rows:
+                checked += 1
+                if not row["outcome"].startswith("fallback"):
+                    continue
+                if _declared(row["name"], f"{zoo_name}/{tree_name}"):
+                    continue
+                problems.append(
+                    f"{zoo_name}:{tree_name}/{row['name']} "
+                    f"shape={row['shape']} fell to the replication "
+                    f"fallback ({row['outcome']}"
+                    + (f", rule {row['rule']!r}" if row["rule"] else "")
+                    + ") — every leaf must match a real partition rule, "
+                    "declare its stack in stack_axes_for, or be listed "
+                    "in DECLARED_REPLICATED with its justification "
+                    "(silent replication is the PR-9 bug class)"
+                )
+
+    if not inject_undeclared_stack:
+        # the device replay ring: field-name registry, same contract
+        from jax import ShapeDtypeStruct as Sds
+
+        cap, obs_dim, action_dim = 4096, 17, 6
+        ring_fields = {
+            "obs": Sds((cap, obs_dim), np.float32),
+            "action": Sds((cap, action_dim), np.float32),
+            "reward": Sds((cap,), np.float32),
+            "next_obs": Sds((cap, obs_dim), np.float32),
+            "discount": Sds((cap,), np.float32),
+            "size": Sds((), np.int32),
+        }
+        for row in explain_partition_rules(RING_RULES, ring_fields, mesh):
+            checked += 1
+            if row["outcome"].startswith("fallback") and not _declared(
+                row["name"], "device_ring"
+            ):
+                problems.append(
+                    f"device_ring/{row['name']} shape={row['shape']} fell "
+                    f"to the replication fallback ({row['outcome']}) — add "
+                    "a RING_RULES row or a DECLARED_REPLICATED entry"
+                )
+    if not problems:
+        print(f"partition-coverage: OK ({checked} leaves, "
+              f"{len(zoo)} zoo configs, mesh dp=2 tp=2)")
+    return problems
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m tools.d4pglint.wholeprog.partition_coverage"
+    )
+    p.add_argument("--inject-undeclared-stack", action="store_true",
+                   help="audit an ensemble tree with its stack declaration "
+                        "withheld — the seeded PR-9 silent-replication bug; "
+                        "exit 0 iff the gate CATCHES it")
+    args = p.parse_args(argv)
+    problems = audit(inject_undeclared_stack=args.inject_undeclared_stack)
+    if args.inject_undeclared_stack:
+        if problems:
+            print(f"partition-coverage: injected undeclared stack caught "
+                  f"({len(problems)} leaves flagged)")
+            return 0
+        print("partition-coverage: INJECTED BUG NOT CAUGHT — the gate is "
+              "blind to undeclared stacks")
+        return 1
+    for e in problems:
+        print(e)
+    n = len(problems)
+    if n:
+        print(f"partition-coverage: {n} problem{'s' if n != 1 else ''}")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,322 @@
+"""Wire-protocol conformance: one id space, fully answered for.
+
+The serving and fleet subsystems share one frame layout and one message-
+id space (``d4pg_tpu/serve/protocol.py``), consumed by eight receive
+loops across five modules. This checker statically verifies the
+contracts that keep that sharing safe (the manifests in
+``wholeprog/config.py`` are the policy):
+
+1. **no id collisions** — two message names with one value would route
+   frames to the wrong handler on a port that legitimately speaks both;
+2. **codec pairs** — every id has an encoder+decoder (a function that
+   must exist, or a declared literal encoding), so a new message type
+   cannot ship half a codec;
+3. **endpoint coverage** — every receive loop dispatches on every id the
+   manifest says it can receive AND carries the explicit catch-all
+   rejection (``ProtocolError``), so an unexpected id fails loudly;
+4. **MAX_PAYLOAD enforcement** — frame bytes flow only through
+   ``protocol.read_frame``/``recv_exact`` (the one bounded read path);
+   raw ``.recv(`` or header unpacking in an endpoint module bypasses the
+   payload bound and is a finding;
+5. **no silent drops** — a dispatch branch that consumes a frame without
+   replying, resolving a future, raising, or closing is a finding
+   (justified suppressions only where dropping is the documented
+   protocol, e.g. a late reply to an already-swept request).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.d4pglint.checks import _dotted
+from tools.d4pglint.core import Finding
+from tools.d4pglint.wholeprog import wholeprog_check
+from tools.d4pglint.wholeprog.config import (
+    PROTOCOL_CODECS,
+    PROTOCOL_ENDPOINTS,
+    PROTOCOL_MODULE,
+    PROTOCOL_NON_IDS,
+    PROTOCOL_WIRE_MODULES,
+)
+
+_CHECK = "protocol-conformance"
+
+
+def _protocol_ids(tree) -> dict:
+    """name -> (value, lineno) for module-level int-constant assigns."""
+    out = {}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not (
+            isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, int)
+            and not isinstance(node.value.value, bool)
+        ):
+            continue
+        for t in node.targets:
+            if (
+                isinstance(t, ast.Name)
+                and t.id.isupper()
+                and t.id not in PROTOCOL_NON_IDS
+            ):
+                out[t.id] = (node.value.value, node.lineno)
+    return out
+
+
+def _function(files, qual: str):
+    """Look up "module.py::qualname" in the file map -> FunctionDef|None."""
+    mod, _, name = qual.partition("::")
+    if mod not in files:
+        return None
+    tree = files[mod][0]
+    parts = name.split(".")
+    scope = tree.body
+    node = None
+    for i, part in enumerate(parts):
+        node = next(
+            (
+                n
+                for n in scope
+                if isinstance(
+                    n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+                )
+                and n.name == part
+            ),
+            None,
+        )
+        if node is None:
+            return None
+        scope = node.body
+    return node if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) else None
+
+
+def _ids_compared(fn, id_names) -> set:
+    """Protocol id names referenced in comparisons/branches inside fn."""
+    seen = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Compare, ast.Match)):
+            for sub in ast.walk(node):
+                dotted = _dotted(sub) or ""
+                tail = dotted.split(".")[-1]
+                if tail in id_names:
+                    seen.add(tail)
+    return seen
+
+
+def _mentions_protocol_error(fn) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Raise, ast.Call)):
+            for sub in ast.walk(node):
+                dotted = _dotted(sub) or ""
+                if dotted.split(".")[-1] == "ProtocolError":
+                    return True
+    return False
+
+
+_REPLY_CALL_NAMES = (
+    "reply", "write_frame", "set_result", "set_exception", "abortive_close",
+    "close",
+)
+
+
+def _branch_answers(body) -> bool:
+    """Does a dispatch branch answer the frame: a reply/resolve call, a
+    raise, or a return (EOF/handled upstream)?"""
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Raise, ast.Return, ast.Break)):
+                return True
+            if isinstance(node, ast.Call):
+                dotted = _dotted(node.func) or ""
+                if dotted.split(".")[-1] in _REPLY_CALL_NAMES:
+                    return True
+    return False
+
+
+def _silent_drop_branches(fn) -> list:
+    """``continue`` whose enclosing if-branch neither replies, resolves,
+    raises, nor returns: the frame is consumed and nobody answers. (The
+    heuristic is continue-shaped on purpose — every receive loop in this
+    codebase dispatches via early-continue branches; a drop that falls
+    through without ``continue`` ends the loop iteration anyway and is
+    covered by the catch-all requirement.)"""
+    out = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.If):
+            continue
+        for body in (node.body, node.orelse):
+            has_continue = any(
+                isinstance(s, ast.Continue) for s in body
+            )
+            if has_continue and not _branch_answers(body):
+                lineno = next(
+                    s.lineno for s in body if isinstance(s, ast.Continue)
+                )
+                out.append(lineno)
+    return out
+
+
+@wholeprog_check("protocol-conformance")
+def protocol_conformance(files: dict, root=None) -> list:
+    out = []
+    if PROTOCOL_MODULE not in files:
+        return out
+    ptree, _ = files[PROTOCOL_MODULE]
+    ids = _protocol_ids(ptree)
+
+    # 1. collisions
+    by_value: dict = {}
+    for name, (value, lineno) in sorted(ids.items(), key=lambda kv: kv[1][1]):
+        if value in by_value:
+            out.append(
+                Finding(
+                    _CHECK, PROTOCOL_MODULE, lineno,
+                    f"message id collision: {name} = {value} already taken "
+                    f"by {by_value[value]} — one id space across serving "
+                    "and fleet means a frame would route to the wrong "
+                    "handler",
+                )
+            )
+        else:
+            by_value[value] = name
+
+    # 2. codec pairs (manifest <-> module drift, and codec existence)
+    for name, (_value, lineno) in sorted(ids.items()):
+        if name not in PROTOCOL_CODECS:
+            out.append(
+                Finding(
+                    _CHECK, PROTOCOL_MODULE, lineno,
+                    f"message id {name} has no codec row in "
+                    "wholeprog/config.py:PROTOCOL_CODECS — declare its "
+                    "payload encoding (encoder+decoder) with the id",
+                )
+            )
+    for name, (enc, dec) in sorted(PROTOCOL_CODECS.items()):
+        if name not in ids:
+            out.append(
+                Finding(
+                    _CHECK, PROTOCOL_MODULE, 1,
+                    f"PROTOCOL_CODECS declares {name} but the protocol "
+                    "module defines no such id — stale manifest row",
+                )
+            )
+            continue
+        for role, qual in (("encoder", enc), ("decoder", dec)):
+            if "::" not in qual:
+                continue  # declared literal encoding (empty/utf8/json)
+            mod = qual.partition("::")[0]
+            if mod in files and _function(files, qual) is None:
+                out.append(
+                    Finding(
+                        _CHECK, mod, 1,
+                        f"{name}'s declared {role} `{qual}` does not "
+                        "exist — half a codec means one direction of the "
+                        "wire cannot speak this id",
+                    )
+                )
+
+    # 3. endpoint coverage + catch-all rejection, 5. silent drops
+    for endpoint, (qual, handled) in sorted(PROTOCOL_ENDPOINTS.items()):
+        mod = qual.partition("::")[0]
+        fn = _function(files, qual)
+        if fn is None:
+            if mod in files:
+                out.append(
+                    Finding(
+                        _CHECK, mod, 1,
+                        f"endpoint {endpoint}: receive loop `{qual}` not "
+                        "found — PROTOCOL_ENDPOINTS is stale",
+                    )
+                )
+            continue
+        compared = _ids_compared(fn, set(ids) | set(PROTOCOL_CODECS))
+        missing = sorted(set(handled) - compared)
+        if missing:
+            out.append(
+                Finding(
+                    _CHECK, mod, fn.lineno,
+                    f"endpoint {endpoint} ({qual.partition('::')[2]}) "
+                    f"never dispatches on {', '.join(missing)} — every id "
+                    "an endpoint can receive must be handled or land in "
+                    "its explicit rejection",
+                )
+            )
+        if not _mentions_protocol_error(fn):
+            out.append(
+                Finding(
+                    _CHECK, mod, fn.lineno,
+                    f"endpoint {endpoint} ({qual.partition('::')[2]}) has "
+                    "no ProtocolError catch-all: an unexpected message id "
+                    "must fail loudly, not fall through",
+                )
+            )
+        for lineno in _silent_drop_branches(fn):
+            out.append(
+                Finding(
+                    _CHECK, mod, lineno,
+                    f"endpoint {endpoint}: this branch consumes a frame "
+                    "without replying, resolving, raising, or closing — a "
+                    "silent drop; answer it or suppress with the "
+                    "documented reason",
+                )
+            )
+
+    # 4. MAX_PAYLOAD: the bounded read path is protocol.read_frame
+    rf = _function(files, f"{PROTOCOL_MODULE}::read_frame")
+    wf = _function(files, f"{PROTOCOL_MODULE}::write_frame")
+    for role, fn in (("read_frame", rf), ("write_frame", wf)):
+        if fn is None:
+            out.append(
+                Finding(
+                    _CHECK, PROTOCOL_MODULE, 1,
+                    f"protocol module defines no `{role}` — the single "
+                    "bounded framing path is the MAX_PAYLOAD enforcement "
+                    "point",
+                )
+            )
+        elif not any(
+            (_dotted(n) or "").split(".")[-1] == "MAX_PAYLOAD"
+            for n in ast.walk(fn)
+        ):
+            out.append(
+                Finding(
+                    _CHECK, PROTOCOL_MODULE, fn.lineno,
+                    f"`{role}` never checks MAX_PAYLOAD — an oversized "
+                    "declared length must fail before any buffering",
+                )
+            )
+    for mod in PROTOCOL_WIRE_MODULES:
+        if mod not in files:
+            continue
+        tree, _src = files[mod]
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn_node = node.func
+            if not isinstance(fn_node, ast.Attribute):
+                continue
+            dotted = _dotted(fn_node) or ""
+            if fn_node.attr == "recv":
+                out.append(
+                    Finding(
+                        _CHECK, mod, node.lineno,
+                        "raw socket `.recv()` outside protocol.py: frame "
+                        "bytes must flow through protocol.read_frame / "
+                        "recv_exact — the one place MAX_PAYLOAD and "
+                        "mid-frame EOF are enforced",
+                    )
+                )
+            elif (
+                fn_node.attr in ("unpack", "unpack_from")
+                and dotted.split(".")[-2:-1] == ["HEADER"]
+            ):
+                out.append(
+                    Finding(
+                        _CHECK, mod, node.lineno,
+                        "frame HEADER unpacked outside protocol.py: "
+                        "header parsing bypasses read_frame's magic/"
+                        "version/length validation",
+                    )
+                )
+    return out

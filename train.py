@@ -522,6 +522,14 @@ def install_preemption_handlers(stop_callback) -> None:
 
 def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
+    if args.debug_guards:
+        # Arm the lock-order witness BEFORE any guarded component builds
+        # its locks (named_lock/named_condition wrap only when enabled);
+        # Trainer.close checks the recorded nesting against the committed
+        # benchmarks/lock_order_graph.json.
+        from d4pg_tpu.analysis import lockwitness
+
+        lockwitness.enable()
     if args.distributed or args.coordinator or (args.num_processes or 0) > 1:
         # Before config_from_args/Trainer import anything that touches
         # devices: the backend binds to the local slice at first use.
