@@ -1273,6 +1273,312 @@ def bench_serve_router(
     return out
 
 
+def bench_serve_multitenant(
+    *,
+    obs_dim: int = OBS_DIM,
+    act_dim: int = ACT_DIM,
+    hidden: int = 64,
+    max_batch: int = 16,
+    max_wait_us: int = 2000,
+    interactive_conns: int = 3,
+    interactive_window: int = 4,
+    bulk_conns: int = 3,
+    bulk_window: int = 32,
+    duration_s: float = 2.0,
+    infer_delay_ms: float = 50.0,
+    replica_capacity: int = 24,
+    bulk_fraction: float = 0.4,
+    slo_ms: float | None = None,
+    scale_window_s: float = 1.0,
+    seed: int = 0,
+) -> dict:
+    """The multi-tenant serving claims as numbers (ISSUE 12), chip-
+    independent by the same slow-device-stub argument as
+    ``bench_serve_router``:
+
+    - **isolation** — the same interactive population (tenant ``web``,
+      interactive class) measured alone and then with a FLOODING bulk
+      tenant (``batch``, bulk class, a much deeper closed window)
+      hammering the same 2-replica fleet through the router's class-aware
+      admission (``replica_capacity``/``bulk_fraction``). The pinned
+      claim: the flood cannot move interactive p99 past its SLO — bulk
+      sheds first (``bulk_capacity``) and absorbs the overload — and the
+      per-(tenant, class) accounting identity is exact on every row.
+
+    - **autoscale_scaling** — one continuous interactive+bulk load while
+      an :class:`~d4pg_tpu.serve.autoscaler.Autoscaler` (tight test
+      cadence) grows the fleet 1 → 2 via an in-process replica pool
+      through ``router.add_backend``: aggregate ok-rps measured in a
+      window at 1 replica and again after admission of the 2nd must
+      scale (the capacity claim; the subprocess-spawning pool is proven
+      in chaos_soak.sh leg 7).
+
+    ``slo_ms`` defaults to 8 × ``infer_delay_ms``: with device-bound
+    replicas a protected interactive request rides ~1-2 batch times; an
+    UNPROTECTED fleet under the bulk window would queue
+    ~bulk_conns×bulk_window/max_batch batches deep (~10× that) — so the
+    SLO separates the two regimes with margin on both sides."""
+    import threading
+
+    from d4pg_tpu.agent.state import D4PGConfig
+    from d4pg_tpu.models.critic import DistConfig
+    from d4pg_tpu.serve import (
+        Autoscaler,
+        PolicyBundle,
+        PolicyClient,
+        PolicyServer,
+        Router,
+    )
+    from d4pg_tpu.serve.autoscaler import ServingSignalSource
+    from d4pg_tpu.serve.bundle import actor_template
+    from d4pg_tpu.serve.client import ConnectionClosed, Overloaded
+
+    slo_ms = slo_ms if slo_ms is not None else 8.0 * infer_delay_ms
+    config = D4PGConfig(
+        obs_dim=obs_dim,
+        action_dim=act_dim,
+        hidden_sizes=(hidden, hidden, hidden),
+        dist=DistConfig(kind="categorical", num_atoms=ATOMS, v_min=V_MIN, v_max=V_MAX),
+    )
+    bundle = PolicyBundle(
+        config=config,
+        actor_params=actor_template(config),
+        action_low=np.full(act_dim, -1.0, np.float32),
+        action_high=np.full(act_dim, 1.0, np.float32),
+        obs_norm=None,
+        meta={"source": "bench_serve_multitenant"},
+    )
+    rng = np.random.default_rng(seed)
+    obs = rng.normal(size=obs_dim).astype(np.float32)
+
+    def make_server():
+        s = PolicyServer(
+            bundle,
+            port=0,
+            max_batch=max_batch,
+            max_wait_us=max_wait_us,
+            queue_limit=8 * max_batch,
+            watch_bundle=False,
+        )
+        s.start()
+        if infer_delay_ms:
+            real_infer = s.batcher._infer
+
+            def slow_infer(params, obs_batch, _real=real_infer):
+                time.sleep(infer_delay_ms / 1e3)
+                return _real(params, obs_batch)
+
+            s.batcher._infer = slow_infer
+        return s
+
+    def pct(lat):
+        if not lat:
+            return {"p50_ms": None, "p95_ms": None, "p99_ms": None}
+        v = np.percentile(np.asarray(lat), (50, 95, 99))
+        return {f"p{q}_ms": round(float(x) * 1e3, 4) for q, x in zip((50, 95, 99), v)}
+
+    class Load:
+        """Closed-loop population with a fixed (tenant, qos): every
+        completion immediately re-sends, every outcome tallied — the
+        client side of the accounting identity."""
+
+        def __init__(self, port, conns, window, tenant, qos):
+            self.counts = {"submitted": 0, "ok": 0, "overloaded": 0,
+                           "error": 0}
+            self.lats: list[float] = []
+            self.lock = threading.Lock()
+            self.stop = threading.Event()
+            self.window = window
+            self.clients = [
+                PolicyClient("127.0.0.1", port, tenant=tenant, qos=qos)
+                for _ in range(conns)
+            ]
+            self.idle = threading.Semaphore(0)
+
+        def _send_next(self, c):
+            t0 = time.perf_counter()
+            with self.lock:
+                self.counts["submitted"] += 1
+            fut = c.act_async(obs)
+
+            def done(f, t0=t0, c=c):
+                exc = f.exception()
+                with self.lock:
+                    if exc is None:
+                        self.counts["ok"] += 1
+                        self.lats.append(time.perf_counter() - t0)
+                    elif isinstance(exc, Overloaded):
+                        self.counts["overloaded"] += 1
+                    else:
+                        self.counts["error"] += 1
+                if self.stop.is_set() or isinstance(exc, ConnectionClosed):
+                    self.idle.release()
+                else:
+                    self._send_next(c)
+
+            fut.add_done_callback(done)
+
+        def start(self):
+            for c in self.clients:
+                for _ in range(self.window):
+                    self._send_next(c)
+            return self
+
+        def finish(self) -> dict:
+            self.stop.set()
+            for _ in range(len(self.clients) * self.window):
+                self.idle.acquire(timeout=30)
+            for c in self.clients:
+                c.close()
+            answered = (self.counts["ok"] + self.counts["overloaded"]
+                        + self.counts["error"])
+            return {
+                **self.counts,
+                "answered": answered,
+                "identity_ok": answered == self.counts["submitted"],
+                "shed_rate": round(
+                    self.counts["overloaded"]
+                    / max(self.counts["submitted"], 1), 6
+                ),
+                **pct(self.lats),
+            }
+
+    def start_fleet(m: int):
+        servers = [make_server() for _ in range(m)]
+        router = Router(
+            [("127.0.0.1", s.port) for s in servers],
+            port=0,
+            probe_interval_s=0.1,
+            probe_timeout_s=1.0,
+            readmit_after=1,
+            retry_seed=seed,
+            replica_capacity=replica_capacity,
+            bulk_fraction=bulk_fraction,
+        )
+        router.start()
+        router.wait_for_replicas(m, timeout_s=60)
+        return servers, router
+
+    out: dict = {
+        "config": {
+            "obs_dim": obs_dim, "act_dim": act_dim, "hidden": hidden,
+            "max_batch": max_batch, "max_wait_us": max_wait_us,
+            "interactive_conns": interactive_conns,
+            "interactive_window": interactive_window,
+            "bulk_conns": bulk_conns, "bulk_window": bulk_window,
+            "duration_s": duration_s, "infer_delay_ms": infer_delay_ms,
+            "replica_capacity": replica_capacity,
+            "bulk_fraction": bulk_fraction,
+            "slo_ms": slo_ms,
+        },
+    }
+
+    # ---- isolation: interactive alone, then under a bulk flood ------------
+    servers, router = start_fleet(2)
+    try:
+        inter = Load(router.port, interactive_conns, interactive_window,
+                     "web", "interactive").start()
+        time.sleep(duration_s)
+        baseline = inter.finish()
+        inter = Load(router.port, interactive_conns, interactive_window,
+                     "web", "interactive").start()
+        flood = Load(router.port, bulk_conns, bulk_window,
+                     "batch", "bulk").start()
+        time.sleep(duration_s)
+        inter_row = inter.finish()
+        flood_row = flood.finish()
+        h = router.healthz()
+        tenants = h["tenants"]
+        rows_ok = all(
+            row["requests"] == row["answered"] for row in tenants.values()
+        )
+        out["isolation"] = {
+            "interactive_baseline": baseline,
+            "interactive_under_flood": inter_row,
+            "bulk_flood": flood_row,
+            "slo_ms": slo_ms,
+            "interactive_p99_ms": inter_row["p99_ms"],
+            "isolation_ok": (
+                inter_row["identity_ok"]
+                and flood_row["identity_ok"]
+                and inter_row["p99_ms"] is not None
+                and inter_row["p99_ms"] <= slo_ms
+            ),
+            "bulk_shed_rate": flood_row["shed_rate"],
+            "shed_bulk_capacity": h["shed_bulk_capacity"],
+            "shed_capacity": h["shed_capacity"],
+            "tenants": tenants,
+            "tenant_identity_ok": rows_ok,
+            "router_identity_ok": (
+                h["requests_total"] == h["answered_total"]
+            ),
+        }
+    finally:
+        router.drain()
+        for s in servers:
+            s.drain()
+
+    # ---- autoscale_scaling: rps at 1 replica vs after the scale-up --------
+    servers, router = start_fleet(1)
+    spawned: list = []
+
+    def scale_up():
+        s = make_server()
+        spawned.append(s)
+        router.add_backend("127.0.0.1", s.port)
+        return True
+
+    scaler = Autoscaler(
+        ServingSignalSource(router.healthz),
+        scale_up,
+        lambda: False,  # this leg only grows; drain is soak-proven
+        min_replicas=1,
+        max_replicas=2,
+        interval_s=0.2,
+        samples=2,
+        cooldown_s=1.0,
+        up_load=0.7,
+        down_load=0.1,
+    )
+    try:
+        load = Load(router.port, interactive_conns + bulk_conns,
+                    max(interactive_window, 8), "web",
+                    "interactive").start()
+        ok0 = router.healthz()["replies_ok"]
+        time.sleep(scale_window_s)
+        rps1 = (router.healthz()["replies_ok"] - ok0) / scale_window_s
+        scaler.start()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if router.healthz()["admitted"] >= 2:
+                break
+            time.sleep(0.1)
+        admitted = router.healthz()["admitted"]
+        time.sleep(0.5)  # settle: let dispatch spread onto the new replica
+        ok0 = router.healthz()["replies_ok"]
+        time.sleep(scale_window_s)
+        rps2 = (router.healthz()["replies_ok"] - ok0) / scale_window_s
+        final = load.finish()
+        h = router.healthz()
+        out["autoscale_scaling"] = {
+            "rps_1_replica": round(rps1, 2),
+            "rps_2_replicas": round(rps2, 2),
+            "scaling_2_over_1": round(rps2 / rps1, 3) if rps1 else None,
+            "admitted_after_scale": admitted,
+            "scale_ups": scaler.snapshot()["scale_ups"],
+            "identity_ok": (
+                final["identity_ok"]
+                and h["requests_total"] == h["answered_total"]
+            ),
+        }
+    finally:
+        scaler.close()
+        router.drain()
+        for s in servers + spawned:
+            s.drain()
+    return out
+
+
 def bench_torch_cpu_baseline() -> float:
     """Reference-style D4PG step: CPU torch nets + host NumPy projection."""
     import torch
@@ -1429,6 +1735,16 @@ def main(argv=None) -> None:
         "print ONE JSON line, and exit; the committed chip-independent "
         "artifact is benchmarks/router_microbench.json",
     )
+    ap.add_argument(
+        "--serve-multitenant",
+        action="store_true",
+        help="run the multi-tenant load generator (bench_serve_multitenant: "
+        "interactive p99 alone vs under a flooding bulk tenant through the "
+        "router's class-aware admission, and aggregate rps at 1 vs "
+        "autoscaled 2 replicas), print ONE JSON line, and exit; the "
+        "committed chip-independent artifact is "
+        "benchmarks/multitenant_microbench.json",
+    )
     args = ap.parse_args(argv)
     # Hermetic gate: the driver must get ONE parseable JSON line even when
     # the TPU tunnel is wedged (raises, hangs, or silently downgrades to
@@ -1519,6 +1835,14 @@ def main(argv=None) -> None:
     if args.serve_router:
         out = bench_serve_router()
         out["metric"] = "serve_router_loadgen"
+        import jax
+
+        out["backend"] = jax.default_backend()
+        print(json.dumps(out))
+        return
+    if args.serve_multitenant:
+        out = bench_serve_multitenant()
+        out["metric"] = "serve_multitenant_loadgen"
         import jax
 
         out["backend"] = jax.default_backend()

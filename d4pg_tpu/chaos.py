@@ -26,6 +26,20 @@ Spec syntax (entries separated by ``;`` or ``,``)::
     replica_slow@9:200    router: stall dispatch 9 for 200 ms
     canary_corrupt@1      router: truncate the params of its 1st canary
                           deploy (replica load fails, healthz degrades)
+    tenant_flood@30:bulky router: at its 30th request, inject a synthetic
+                          BULK burst from tenant "bulky" through the real
+                          admission path (quota + class shed absorb it;
+                          interactive p99 must hold)
+    policy_skew@40        router: at its 40th request, inject a synthetic
+                          burst 95% onto the default policy (cold
+                          policies must still meet their deadlines)
+    scaledown_during_canary@3  autoscaler: force a scale-down at its 3rd
+                          control tick (mid-rollout it must abort or
+                          complete cleanly, never strand a half-deployed
+                          replica)
+
+A ``:<arg>`` that does not parse as a number is kept as a string LABEL
+(``tenant_flood``'s tenant name); numeric args stay floats.
 
 ``count`` is 1-based and counted *at the site* (a worker counts its own
 env steps; the pool counts pool steps; the flusher counts wakes), which
@@ -68,6 +82,19 @@ site                  tick location               recovery proven
 ``canary_corrupt``    router, per canary deploy   replica keeps old params
                                                   (degraded), router
                                                   auto-rolls-back
+``tenant_flood``      router, per ACT frame       quota + bulk-first shed
+                                                  absorb the burst;
+                                                  interactive p99 holds,
+                                                  identity exact per
+                                                  tenant/class
+``policy_skew``       router, per ACT frame       cold policies' batchers
+                                                  unaffected; deadlines
+                                                  still met
+``scaledown_during_canary``  autoscaler, per      rollout aborts/completes
+                      control tick                cleanly; removed
+                                                  replica's bundle dir
+                                                  restored (never
+                                                  half-deployed)
 ====================  ==========================  =========================
 """
 
@@ -103,7 +130,19 @@ KNOWN_SITES = WORKER_SITES + (
     "replica_kill",
     "replica_slow",
     "canary_corrupt",
+    # multi-tenant sites (ISSUE 12): tenant_flood/policy_skew tick in the
+    # router per received ACT-class frame and inject a synthetic burst
+    # through the REAL admission + dispatch path (identity-accounted);
+    # scaledown_during_canary ticks once per autoscaler control tick and
+    # forces a scale-down (the rollout-abort proof).
+    "tenant_flood",
+    "policy_skew",
+    "scaledown_during_canary",
 )
+
+# Sites whose ``:<arg>`` is a string label, not a number (the flood's
+# tenant name). Everything else coerces to float as before.
+LABEL_ARG_SITES = ("tenant_flood",)
 
 
 @dataclass(frozen=True)
@@ -112,10 +151,13 @@ class ChaosEntry:
     at: int                      # 1-based count at the site
     arg: Optional[float] = None  # site-specific (hang/stall seconds)
     actor: Optional[int] = None  # worker index for worker-targeted sites
+    label: Optional[str] = None  # string arg (LABEL_ARG_SITES, e.g. tenant)
 
     def __str__(self) -> str:
         s = f"{self.site}@{self.at}"
-        if self.arg is not None:
+        if self.label is not None:
+            s += f":{self.label}"
+        elif self.arg is not None:
             s += f":{self.arg:g}"
         if self.actor is not None:
             s += f"#{self.actor}"
@@ -151,8 +193,17 @@ class ChaosPlan:
                 entry = ChaosEntry(
                     site=site,
                     at=int(at_s),
-                    arg=float(arg_s) if arg_s else None,
+                    arg=(
+                        float(arg_s)
+                        if arg_s and site not in LABEL_ARG_SITES
+                        else None
+                    ),
                     actor=int(actor_s) if actor_s else None,
+                    label=(
+                        arg_s
+                        if arg_s and site in LABEL_ARG_SITES
+                        else None
+                    ),
                 )
             except ValueError as e:
                 raise ValueError(f"bad chaos entry {tok!r}: {e}") from e
@@ -179,7 +230,8 @@ class ChaosPlan:
         resolved = []
         for e in self.entries:
             if e.site in WORKER_SITES + ("worker_kill",) and e.actor is None:
-                e = ChaosEntry(e.site, e.at, e.arg, (self.seed + e.at) % num_actors)
+                e = ChaosEntry(e.site, e.at, e.arg,
+                               (self.seed + e.at) % num_actors, e.label)
             elif e.actor is not None and e.actor >= num_actors:
                 raise ValueError(
                     f"chaos entry {e} targets actor {e.actor} but the pool "

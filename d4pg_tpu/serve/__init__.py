@@ -16,7 +16,11 @@ Barth-Maron et al. 2018 §deployment):
 - :mod:`~d4pg_tpu.serve.stats`    — p50/p95/p99, batch/queue histograms;
 - :mod:`~d4pg_tpu.serve.router`   — replicated front-end: least-loaded
   dispatch across M replicas, health-driven ejection/re-admission,
-  rolling canary rollout with auto-rollback (JAX-free, host-only).
+  per-policy rolling canary rollouts with auto-rollback, QoS classes +
+  per-tenant admission quotas (JAX-free, host-only);
+- :mod:`~d4pg_tpu.serve.autoscaler` — healthz-driven control loop with
+  hysteresis + cooldown: spawns/drains serve replicas (and fleet actor
+  hosts) against the exported gauges (JAX-free, host-only).
 
 Run it: ``python -m d4pg_tpu.serve --bundle <dir>`` (one replica) and
 ``python -m d4pg_tpu.serve.router --backends host:port,...`` (the fleet
@@ -44,6 +48,8 @@ _EXPORTS = {
     "ServerError": "d4pg_tpu.serve.client",
     "PolicyServer": "d4pg_tpu.serve.server",
     "Router": "d4pg_tpu.serve.router",
+    "Autoscaler": "d4pg_tpu.serve.autoscaler",
+    "ScaleSignal": "d4pg_tpu.serve.autoscaler",
 }
 
 __getattr__, __dir__ = lazy_exports(__name__, _EXPORTS)

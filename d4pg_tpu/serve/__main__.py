@@ -19,7 +19,15 @@ def build_parser() -> argparse.ArgumentParser:
         prog="python -m d4pg_tpu.serve", description=__doc__
     )
     p.add_argument("--bundle", required=True,
-                   help="bundle directory from train.py --export-bundle")
+                   help="bundle directory from train.py --export-bundle "
+                        "(the DEFAULT policy: v1 clients with no policy-id "
+                        "field land here)")
+    p.add_argument("--policy", action="append", default=[],
+                   metavar="NAME=DIR",
+                   help="additional resident policy (repeatable): NAME is "
+                        "the ACT2 policy_id, DIR its bundle. Each policy "
+                        "gets its own batcher, compile budget, and "
+                        "hot-reload watch")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=7431,
                    help="0 = ephemeral (printed on startup)")
@@ -80,8 +88,17 @@ def main(argv=None) -> None:
 
         chaos = ChaosInjector(ChaosPlan.parse(args.chaos))
     bundle = load_bundle(args.bundle)
+    policies = {}
+    for spec in args.policy:
+        name, sep, path = spec.partition("=")
+        if not sep or not name or not path:
+            raise SystemExit(f"--policy wants NAME=DIR, got {spec!r}")
+        if name in policies:
+            raise SystemExit(f"--policy {name!r} given twice")
+        policies[name] = load_bundle(path)
     server = PolicyServer(
         bundle,
+        policies=policies or None,
         host=args.host,
         port=args.port,
         max_batch=args.max_batch,
@@ -109,14 +126,22 @@ def main(argv=None) -> None:
         f"[serve] listening on {server.host}:{server.port} {rid}"
         f"obs_dim={bundle.obs_dim} action_dim={bundle.action_dim} "
         f"buckets={list(server.batcher.buckets)} "
+        f"policies={sorted(server._policies)} "
         f"source={bundle.meta.get('source', '?')}",
         flush=True,
     )
     server.serve_until_shutdown()
     snap = server.healthz()
+    # aggregate across every resident policy (top-level counters are the
+    # DEFAULT policy's — the PR-3 schema)
+    served = sum(r["replies_ok"] for r in snap["policies"].values())
+    shed = snap["shed_total"] + sum(
+        r["shed_total"] for pid, r in snap["policies"].items()
+        if pid != "default"
+    )
     print(
-        f"[serve] drained: {snap['replies_ok']} served, "
-        f"{snap['shed_total']} shed, p99={snap.get('p99_ms')} ms",
+        f"[serve] drained: {served} served, "
+        f"{shed} shed, p99={snap.get('p99_ms')} ms",
         flush=True,
     )
     sys.exit(0)

@@ -117,6 +117,7 @@ class DynamicBatcher:
         ledger=None,
         sentinel=None,
         guard_transfers: bool = False,
+        name: str = "serve",
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -190,9 +191,18 @@ class DynamicBatcher:
         # exactly one program per bucket after warmup; a hot reload or a
         # stray dtype drift that retraces trips check(). The trace-count
         # side effect above stays as the wire-visible compile_count.
+        # ``name`` scopes the sentinel entry and the ledger staging groups:
+        # a multi-policy server runs one batcher PER resident policy, and
+        # two batchers sharing the literal "serve.infer" key would pool
+        # their compile budgets (hiding a per-policy retrace) and alias
+        # each other's staging-slot generations. Default stays "serve" so
+        # single-policy traces/budgets are unchanged.
+        self.name = name
         self._sentinel = sentinel
         if sentinel is not None:
-            sentinel.track("serve.infer", self._infer, budget=len(self.buckets))
+            sentinel.track(
+                f"{name}.infer", self._infer, budget=len(self.buckets)
+            )
         # Transfer guard (--debug-guards): steady-state dispatch must see
         # only device-resident operands; the staging device_put below is
         # the one explicit, exempt copy. Resolved once here — the device
@@ -232,7 +242,7 @@ class DynamicBatcher:
         # thread hasn't fetched yet raises at the overwrite site. Group
         # names precomputed — no per-batch f-string on the device loop.
         self._ledger = ledger if ledger is not None else NULL_LEDGER
-        self._staging_group = {b: f"serve.staging[{b}]" for b in self.buckets}
+        self._staging_group = {b: f"{name}.staging[{b}]" for b in self.buckets}
         # Test hook (staging-ledger stress test): pin the rotation to one
         # slot to seed the PR-2/PR-3 early-reuse bug class deliberately.
         self._test_force_flip: Optional[int] = None

@@ -68,10 +68,24 @@ class PolicyClient:
         *,
         retries: int = 0,
         retry_seed: Optional[int] = None,
+        policy_id: Optional[str] = None,
+        qos: Optional[str] = None,
+        tenant: Optional[str] = None,
     ):
         self.host = host
         self.port = port
         self.timeout = timeout
+        # Multi-tenant identity (all optional): with NONE of them set the
+        # client emits v1 ``ACT`` frames byte-identical to the PR-8 wire —
+        # full interop with old servers. Setting any switches requests to
+        # the v2 ``ACT2`` frame (policy routing + router QoS/quota
+        # admission); against an OLD server those fail loudly with the
+        # server's "protocol version" ERROR, never a decode crash.
+        self.policy_id = policy_id
+        self.tenant = tenant or ""
+        if qos is not None and qos not in ("interactive", "bulk"):
+            raise ValueError(f"qos must be 'interactive' or 'bulk', got {qos!r}")
+        self.qos = qos
         # Opt-in bounded retry for act(): attempts beyond the first on
         # Overloaded/ConnectionClosed, paced by a seeded Backoff (jitter
         # must not synchronize a retrying fleet; seeding keeps chaos runs
@@ -218,14 +232,38 @@ class PolicyClient:
         return True
 
     def act_async(
-        self, obs: np.ndarray, deadline_ms: Optional[float] = None
+        self,
+        obs: np.ndarray,
+        deadline_ms: Optional[float] = None,
+        *,
+        policy_id: Optional[str] = None,
+        qos: Optional[str] = None,
+        tenant: Optional[str] = None,
     ) -> Future:
         req_id, fut = self._register()
         if self._fail_if_dead(req_id, fut):
             return fut
         deadline_us = int(deadline_ms * 1e3) if deadline_ms else 0
+        policy_id = policy_id if policy_id is not None else self.policy_id
+        qos = qos if qos is not None else self.qos
+        tenant = tenant if tenant is not None else self.tenant
+        if policy_id is None and qos is None and not tenant:
+            # pure v1 request: byte-identical to the PR-8 client's frame
+            msg_type = protocol.ACT
+            payload = protocol.encode_act(obs, deadline_us)
+        else:
+            msg_type = protocol.ACT2
+            payload = protocol.encode_act2(
+                obs, deadline_us,
+                policy_id=policy_id or protocol.DEFAULT_POLICY,
+                qos=(
+                    protocol.QOS_BULK if qos == "bulk"
+                    else protocol.QOS_INTERACTIVE
+                ),
+                tenant=tenant,
+            )
         try:
-            self._send(protocol.ACT, req_id, protocol.encode_act(obs, deadline_us))
+            self._send(msg_type, req_id, payload)
         except OSError as e:
             with self._pending_lock:
                 self._pending.pop(req_id, None)
@@ -238,13 +276,19 @@ class PolicyClient:
         obs: np.ndarray,
         deadline_ms: Optional[float] = None,
         timeout: Optional[float] = None,
+        *,
+        policy_id: Optional[str] = None,
+        qos: Optional[str] = None,
+        tenant: Optional[str] = None,
     ) -> np.ndarray:
         """One action, blocking. Raises :class:`Overloaded` when shed
         (after the bounded ``retries=`` budget, when one was configured —
-        a dead link is re-dialed between attempts)."""
+        a dead link is re-dialed between attempts). ``policy_id`` /
+        ``qos`` / ``tenant`` override the client-level defaults per call."""
         timeout = timeout if timeout is not None else self.timeout
+        kw = dict(policy_id=policy_id, qos=qos, tenant=tenant)
         if not self._retries:
-            return self.act_async(obs, deadline_ms).result(timeout)
+            return self.act_async(obs, deadline_ms, **kw).result(timeout)
         last: Optional[Exception] = None
         backoff = Backoff(
             base_s=0.05,
@@ -260,7 +304,7 @@ class PolicyClient:
                     last = ConnectionClosed(f"reconnect failed: {e}")
                     continue
             try:
-                return self.act_async(obs, deadline_ms).result(timeout)
+                return self.act_async(obs, deadline_ms, **kw).result(timeout)
             except (Overloaded, ConnectionClosed) as e:
                 last = e  # bounded: the Backoff iterator sleeps, then stops
         assert last is not None

@@ -14,10 +14,30 @@ Frame layout::
     length   u32  payload byte count (<= MAX_PAYLOAD)
     payload  bytes
 
+Frame versioning (the multi-tenant compat contract): every frame carries
+the LOWEST version that can express its type — the v1 sublanguage is
+byte-for-byte what PR-8-era peers speak, so an old client against this
+code sees identical reply bytes, and this code's probes/plain-ACT traffic
+work against old servers unchanged. Only ``ACT2`` (policy-id routing)
+needs version 2; an old server's ``read_frame`` rejects the version byte
+with a clear ``protocol version 2 (this server speaks 1)`` ERROR reply —
+a new client fails loudly, never with a decode crash. ``read_frame`` here
+accepts every version in ``SUPPORTED_VERSIONS``.
+
 Message types and payloads:
 
 - ``ACT``          → ``u32 deadline_us`` (0 = none, relative to arrival)
-                     followed by ``obs_dim`` float32s.
+                     followed by ``obs_dim`` float32s. v1: no policy id —
+                     a server holding N policies serves it the DEFAULT
+                     policy (old clients negotiate down implicitly).
+- ``ACT2``         → ``u8 qos  u8 policy_len  u8 tenant_len  u8 reserved
+                     u32 deadline_us`` + policy_id utf-8 + tenant utf-8 +
+                     obs float32s. The multi-tenant request frame:
+                     ``policy_id`` routes to a resident bundle, ``qos``
+                     (0 = interactive, 1 = bulk) and ``tenant`` feed the
+                     router's class-aware shed + per-tenant quotas.
+                     Unknown policy → per-request ``ERROR`` reply (the
+                     frame is well-formed; the connection survives).
 - ``ACT_OK``       ← ``action_dim`` float32s.
 - ``OVERLOADED``   ← utf-8 reason (``queue_full`` | ``deadline`` |
                      ``draining``). The request was SHED, not failed: the
@@ -56,7 +76,11 @@ from typing import Optional, Tuple
 import numpy as np
 
 MAGIC = b"D4"
-PROTOCOL_VERSION = 1
+# Highest version this code speaks; frames go out at the lowest version
+# that can carry their type (``_frame_version``) so the v1 sublanguage
+# stays byte-identical to PR-8-era peers in both directions.
+PROTOCOL_VERSION = 2
+SUPPORTED_VERSIONS = (1, 2)
 # Generous for observation vectors (a 348-dim Humanoid obs is ~1.4 KB;
 # even a flattened 96×96×4 pixel obs is ~147 KB) while bounding what a
 # malicious/buggy client can make the server buffer per frame.
@@ -64,6 +88,7 @@ MAX_PAYLOAD = 1 << 20
 
 HEADER = struct.Struct("<2sBBII")
 _DEADLINE = struct.Struct("<I")
+_ACT2_HEAD = struct.Struct("<BBBBI")  # qos, policy_len, tenant_len, rsvd, deadline
 
 # message types (one id space across serving AND fleet ingest: the framing
 # layer is shared, so a frame routed at the wrong port fails loudly on type)
@@ -77,6 +102,20 @@ HELLO = 7         # fleet actor handshake (d4pg_tpu/fleet/wire.py)
 HELLO_OK = 8
 WINDOWS = 9       # batch of complete n-step windows
 WINDOWS_OK = 10
+ACT2 = 11         # versioned multi-tenant request: policy_id + QoS + tenant
+
+# QoS classes carried in the ACT2 frame. Interactive is the protected
+# tier (the router sheds bulk FIRST under overload — docs/serving.md);
+# bulk is the best-effort batch tier.
+QOS_INTERACTIVE = 0
+QOS_BULK = 1
+QOS_NAMES = {QOS_INTERACTIVE: "interactive", QOS_BULK: "bulk"}
+
+# Per-type frame-version floor: a type absent here rides version 1 (the
+# PR-8 wire language). ``write_frame`` applies it, so call sites never
+# choose a version — interop with old peers is automatic for old types,
+# and new types fail loudly on old peers with a version error.
+_FRAME_MIN_VERSION = {ACT2: 2}
 
 
 class ProtocolError(Exception):
@@ -158,16 +197,23 @@ def recv_exact(stream, n: int) -> Optional[bytes]:
 
 def read_frame(stream) -> Optional[Tuple[int, int, bytes]]:
     """One ``(msg_type, req_id, payload)`` frame from a socket or buffered
-    file; None on clean EOF."""
+    file; None on clean EOF. Accepts every version in
+    ``SUPPORTED_VERSIONS`` — the version byte gates frame-level features
+    (``ACT2`` rides v2), not the connection."""
     hdr = recv_exact(stream, HEADER.size)
     if hdr is None:
         return None
     magic, version, msg_type, req_id, length = HEADER.unpack(hdr)
     if magic != MAGIC:
         raise ProtocolError(f"bad magic {magic!r}")
-    if version != PROTOCOL_VERSION:
+    if version not in SUPPORTED_VERSIONS:
+        # Wording matters: this exact string is an old peer's loud answer
+        # to a too-new frame (the compat regression pins it) — keep the
+        # "protocol version" prefix so clients can tell a version skew
+        # from a framing bug.
         raise ProtocolError(
-            f"protocol version {version} (this server speaks {PROTOCOL_VERSION})"
+            f"protocol version {version} (this server speaks "
+            f"{PROTOCOL_VERSION})"
         )
     if length > MAX_PAYLOAD:
         raise ProtocolError(f"payload length {length} > max {MAX_PAYLOAD}")
@@ -186,8 +232,18 @@ def write_frame(sock, msg_type: int, req_id: int, payload: bytes = b"") -> None:
     # writer on the same socket (replies come from batcher callbacks, the
     # healthz reply from the reader thread) can never interleave a frame —
     # callers still hold a per-connection send lock for ordering.
+    # The version byte is the TYPE's floor (v1 unless the type needs v2):
+    # replies to an old client are byte-identical to PR-8's, and only a
+    # frame that actually uses v2 features can trip an old peer's
+    # version check.
     sock.sendall(
-        HEADER.pack(MAGIC, PROTOCOL_VERSION, msg_type, req_id, len(payload))
+        HEADER.pack(
+            MAGIC,
+            _FRAME_MIN_VERSION.get(msg_type, 1),
+            msg_type,
+            req_id,
+            len(payload),
+        )
         + payload
     )
 
@@ -210,6 +266,69 @@ def decode_act(payload: bytes, obs_dim: int) -> Tuple[np.ndarray, int]:
     (deadline_us,) = _DEADLINE.unpack_from(payload)
     obs = np.frombuffer(payload, np.float32, offset=_DEADLINE.size).copy()
     return obs, deadline_us
+
+
+DEFAULT_POLICY = "default"
+
+
+def encode_act2(
+    obs: np.ndarray,
+    deadline_us: int = 0,
+    *,
+    policy_id: str = DEFAULT_POLICY,
+    qos: int = QOS_INTERACTIVE,
+    tenant: str = "",
+) -> bytes:
+    """The v2 multi-tenant request payload (see module docstring layout).
+    ``policy_id``/``tenant`` are utf-8, each bounded to 255 bytes by the
+    u8 length fields — plenty for ids, and the bound keeps the decode
+    allocation-free beyond the obs copy."""
+    pid = policy_id.encode("utf-8")
+    ten = tenant.encode("utf-8")
+    if len(pid) > 255:
+        raise ProtocolError(f"policy_id longer than 255 bytes: {policy_id!r}")
+    if len(ten) > 255:
+        raise ProtocolError(f"tenant longer than 255 bytes: {tenant!r}")
+    if qos not in QOS_NAMES:
+        raise ProtocolError(f"unknown qos class {qos!r}")
+    obs = np.ascontiguousarray(obs, dtype=np.float32)
+    return (
+        _ACT2_HEAD.pack(qos, len(pid), len(ten), 0, int(deadline_us))
+        + pid
+        + ten
+        + obs.tobytes()
+    )
+
+
+def decode_act2(payload: bytes) -> Tuple[np.ndarray, int, str, int, str]:
+    """Returns ``(obs f32, deadline_us, policy_id, qos, tenant)``. The obs
+    length is self-described (total minus headers) — the SERVER validates
+    it against the routed policy's obs_dim and answers a per-request
+    ``ERROR`` on mismatch, because unlike v1 ``ACT`` the framing here is
+    intact either way."""
+    if len(payload) < _ACT2_HEAD.size:
+        raise ProtocolError(
+            f"ACT2 payload is {len(payload)} bytes, header needs "
+            f"{_ACT2_HEAD.size}"
+        )
+    qos, plen, tlen, _rsvd, deadline_us = _ACT2_HEAD.unpack_from(payload)
+    if qos not in QOS_NAMES:
+        raise ProtocolError(f"unknown qos class {qos}")
+    off = _ACT2_HEAD.size
+    if len(payload) < off + plen + tlen:
+        raise ProtocolError(
+            f"ACT2 payload is {len(payload)} bytes, ids declare "
+            f"{off + plen + tlen}"
+        )
+    policy_id = payload[off:off + plen].decode("utf-8", "replace")
+    tenant = payload[off + plen:off + plen + tlen].decode("utf-8", "replace")
+    obs_off = off + plen + tlen
+    if (len(payload) - obs_off) % 4:
+        raise ProtocolError(
+            f"ACT2 obs bytes ({len(payload) - obs_off}) not float32"
+        )
+    obs = np.frombuffer(payload, np.float32, offset=obs_off).copy()
+    return obs, deadline_us, policy_id or DEFAULT_POLICY, qos, tenant
 
 
 def encode_action(action: np.ndarray) -> bytes:

@@ -43,6 +43,35 @@ Three jobs:
   and RE-EJECT the canaries until their healthz attests the old version
   again. Every decision is a structured ``[router-event]`` JSON line.
 
+The multi-tenant tier (ISSUE 12) adds three admission/routing axes on
+top:
+
+- **Policy-id routing** — an ``ACT2`` request names a resident policy;
+  dispatch is restricted to replicas hosting it (learned from each
+  replica's healthz ``policies`` rows), and the canary machinery runs
+  ONE rollout state machine PER policy (``--canary-bundle policy=dir``,
+  repeatable): a rollout for policy A never touches policy B's replicas,
+  bundle dirs, or traffic split. v1 ``ACT`` requests negotiate down to
+  the default policy.
+
+- **QoS classes + per-tenant quotas** — every request carries a class
+  (interactive | bulk) and a tenant id; admission runs BEFORE dispatch:
+  first the tenant's token bucket (``--tenant-quota``/``--default-quota``,
+  shed reason ``quota``), then the class-aware capacity check
+  (``--replica-capacity`` × admitted replicas): bulk is admitted only
+  up to ``--bulk-fraction`` of fleet capacity (shed reason
+  ``bulk_capacity``) so under overload the bulk tier sheds FIRST and
+  interactive p99 stays inside its SLO; interactive sheds only at full
+  capacity (``capacity``). The accounting identity generalizes: answered
+  == ok + overloaded + error, exact in aggregate AND per (tenant, class)
+  on the healthz ``tenants`` rows.
+
+- **Elastic capacity** — ``add_backend``/``remove_backend`` let the
+  autoscaler (``serve/autoscaler.py``) grow and drain the fleet at
+  runtime; a replica removed mid-rollout is handled by the rollout
+  state machine (abort → restore every touched bundle dir), never left
+  half-deployed.
+
 The router is a HOST-ONLY module (d4pglint manifest): it moves bytes and
 stats files, never tensors — the one numpy touch is decoding the obs to
 re-encode it for the backend link. Deliberately no JAX import anywhere
@@ -75,6 +104,8 @@ import time
 from collections import deque
 from typing import Optional
 
+import numpy as np
+
 from d4pg_tpu.serve import protocol
 from d4pg_tpu.serve.client import ConnectionClosed, Overloaded, PolicyClient
 from d4pg_tpu.serve.protocol import ProtocolError
@@ -97,10 +128,47 @@ def _bundle_json_mtime(bundle_dir: str) -> Optional[float]:
         return None
 
 
+# Per-(tenant, class) accounting rows are bounded: past this many distinct
+# tenants new ones aggregate into "__other__" (the identity stays exact —
+# the overflow row is still a row) so a tenant-id flood cannot grow router
+# memory without bound.
+MAX_TENANT_ROWS = 512
+
+
+class TokenBucket:
+    """Per-tenant admission quota: ``rate`` tokens/s refill up to
+    ``burst``. No lock of its own — every touch happens under the ROUTER
+    lock on the admission path (one lock hop per request, same discipline
+    as the dispatch bookkeeping), and no allocation per take (the quota
+    check is in HOT_PATH_FUNCTIONS)."""
+
+    __slots__ = ("rate", "burst", "tokens", "t_last")
+
+    def __init__(self, rate: float, burst: float, now: float):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self.tokens = float(burst)
+        self.t_last = now
+
+    def take(self, now: float) -> bool:
+        tokens = self.tokens + (now - self.t_last) * self.rate
+        if tokens > self.burst:
+            tokens = self.burst
+        self.t_last = now
+        if tokens < 1.0:
+            self.tokens = tokens
+            return False
+        self.tokens = tokens - 1.0
+        return True
+
+
 class RouterStats:
     """Router-level counters + client-observed latency. One lock, O(1)
     per request; the identity surface is replies_ok + replies_overloaded
-    + replies_error == answered requests."""
+    + replies_error == answered requests — in aggregate, and per
+    (tenant, QoS class) on the bounded ``tenants`` rows. Latency is also
+    kept per class: the isolation claim ("a flooding bulk tenant cannot
+    move interactive p99") needs the interactive reservoir separable."""
 
     def __init__(self):
         self._lock = lockwitness.named_lock("RouterStats._lock")
@@ -116,11 +184,64 @@ class RouterStats:
         self.protocol_errors = 0
         self.canary_rollbacks = 0
         self.canary_promotions = 0
+        # admission sheds (each also counted in replies_overloaded — they
+        # ARE overloaded answers; these break the reason down)
+        self.shed_quota = 0
+        self.shed_bulk_capacity = 0
+        self.shed_capacity = 0
         self.latency = LatencyReservoir()
+        self.latency_interactive = LatencyReservoir()
+        self.latency_bulk = LatencyReservoir()
+        # (tenant, qos) -> [requests, ok, overloaded, error]
+        self._tenants: dict = {}
 
     def inc(self, field: str, by: int = 1) -> None:
         with self._lock:
             setattr(self, field, getattr(self, field) + by)
+
+    def _tenant_row(self, tenant: str, qos: int) -> list:
+        """Caller holds ``self._lock``."""
+        key = (tenant, qos)
+        row = self._tenants.get(key)
+        if row is None:
+            if len(self._tenants) >= MAX_TENANT_ROWS:
+                key = ("__other__", qos)
+                row = self._tenants.get(key)
+                if row is None:
+                    row = self._tenants[key] = [0, 0, 0, 0]
+            else:
+                row = self._tenants[key] = [0, 0, 0, 0]
+        return row
+
+    def tenant_request(self, tenant: str, qos: int) -> None:
+        with self._lock:
+            self._tenant_row(tenant, qos)[0] += 1
+
+    def tenant_outcome(self, tenant: str, qos: int, outcome: int) -> None:
+        """``outcome``: 1 = ok, 2 = overloaded, 3 = error (row offsets)."""
+        with self._lock:
+            self._tenant_row(tenant, qos)[outcome] += 1
+
+    def add_latency(self, seconds: float, qos: int) -> None:
+        self.latency.add(seconds)
+        (self.latency_bulk if qos else self.latency_interactive).add(seconds)
+
+    def tenants_snapshot(self) -> dict:
+        """``"tenant/class" -> {requests, ok, overloaded, error, answered}``
+        rows; the per-row identity (requests == answered at quiesce) is
+        the machine-checked multi-tenant accounting surface."""
+        with self._lock:
+            items = list(self._tenants.items())
+        out = {}
+        for (tenant, qos), row in sorted(items):
+            out[f"{tenant}/{protocol.QOS_NAMES.get(qos, qos)}"] = {
+                "requests": row[0],
+                "ok": row[1],
+                "overloaded": row[2],
+                "error": row[3],
+                "answered": row[1] + row[2] + row[3],
+            }
+        return out
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -137,11 +258,16 @@ class RouterStats:
                 "protocol_errors": self.protocol_errors,
                 "canary_rollbacks": self.canary_rollbacks,
                 "canary_promotions": self.canary_promotions,
+                "shed_quota": self.shed_quota,
+                "shed_bulk_capacity": self.shed_bulk_capacity,
+                "shed_capacity": self.shed_capacity,
             }
         out["answered_total"] = (
             out["replies_ok"] + out["replies_overloaded"] + out["replies_error"]
         )
         out.update(self.latency.percentiles_ms())
+        out["interactive"] = self.latency_interactive.percentiles_ms()
+        out["bulk"] = self.latency_bulk.percentiles_ms()
         return out
 
 
@@ -155,11 +281,19 @@ class Replica:
     """
 
     def __init__(self, index: int, host: str, port: int,
-                 bundle_dir: Optional[str] = None):
+                 bundle_dirs=None):
         self.index = index
         self.host = host
         self.port = port
-        self.bundle_dir = bundle_dir      # None = canary cannot target it
+        # policy id -> this replica's live bundle dir for that policy
+        # ({} = canary rollouts cannot target it). A bare str means the
+        # default policy (the PR-8 single-policy calling convention).
+        if bundle_dirs is None:
+            self.bundle_dirs: dict = {}
+        elif isinstance(bundle_dirs, str):
+            self.bundle_dirs = {protocol.DEFAULT_POLICY: bundle_dirs}
+        else:
+            self.bundle_dirs = dict(bundle_dirs)
         self.client: Optional[PolicyClient] = None  # dispatch link
         self.inflight = 0                 # router-side, not healthz
         self.admitted = False
@@ -167,8 +301,19 @@ class Replica:
         self.healthy_streak = 0
         self.health: dict = {}            # last successful probe snapshot
         self.pid: Optional[int] = None
-        self.bundle_mtime: Optional[float] = None
-        self.canary = False
+        self.bundle_mtime: Optional[float] = None   # default policy's vector
+        # per-policy serving-version vectors from the healthz ``policies``
+        # rows (an old single-policy replica reports only the top-level
+        # bundle_mtime — mapped to the default policy)
+        self.policy_mtimes: dict = {}
+        # policies this replica HOSTS (healthz-learned); dispatch for a
+        # policy only considers replicas hosting it
+        self.policies: tuple = (protocol.DEFAULT_POLICY,)
+        self.canary_for: set = set()      # policies it is canary for
+        # Scale-down lifecycle: a removed replica stays in the list (index
+        # stability — rollout state and events reference indices) but is
+        # invisible to dispatch, probing, and capacity.
+        self.removed = False
         self.ok = 0                       # lifetime final outcomes served
         self.errors = 0
         # Dispatch-progress watermark: refreshed when inflight leaves 0 at
@@ -183,24 +328,65 @@ class Replica:
         return f"{self.host}:{self.port}"
 
 
+class _Rollout:
+    """Per-policy canary rollout state. The control thread is the only
+    writer (the state machine runs there); ``state`` and the traffic
+    counters are additionally written/read under the router lock because
+    ``_pick`` routes on them. One instance per ``--canary-bundle``
+    policy=dir spec — rollouts for different policies advance
+    independently and never touch each other's replicas or traffic.
+
+    d4pglint shared-mutable-state: control-thread-only fields (the
+    PR-8 single-rollout contract, now per instance); readers take atomic
+    snapshots and tolerate one-tick staleness."""
+
+    _THREAD_SAFE = (
+        "seen_mtime", "version", "deadline", "rollback_deadline",
+        "deploys", "promote_done", "rollback_dir", "backed_up", "state",
+    )
+
+    def __init__(self, policy: str, src_dir: str, window: int):
+        self.policy = policy
+        self.src_dir = src_dir
+        self.state = "idle"  # idle|deploying|observing|promoting|rolling_back
+        self.seen_mtime: Optional[float] = None
+        self.version: Optional[float] = None
+        self.deadline: Optional[float] = None
+        self.rollback_deadline: Optional[float] = None
+        self.deploys: dict = {}       # replica index -> awaited json mtime
+        self.promote_done: set = set()
+        self.rollback_dir: Optional[str] = None
+        self.backed_up: set = set()
+        # per-rollout stripe counter (under the router lock): the
+        # Bresenham fraction must be exact over THIS policy's requests,
+        # not the global sequence mixed across policies
+        self.seq = 0
+        self.windows = {
+            "baseline": deque(maxlen=int(window)),
+            "canary": deque(maxlen=int(window)),
+        }
+
+    def snapshot_row(self, permille: int) -> dict:
+        return {
+            "policy": self.policy,
+            "state": self.state,
+            "fraction": permille / 1000.0,
+            "version": self.version,
+            "window_baseline": len(self.windows["baseline"]),
+            "window_canary": len(self.windows["canary"]),
+        }
+
+
 class Router:
     """The replicated front-end. ``start()`` binds and spawns the accept /
     control threads; ``drain()`` is the graceful stop (answer in-flight,
     shed new with ``draining``)."""
 
-    # d4pglint shared-mutable-state: written by exactly one thread each,
-    # read as atomic snapshots —
-    #   _canary_* cursor fields: control thread only (the state machine
-    #   runs there); _canary_state itself is written under _lock because
-    #   _pick routes on it;
-    #   _rollback_dir/_backed_up: control thread only (file staging);
-    #   _obs_dim is also written under _lock (prober) after the first
-    #   successful probe and only ever goes None -> int.
-    _THREAD_SAFE = (
-        "_canary_seen_mtime", "_canary_version", "_canary_deadline",
-        "_rollback_deadline", "_deploys", "_promote_done",
-        "_rollback_dir", "_backed_up",
-    )
+    # d4pglint shared-mutable-state: per-rollout cursor state moved onto
+    # _Rollout (control thread only — declared there); _obs_dim is
+    # written under _lock (prober) after the first successful probe and
+    # only ever goes None -> int; _obs_dims entries likewise.
+    _THREAD_SAFE = ()
     # d4pglint thread-lifecycle: per-connection reader threads are not
     # joined — drain() closes every socket in _conns, which unblocks the
     # blocking read_frame immediately (same contract as PolicyServer).
@@ -231,6 +417,11 @@ class Router:
         log_dir: Optional[str] = None,
         metrics_interval_s: float = 30.0,
         chaos=None,
+        tenant_quotas=None,
+        default_quota=None,
+        replica_capacity: int = 0,
+        bulk_fraction: float = 0.5,
+        flood_burst: int = 200,
     ):
         if not backends:
             raise ValueError("router needs at least one backend replica")
@@ -248,13 +439,6 @@ class Router:
                 h, _, p = str(spec).rpartition(":")
             self._replicas.append(Replica(i, h or "127.0.0.1", int(p),
                                           bundle_dirs[i]))
-        if canary_bundle is not None and not any(
-            r.bundle_dir for r in self._replicas
-        ):
-            raise ValueError(
-                "--canary-bundle needs --backend-bundles: the router rolls "
-                "a replica forward by writing into ITS bundle directory"
-            )
         self.host = host
         self._requested_port = port
         self.port: Optional[int] = None
@@ -263,6 +447,9 @@ class Router:
         self._lock = lockwitness.named_lock("Router._lock")
         self._seq = 0
         self._obs_dim: Optional[int] = None
+        # policy -> obs_dim learned from replica healthz ``policies`` rows
+        # (the default policy also mirrors into _obs_dim for the v1 path)
+        self._obs_dims: dict = {}
 
         self._probe_interval_s = float(probe_interval_s)
         self._probe_timeout_s = float(probe_timeout_s)
@@ -274,8 +461,10 @@ class Router:
         # deterministically under --chaos, like every retry in this repo.
         self._retry_rng = random.Random(retry_seed)
 
-        # ---- canary rollout state machine (control thread) ----
-        self._canary_dir = canary_bundle
+        # ---- per-policy canary rollout state machines (control thread) ----
+        # ``canary_bundle``: a bare dir (the PR-8 convention — a rollout
+        # for the DEFAULT policy) or a {policy: dir} mapping; one
+        # _Rollout per entry, fully independent.
         self._canary_permille = int(round(float(canary_fraction) * 1000))
         if canary_bundle is not None and not (
             0 < self._canary_permille < 1000
@@ -287,27 +476,64 @@ class Router:
                 "nothing to the canary, 1 starves the baseline — either "
                 "way the rollout would observe forever)"
             )
-        self._canary_state = "idle"   # idle|deploying|observing|promoting|rolling_back
-        self._canary_seen_mtime: Optional[float] = None
-        self._canary_version: Optional[float] = None
-        self._canary_deadline: Optional[float] = None
-        self._rollback_deadline: Optional[float] = None
+        if canary_bundle is None:
+            canary_specs = {}
+        elif isinstance(canary_bundle, str):
+            canary_specs = {protocol.DEFAULT_POLICY: canary_bundle}
+        else:
+            canary_specs = dict(canary_bundle)
+        self._rollouts: dict = {
+            pol: _Rollout(pol, src, canary_window)
+            for pol, src in sorted(canary_specs.items())
+        }
+        for pol in self._rollouts:
+            if not any(
+                pol in r.bundle_dirs for r in self._replicas
+            ):
+                raise ValueError(
+                    f"--canary-bundle for policy {pol!r} needs "
+                    "--backend-bundles hosting that policy: the router "
+                    "rolls a replica forward by writing into ITS bundle "
+                    "directory for the policy"
+                )
         self._attest_timeout_s = float(canary_attest_timeout_s)
         self._observe_timeout_s = float(canary_observe_timeout_s)
         self._min_samples = int(canary_min_samples)
         self._max_err_increase = float(canary_max_err_increase)
         self._p99_ratio = float(canary_p99_ratio)
-        self._deploys: dict = {}        # replica index -> awaited json mtime
-        self._promote_done: set = set()
-        self._rollback_dir: Optional[str] = None
-        self._backed_up: set = set()
-        # replica index -> bundle_mtime it must attest before probes count
-        # as healthy again (the re-eject-until-old-bundle rollback contract)
+        # (replica index, policy) -> bundle_mtime it must attest before
+        # probes count as healthy again (the re-eject-until-old-bundle
+        # rollback contract, per policy)
         self._readmit_gate: dict = {}
-        self._windows = {
-            "baseline": deque(maxlen=int(canary_window)),
-            "canary": deque(maxlen=int(canary_window)),
+
+        # ---- QoS + per-tenant admission (the multi-tenant tier) ----
+        # tenant -> TokenBucket, built from the configured quotas and
+        # lazily for unknown tenants under the default quota; everything
+        # guarded by self._lock (one hop per request on the hot path).
+        now = time.monotonic()
+        self._tenant_buckets: dict = {}
+        self._tenant_quota_conf = {
+            str(t): (float(r), float(b))
+            for t, (r, b) in (tenant_quotas or {}).items()
         }
+        for t, (rate, burst) in self._tenant_quota_conf.items():
+            self._tenant_buckets[t] = TokenBucket(rate, burst, now)
+        self._default_quota = (
+            (float(default_quota[0]), float(default_quota[1]))
+            if default_quota else None
+        )
+        # Class-aware capacity: fleet capacity = admitted replicas ×
+        # replica_capacity; bulk is admitted only below bulk_fraction of
+        # it, interactive up to all of it — so overload sheds bulk FIRST.
+        # replica_capacity 0 disables the class-aware admission tier
+        # (quotas still apply), which is the PR-8 behavior.
+        self._replica_capacity = int(replica_capacity)
+        if not (0.0 < float(bulk_fraction) <= 1.0):
+            raise ValueError(
+                f"bulk_fraction must be in (0, 1], got {bulk_fraction}"
+            )
+        self._bulk_fraction = float(bulk_fraction)
+        self._flood_burst = int(flood_burst)
 
         self._events: deque = deque(maxlen=1000)
         self._events_total = 0
@@ -373,6 +599,70 @@ class Router:
     def request_shutdown(self) -> None:
         """Signal-handler-safe: set the event; drain happens on the waiter."""
         self._shutdown.set()
+
+    # ------------------------------------------------- elastic fleet (autoscaler)
+    def add_backend(self, host: str, port: int, bundle_dirs=None) -> int:
+        """Register a new replica at runtime (the autoscaler's scale-up
+        seam). Returns its index. The replica starts un-admitted —
+        admission flows through the normal K-consecutive-healthy-probes
+        path, so a half-started process never takes traffic."""
+        with self._lock:
+            idx = len(self._replicas)
+            r = Replica(idx, host, int(port), bundle_dirs)
+            self._replicas.append(r)
+        self._record_event("backend_added", replica=idx, addr=r.addr)
+        return idx
+
+    def remove_backend(self, index: int) -> None:
+        """Deregister a replica (the autoscaler's scale-down seam, called
+        BEFORE the SIGTERM so no new request lands on a draining process
+        and sheds). Ejection closes the dispatch link — in-flight
+        dispatches fail over via the bounded retry; the replica still
+        answers what it had admitted. The replica keeps its index slot
+        (rollout state and events reference indices) but becomes
+        invisible to dispatch, probing, and capacity. If an active
+        rollout touched it, the rollout's own control tick aborts via the
+        normal rollback — which restores every touched bundle dir, so a
+        scale-down can never strand a half-deployed replica."""
+        with self._lock:
+            r = self._replicas[index]
+            if r.removed:
+                return
+            r.removed = True
+            # a removed replica can never attest a restored bundle: any
+            # readmit gate on it (a rollback that raced the drain) is
+            # dead weight — drop it so no rollout waits on a ghost
+            for key in [k for k in self._readmit_gate if k[0] == index]:
+                del self._readmit_gate[key]
+            to_close = self._eject_locked(r, "removed (scale-down)") \
+                if r.admitted else None
+        if to_close is not None:
+            try:
+                to_close.close()
+            except OSError:
+                pass
+        self._record_event("backend_removed", replica=index, addr=r.addr)
+
+    def pick_scaledown_candidate(self) -> Optional[int]:
+        """The replica an autoscaler should drain next: prefer one no
+        active rollout touched (draining a canary mid-rollout forces an
+        abort — legal but wasteful), highest index first (LIFO — the
+        autoscaler's own spawns go before the operator's seed fleet).
+        None when nothing is admitted."""
+        with self._lock:
+            in_rollout = set()
+            for ro in self._rollouts.values():
+                if ro.state != "idle":
+                    in_rollout |= set(ro.backed_up) | set(ro.deploys)
+                    in_rollout |= {
+                        r.index for r in self._replicas
+                        if ro.policy in r.canary_for
+                    }
+            pool = [r for r in self._replicas if r.admitted and not r.removed]
+            if not pool:
+                return None
+            clean = [r for r in pool if r.index not in in_rollout]
+            return max(clean or pool, key=lambda r: r.index).index
 
     def serve_until_shutdown(self) -> None:
         # Park-until-signal is the design (same contract as PolicyServer).
@@ -471,7 +761,9 @@ class Router:
         # self-contained one-shot socket, so a thread per replica per
         # round is safe; a wedged probe past the join bound is treated as
         # failed and its daemon thread dies with its socket timeout.
-        results: list = [None] * len(self._replicas)
+        with self._lock:
+            live = [r for r in self._replicas if not r.removed]
+        results: list = [None] * len(live)
 
         def probe_one(i: int, r: Replica) -> None:
             try:
@@ -484,16 +776,16 @@ class Router:
         threads = [
             threading.Thread(
                 target=probe_one, args=(i, r),
-                name=f"router-probe-{i}", daemon=True,
+                name=f"router-probe-{r.index}", daemon=True,
             )
-            for i, r in enumerate(self._replicas)
+            for i, r in enumerate(live)
         ]
         for t in threads:
             t.start()
         deadline = time.monotonic() + self._probe_timeout_s + 2.0
         for t in threads:
             t.join(timeout=max(0.0, deadline - time.monotonic()))
-        for r, res in zip(self._replicas, results):
+        for r, res in zip(live, results):
             if res is None:
                 res = (None, TimeoutError("probe thread did not finish"))
             self._apply_probe(r, res[0], res[1])
@@ -542,8 +834,28 @@ class Router:
                 r.health = h
                 r.pid = h.get("pid")
                 r.bundle_mtime = h.get("bundle_mtime")
+                pol_rows = h.get("policies")
+                if isinstance(pol_rows, dict) and pol_rows:
+                    r.policies = tuple(sorted(pol_rows))
+                    r.policy_mtimes = {
+                        pid: row.get("bundle_mtime")
+                        for pid, row in pol_rows.items()
+                    }
+                    for pid, row in pol_rows.items():
+                        if pid not in self._obs_dims and row.get("obs_dim"):
+                            self._obs_dims[pid] = int(row["obs_dim"])
+                else:
+                    # old single-policy replica: its one bundle IS the
+                    # default policy's
+                    r.policies = (protocol.DEFAULT_POLICY,)
+                    r.policy_mtimes = {
+                        protocol.DEFAULT_POLICY: h.get("bundle_mtime")
+                    }
                 if self._obs_dim is None and h.get("obs_dim"):
                     self._obs_dim = int(h["obs_dim"])
+                    self._obs_dims.setdefault(
+                        protocol.DEFAULT_POLICY, self._obs_dim
+                    )
             if h is None or h.get("status") != "ok":
                 r.healthy_streak = 0
                 if r.admitted:
@@ -553,17 +865,26 @@ class Router:
                     )
                     to_close = self._eject_locked(r, eject_reason)
             else:
-                gate = self._readmit_gate.get(r.index)
-                if gate is not None and r.bundle_mtime != gate:
-                    # rolled-back canary: healthy probes do not count until
-                    # it attests the RESTORED bundle version
+                # rolled-back canary: healthy probes do not count until it
+                # attests the RESTORED bundle version for EVERY gated
+                # policy (gates are per (replica, policy) — a rollback of
+                # policy A never gates on policy B's vector)
+                gates = [
+                    (key, mt) for key, mt in self._readmit_gate.items()
+                    if key[0] == r.index
+                ]
+                unmet = [
+                    key for key, mt in gates
+                    if r.policy_mtimes.get(key[1]) != mt
+                ]
+                if unmet:
                     r.healthy_streak = 0
                 else:
-                    if gate is not None:
-                        del self._readmit_gate[r.index]
+                    for key, _mt in gates:
+                        del self._readmit_gate[key]
                     r.healthy_streak += 1
                     if (
-                        not r.admitted
+                        not r.admitted and not r.removed
                         and r.healthy_streak >= self._readmit_after
                     ):
                         dial = True
@@ -607,7 +928,10 @@ class Router:
             return
         stale = None
         with self._lock:
-            if r.admitted or self._shutdown.is_set():
+            # r.removed: a probe round snapshotted before remove_backend
+            # may still be applying — a removed replica must never
+            # re-admit (its process is drained/gone)
+            if r.admitted or r.removed or self._shutdown.is_set():
                 stale = client
             else:
                 r.client = client
@@ -625,34 +949,45 @@ class Router:
                            streak=r.healthy_streak)
 
     # -------------------------------------------------------------- dispatch
-    def _pick(self, exclude):
-        """Least-loaded admitted replica (ties → lowest index), honoring
-        the deterministic canary traffic split while a rollout is
-        observing. Returns ``(replica, client)`` or ``(None, None)`` —
-        the all-ejected case the router answers OVERLOADED itself."""
+    def _pick(self, exclude, policy: str):
+        """Least-loaded admitted replica HOSTING ``policy`` (ties →
+        lowest index), honoring that policy's deterministic canary
+        traffic split while ITS rollout is observing. Returns
+        ``(replica, client)`` or ``(None, None)`` — the all-ejected case
+        the router answers OVERLOADED itself."""
         with self._lock:
             self._seq += 1
             seq = self._seq
             pool = [
                 r for r in self._replicas
                 if r.admitted and r.client is not None
+                and not r.removed
                 and r.index not in exclude
+                and policy in r.policies
             ]
             if not pool:
                 return None, None
-            if self._canary_state == "observing" and self._canary_permille:
-                # Bresenham-style striping: request i is canary iff
-                # (i·permille) mod 1000 < permille — the fraction is exact
-                # over any 1000-request window AND interleaved, so both
+            ro = self._rollouts.get(policy)
+            if (
+                ro is not None and ro.state == "observing"
+                and self._canary_permille
+            ):
+                # Bresenham-style striping on THIS policy's own request
+                # counter: request i is canary iff (i·permille) mod 1000 <
+                # permille — the fraction is exact over any 1000-request
+                # window of this policy's traffic AND interleaved, so both
                 # comparison windows fill together (seq%1000 < permille
                 # would send a contiguous block of 1000·fraction requests
                 # to the canary first, starving the baseline window).
+                ro.seq += 1
                 want_canary = (
-                    seq * self._canary_permille
+                    ro.seq * self._canary_permille
                 ) % 1000 < self._canary_permille
-                group = [r for r in pool if r.canary == want_canary] or pool
+                group = [
+                    r for r in pool if (policy in r.canary_for) == want_canary
+                ] or pool
             else:
-                group = [r for r in pool if not r.canary] or pool
+                group = [r for r in pool if policy not in r.canary_for] or pool
             # least-loaded wins; ties rotate with the dispatch counter so
             # sequential (inflight-0) traffic round-robins instead of
             # pinning the lowest index
@@ -671,13 +1006,76 @@ class Router:
             best.inflight += 1
             return best, best.client
 
-    def _route(self, obs, deadline_us: int, req_id: int, reply) -> None:
+    def _admit_tenant(self, tenant: str, qos: int) -> Optional[bytes]:
+        """Admission control, BEFORE dispatch: the tenant's token bucket,
+        then the class-aware capacity check. Returns the shed reason
+        (wire bytes) or None when admitted. One lock hop, no allocation
+        per request (HOT_PATH_FUNCTIONS) — the lazy bucket creation for a
+        never-seen tenant is the one cold-path exception.
+
+        The shed ORDERING contract (docs/serving.md): fleet capacity is
+        admitted-replicas × replica_capacity; bulk is admitted only while
+        total inflight is under bulk_fraction × capacity, interactive up
+        to full capacity — so under overload the bulk tier sheds FIRST
+        and the interactive tier keeps its p99 inside the SLO."""
+        now = time.monotonic()
+        with self._lock:
+            bucket = self._tenant_buckets.get(tenant)
+            if bucket is None and self._default_quota is not None:
+                if len(self._tenant_buckets) >= MAX_TENANT_ROWS:
+                    bucket = self._tenant_buckets.get("__other__")
+                    if bucket is None:
+                        bucket = self._tenant_buckets["__other__"] = (
+                            TokenBucket(*self._default_quota, now)
+                        )
+                else:
+                    bucket = self._tenant_buckets[tenant] = TokenBucket(
+                        *self._default_quota, now
+                    )
+            if bucket is not None and not bucket.take(now):
+                # stats.inc nests RouterStats._lock under Router._lock —
+                # the same order _eject_locked already established
+                self.stats.inc("shed_quota")
+                return b"quota"
+            if self._replica_capacity:
+                admitted = 0
+                inflight = 0
+                for r in self._replicas:
+                    if r.admitted and not r.removed:
+                        admitted += 1
+                        inflight += r.inflight
+                capacity = admitted * self._replica_capacity
+                if qos == protocol.QOS_BULK:
+                    if inflight >= int(capacity * self._bulk_fraction):
+                        self.stats.inc("shed_bulk_capacity")
+                        return b"bulk_capacity"
+                elif inflight >= capacity:
+                    self.stats.inc("shed_capacity")
+                    return b"capacity"
+        return None
+
+    def _route(
+        self,
+        obs,
+        deadline_us: int,
+        req_id: int,
+        reply,
+        policy: str = protocol.DEFAULT_POLICY,
+        qos: int = protocol.QOS_INTERACTIVE,
+        tenant: str = "",
+    ) -> None:
         """Dispatch one decoded request; ``reply`` is the per-connection
         frame writer. Exactly one reply per request, on every path — the
-        accounting identity depends on it."""
+        accounting identity (aggregate AND per tenant/class) depends on
+        it."""
         t0 = time.perf_counter()
         deadline_ms = deadline_us / 1e3 if deadline_us else None
         state = {"backoff": None, "exclude": []}
+
+        def answered(outcome: int) -> None:
+            # one call per request, on exactly one path — outcome offsets:
+            # 1 = ok, 2 = overloaded, 3 = error (RouterStats row layout)
+            self.stats.tenant_outcome(tenant, qos, outcome)
 
         def attempt():
             remaining_ms = None
@@ -692,11 +1090,13 @@ class Router:
                 )
                 if remaining_ms <= 0:
                     self.stats.inc("replies_overloaded")
+                    answered(2)
                     reply(protocol.OVERLOADED, req_id, b"deadline")
                     return
-            replica, client = self._pick(state["exclude"])
+            replica, client = self._pick(state["exclude"], policy)
             if replica is None:
                 self.stats.inc("replies_overloaded")
+                answered(2)
                 reply(protocol.OVERLOADED, req_id, b"no_replicas")
                 return
             kill_pid = None
@@ -704,7 +1104,19 @@ class Router:
                 e = self._chaos.tick("replica_kill")
                 if e is not None:
                     kill_pid = replica.pid
-            fut = client.act_async(obs, remaining_ms)
+            # Forward at the lowest frame version the request needs: any
+            # DEFAULT-policy request rides the v1 ACT frame — qos and
+            # tenant are ROUTER-admission concerns the replica discards,
+            # so forwarding them would only break old (v1-only) replicas
+            # behind this router (a v2 frame tears down the shared
+            # pipelined link with a version error, failing every request
+            # in flight on it). Only a non-default policy needs ACT2.
+            fut = client.act_async(
+                obs, remaining_ms,
+                policy_id=(
+                    None if policy == protocol.DEFAULT_POLICY else policy
+                ),
+            )
             if kill_pid:
                 # AFTER the send: the request is on the wire — this is the
                 # mid-stream replica death the failover contract covers.
@@ -720,14 +1132,18 @@ class Router:
                     replica.last_progress = time.monotonic()
                 exc = f.exception()
                 lat = time.perf_counter() - t0
+                ro = self._rollouts.get(policy)
                 if exc is None:
                     with self._lock:
                         replica.ok += 1
-                        self._windows[
-                            "canary" if replica.canary else "baseline"
-                        ].append((True, lat))
+                        if ro is not None:
+                            ro.windows[
+                                "canary" if policy in replica.canary_for
+                                else "baseline"
+                            ].append((True, lat))
                     self.stats.inc("replies_ok")
-                    self.stats.latency.add(lat)
+                    self.stats.add_latency(lat, qos)
+                    answered(1)
                     reply(protocol.ACT_OK, req_id,
                           # inside f's own done-callback: resolved by
                           # definition, result() cannot block
@@ -755,22 +1171,90 @@ class Router:
                         return
                 with self._lock:
                     replica.errors += 1
-                    if not isinstance(exc, Overloaded):
-                        self._windows[
-                            "canary" if replica.canary else "baseline"
+                    if ro is not None and not isinstance(exc, Overloaded):
+                        ro.windows[
+                            "canary" if policy in replica.canary_for
+                            else "baseline"
                         ].append((False, lat))
                 if isinstance(exc, Overloaded):
                     self.stats.inc("replies_overloaded")
+                    answered(2)
                     reply(protocol.OVERLOADED, req_id,
                           str(exc).encode() or b"overloaded")
                 else:
                     self.stats.inc("replies_error")
+                    answered(3)
                     reply(protocol.ERROR, req_id,
                           f"failed after bounded retry: {exc}".encode())
 
             fut.add_done_callback(done)
 
         attempt()
+
+    # -------------------------------------------------- synthetic chaos load
+    @staticmethod
+    def _sink(_msg_type, _req_id, _payload=b"") -> None:
+        """Reply writer for synthetic chaos requests: the outcome counters
+        tally through the normal _route path; there is no socket to
+        answer."""
+
+    def _inject_synthetic(self, policy: str, qos: int, tenant: str,
+                          n: int) -> int:
+        """Push ``n`` synthetic requests for ``policy`` through the REAL
+        admission + dispatch path (counted in requests_total and every
+        per-tenant/class identity row — the identity stays exact because
+        synthetic traffic is accounted exactly like real traffic).
+        Returns how many were injected (0 when the policy's obs_dim is
+        still unknown)."""
+        dim = self._obs_dims.get(policy)
+        if dim is None and policy == protocol.DEFAULT_POLICY:
+            dim = self._obs_dim
+        if dim is None:
+            return 0
+        obs = np.zeros(dim, np.float32)
+        for _ in range(n):
+            self.stats.inc("requests_total")
+            self.stats.tenant_request(tenant, qos)
+            shed = self._admit_tenant(tenant, qos)
+            if shed is not None:
+                self.stats.inc("replies_overloaded")
+                self.stats.tenant_outcome(tenant, qos, 2)
+                continue
+            self._route(obs, 0, 0, self._sink,
+                        policy=policy, qos=qos, tenant=tenant)
+        return n
+
+    def _inject_flood(self, tenant: str, n: int) -> None:
+        """The ``tenant_flood`` chaos site: a burst of BULK-class requests
+        from one named tenant. Under the admission contracts most of it
+        sheds at the tenant's quota / the bulk capacity line — which is
+        the point: the soak asserts interactive p99 holds through it."""
+        self._record_event("chaos_tenant_flood", tenant=tenant, n=n)
+        self._inject_synthetic(
+            protocol.DEFAULT_POLICY, protocol.QOS_BULK, tenant, n
+        )
+
+    def _inject_skew(self, n: int) -> None:
+        """The ``policy_skew`` chaos site: 95% of a synthetic burst hits
+        the default policy, 5% spreads over the other known policies —
+        the cold policies' real traffic must still meet its deadlines
+        (their batchers are independent; the shared resource is the
+        host/device, which is what the soak measures)."""
+        with self._lock:
+            cold = sorted(
+                p for p in self._obs_dims if p != protocol.DEFAULT_POLICY
+            )
+        self._record_event("chaos_policy_skew", n=n, cold_policies=cold)
+        hot = int(n * 0.95) if cold else n
+        self._inject_synthetic(
+            protocol.DEFAULT_POLICY, protocol.QOS_BULK, "skew_tenant", hot
+        )
+        if cold:
+            per = max(1, (n - hot) // len(cold))
+            for p in cold:
+                self._inject_synthetic(
+                    p, protocol.QOS_BULK, "skew_tenant", per
+                )
 
     # ------------------------------------------------------------ client side
     def _accept_loop(self) -> None:
@@ -846,20 +1330,44 @@ class Router:
                     reply(protocol.HEALTHZ_OK, req_id,
                           json.dumps(self.healthz()).encode())
                     continue
-                if msg_type != protocol.ACT:
+                if msg_type == protocol.ACT:
+                    # v1: default policy, interactive class, anonymous
+                    # tenant — old clients negotiate down implicitly
+                    policy = protocol.DEFAULT_POLICY
+                    qos = protocol.QOS_INTERACTIVE
+                    tenant = ""
+                    obs_dim = self._obs_dim
+                    if obs_dim is None:
+                        # no replica has ever answered a probe: obs_dim
+                        # (and the fleet) is unknown — shed honestly
+                        self.stats.inc("requests_total")
+                        self.stats.inc("replies_overloaded")
+                        reply(protocol.OVERLOADED, req_id, b"no_replicas")
+                        continue
+                    obs, deadline_us = protocol.decode_act(payload, obs_dim)
+                elif msg_type == protocol.ACT2:
+                    obs, deadline_us, policy, qos, tenant = (
+                        protocol.decode_act2(payload)
+                    )
+                    known = self._obs_dims.get(policy)
+                    if known is not None and obs.shape[0] != known:
+                        self.stats.inc("requests_total")
+                        self.stats.tenant_request(tenant, qos)
+                        self.stats.inc("replies_error")
+                        self.stats.tenant_outcome(tenant, qos, 3)
+                        reply(
+                            protocol.ERROR, req_id,
+                            f"obs is {obs.shape[0]}-dim, policy "
+                            f"{policy!r} wants {known}".encode(),
+                        )
+                        continue
+                else:
                     raise ProtocolError(f"unexpected message type {msg_type}")
-                obs_dim = self._obs_dim
-                if obs_dim is None:
-                    # no replica has ever answered a probe: obs_dim (and
-                    # the fleet) is unknown — shed honestly
-                    self.stats.inc("requests_total")
-                    self.stats.inc("replies_overloaded")
-                    reply(protocol.OVERLOADED, req_id, b"no_replicas")
-                    continue
-                obs, deadline_us = protocol.decode_act(payload, obs_dim)
                 self.stats.inc("requests_total")
+                self.stats.tenant_request(tenant, qos)
                 if self._shutdown.is_set():
                     self.stats.inc("replies_overloaded")
+                    self.stats.tenant_outcome(tenant, qos, 2)
                     reply(protocol.OVERLOADED, req_id, b"draining")
                     continue
                 if self._chaos is not None:
@@ -871,7 +1379,31 @@ class Router:
                         time.sleep(
                             (e.arg if e.arg is not None else 100.0) / 1e3
                         )
-                self._route(obs, deadline_us, req_id, reply)
+                    e = self._chaos.tick("tenant_flood")
+                    if e is not None:
+                        # synthetic bulk flood from the named tenant: real
+                        # load through the real admission + dispatch path
+                        # (counted in every identity surface) — proves
+                        # interactive isolation under a misbehaving tenant
+                        self._inject_flood(
+                            e.label or "flood_tenant", self._flood_burst
+                        )
+                    e = self._chaos.tick("policy_skew")
+                    if e is not None:
+                        # 95% of a synthetic burst hits the default
+                        # policy; the cold policies' requests ride along
+                        # and must still meet their deadlines
+                        self._inject_skew(self._flood_burst)
+                # admission: quota first, then the class-aware capacity
+                # check — sheds here never reach a replica
+                shed = self._admit_tenant(tenant, qos)
+                if shed is not None:
+                    self.stats.inc("replies_overloaded")
+                    self.stats.tenant_outcome(tenant, qos, 2)
+                    reply(protocol.OVERLOADED, req_id, shed)
+                    continue
+                self._route(obs, deadline_us, req_id, reply,
+                            policy=policy, qos=qos, tenant=tenant)
         except ProtocolError as e:
             self.stats.inc("protocol_errors")
             try:
@@ -897,41 +1429,62 @@ class Router:
 
     # ------------------------------------------------------- canary rollout
     def _canary_step(self) -> None:
-        if self._canary_dir is None:
-            return
-        state = self._canary_state
-        if state == "idle":
-            self._canary_idle()
-        elif state == "deploying":
-            self._canary_check_deploys()
-        elif state == "observing":
-            self._canary_observe()
-        elif state == "promoting":
-            self._canary_promote()
-        elif state == "rolling_back":
-            self._canary_check_rollback()
+        """One control tick for EVERY per-policy rollout. The machines
+        are independent: policy A deploying while policy B observes is
+        normal, and no step of one ever touches another's replicas,
+        bundle dirs, windows, or readmit gates."""
+        for ro in self._rollouts.values():
+            state = ro.state
+            if state == "idle":
+                self._canary_idle(ro)
+            elif state == "deploying":
+                self._canary_check_deploys(ro)
+            elif state == "observing":
+                self._canary_observe(ro)
+            elif state == "promoting":
+                self._canary_promote(ro)
+            elif state == "rolling_back":
+                self._canary_check_rollback(ro)
 
-    def _set_canary_state(self, state: str) -> None:
+    def _set_canary_state(self, ro: _Rollout, state: str) -> None:
         with self._lock:
-            self._canary_state = state
+            ro.state = state
 
-    def _clear_windows(self) -> None:
+    def _clear_windows(self, ro: _Rollout) -> None:
         with self._lock:
-            self._windows["baseline"].clear()
-            self._windows["canary"].clear()
+            ro.windows["baseline"].clear()
+            ro.windows["canary"].clear()
 
-    def _canary_replicas(self):
-        return [r for r in self._replicas if r.canary]
+    def _removed_mid_rollout(self, ro: _Rollout) -> Optional[list]:
+        """Replica indices the rollout touched that were REMOVED
+        (scale-down) — an active rollout must abort rather than wait on a
+        replica that no longer exists, and the abort's restore is what
+        un-strands the removed replica's half-deployed bundle dir."""
+        with self._lock:
+            touched = (
+                set(ro.backed_up)
+                | set(ro.deploys)
+                | {
+                    r.index for r in self._replicas
+                    if ro.policy in r.canary_for
+                }
+            )
+            removed = sorted(
+                i for i in touched if self._replicas[i].removed
+            )
+        return removed or None
 
-    def _canary_idle(self) -> None:
-        m = _bundle_json_mtime(self._canary_dir)
-        if m is None or m == self._canary_seen_mtime:
+    def _canary_idle(self, ro: _Rollout) -> None:
+        m = _bundle_json_mtime(ro.src_dir)
+        if m is None or m == ro.seen_mtime:
             return
         with self._lock:
             eligible = [
-                r for r in self._replicas if r.admitted and r.bundle_dir
+                r for r in self._replicas
+                if r.admitted and not r.removed
+                and ro.policy in r.bundle_dirs
             ]
-            total = len(self._replicas)
+            total = len([r for r in self._replicas if not r.removed])
         if len(eligible) < 2:
             # a canary needs at least one baseline to compare against;
             # keep waiting (the bookmark does NOT advance — the rollout
@@ -941,48 +1494,59 @@ class Router:
                        len(eligible) - 1)
         # deterministic choice: the highest-index eligible replicas
         canaries = sorted(eligible, key=lambda r: -r.index)[:n_canary]
-        self._canary_seen_mtime = m
-        self._canary_version = m
-        self._rollback_dir = tempfile.mkdtemp(prefix="d4pg-router-rollback-")
-        self._backed_up = set()
+        ro.seen_mtime = m
+        ro.version = m
+        ro.rollback_dir = tempfile.mkdtemp(
+            prefix=f"d4pg-router-rollback-{ro.policy}-"
+        )
+        ro.backed_up = set()
         deploys = {}
         try:
             for r in canaries:
-                self._backup_bundle(r)
+                self._backup_bundle(ro, r)
                 corrupt = False
                 if self._chaos is not None:
                     corrupt = self._chaos.tick("canary_corrupt") is not None
                 deploys[r.index] = self._deploy_bundle(
-                    self._canary_dir, r.bundle_dir, corrupt=corrupt
+                    ro.src_dir, r.bundle_dirs[ro.policy], corrupt=corrupt
                 )
         except OSError as e:
             # Mid-deploy I/O failure (ENOSPC, unreadable canary source, a
             # missing replica bundle file): any canary ALREADY rolled
             # forward must not be left serving the new bundle as a phantom
             # baseline. Route through the normal rollback — it restores
-            # every replica in _backed_up and re-ejects until the old
+            # every replica in backed_up and re-ejects until the old
             # version attests; the bookmark stays advanced so a broken
             # rollout is reported once, not retried every probe tick.
-            self._canary_rollback(f"deploy I/O error: {e!r}")
+            self._canary_rollback(ro, f"deploy I/O error: {e!r}")
             return
         with self._lock:
             for r in canaries:
-                r.canary = True
-            self._canary_state = "deploying"
-        self._deploys = deploys
-        self._canary_deadline = time.monotonic() + self._attest_timeout_s
-        self._clear_windows()
+                r.canary_for.add(ro.policy)
+            ro.state = "deploying"
+        ro.deploys = deploys
+        ro.deadline = time.monotonic() + self._attest_timeout_s
+        self._clear_windows(ro)
         self._record_event(
-            "canary_start", version=m,
+            "canary_start", policy=ro.policy, version=m,
             canaries=[r.index for r in canaries],
             fraction=self._canary_permille / 1000.0,
         )
 
-    def _canary_check_deploys(self) -> None:
+    def _canary_check_deploys(self, ro: _Rollout) -> None:
+        removed = self._removed_mid_rollout(ro)
+        if removed:
+            self._canary_rollback(
+                ro, f"replicas {removed} removed (scale-down) mid-deploy"
+            )
+            return
         with self._lock:
-            canaries = [r for r in self._replicas if r.canary]
+            canaries = [
+                r for r in self._replicas if ro.policy in r.canary_for
+            ]
             attested = all(
-                r.bundle_mtime == self._deploys.get(r.index) and r.admitted
+                r.policy_mtimes.get(ro.policy) == ro.deploys.get(r.index)
+                and r.admitted
                 for r in canaries
             )
             failed = [
@@ -990,37 +1554,44 @@ class Router:
                 if not r.admitted or r.health.get("status") == "degraded"
             ]
         if attested:
-            self._set_canary_state("observing")
+            self._set_canary_state(ro, "observing")
             # observing gets its own deadline: every other rollout state
             # is bounded, and a fleet with too little traffic to fill the
             # comparison windows must eventually roll back (frozen canary
             # traffic + a rollout that blocks every newer version forever
             # is worse than retrying later under real load)
-            self._canary_deadline = (
-                time.monotonic() + self._observe_timeout_s
-            )
-            self._clear_windows()
-            self._record_event("canary_observing",
-                               version=self._canary_version)
-        elif failed or time.monotonic() > self._canary_deadline:
+            ro.deadline = time.monotonic() + self._observe_timeout_s
+            self._clear_windows(ro)
+            self._record_event("canary_observing", policy=ro.policy,
+                               version=ro.version)
+        elif failed or time.monotonic() > ro.deadline:
             self._canary_rollback(
+                ro,
                 f"deploy failed on replicas {failed}" if failed
                 else "deploy attestation timed out"
             )
 
-    def _canary_observe(self) -> None:
+    def _canary_observe(self, ro: _Rollout) -> None:
+        removed = self._removed_mid_rollout(ro)
+        if removed:
+            self._canary_rollback(
+                ro,
+                f"replicas {removed} removed (scale-down) mid-observation"
+            )
+            return
         with self._lock:
             dead = [r.index for r in self._replicas
-                    if r.canary and not r.admitted]
-            base = list(self._windows["baseline"])
-            can = list(self._windows["canary"])
+                    if ro.policy in r.canary_for and not r.admitted]
+            base = list(ro.windows["baseline"])
+            can = list(ro.windows["canary"])
         if dead:
-            self._canary_rollback(f"canary replicas {dead} ejected "
+            self._canary_rollback(ro, f"canary replicas {dead} ejected "
                                   "mid-observation")
             return
         if len(base) < self._min_samples or len(can) < self._min_samples:
-            if time.monotonic() > self._canary_deadline:
+            if time.monotonic() > ro.deadline:
                 self._canary_rollback(
+                    ro,
                     f"observation starved: windows never filled "
                     f"({len(base)} baseline / {len(can)} canary of "
                     f"{self._min_samples} required)"
@@ -1039,6 +1610,7 @@ class Router:
         }
         if can_err > base_err + self._max_err_increase:
             self._canary_rollback(
+                ro,
                 f"error-rate regression {can_err:.4f} vs {base_err:.4f}",
                 **verdict,
             )
@@ -1047,6 +1619,7 @@ class Router:
             and can_p99 > base_p99 * self._p99_ratio + 0.010
         ):
             self._canary_rollback(
+                ro,
                 f"p99 regression {_ms(can_p99)} ms vs {_ms(base_p99)} ms",
                 **verdict,
             )
@@ -1055,94 +1628,115 @@ class Router:
             # terminal in _canary_promote), not here at the verdict: a
             # promote that later fails (deploy I/O, attestation timeout)
             # ends in a rollback, and one rollout must never book both
-            self._promote_done = set()
-            self._deploys = {}
-            self._set_canary_state("promoting")
-            self._record_event("canary_promote",
-                               version=self._canary_version, **verdict)
+            ro.promote_done = set()
+            ro.deploys = {}
+            self._set_canary_state(ro, "promoting")
+            self._record_event("canary_promote", policy=ro.policy,
+                               version=ro.version, **verdict)
 
-    def _canary_promote(self) -> None:
+    def _canary_promote(self, ro: _Rollout) -> None:
         """Roll the remaining baselines forward ONE at a time, each
         attested before the next — a bad surprise mid-promote strands one
         replica, not the fleet."""
+        removed = self._removed_mid_rollout(ro)
+        if removed:
+            self._canary_rollback(
+                ro, f"replicas {removed} removed (scale-down) mid-promote"
+            )
+            return
         with self._lock:
             baselines = [r for r in self._replicas
-                         if r.bundle_dir and not r.canary]
-            pending = [r for r in baselines if r.index in self._deploys]
+                         if ro.policy in r.bundle_dirs
+                         and ro.policy not in r.canary_for
+                         and not r.removed]
+            pending = [r for r in baselines if r.index in ro.deploys]
             for r in pending:
-                if r.bundle_mtime == self._deploys[r.index] and r.admitted:
-                    self._promote_done.add(r.index)
-                    del self._deploys[r.index]
+                if (
+                    r.policy_mtimes.get(ro.policy) == ro.deploys[r.index]
+                    and r.admitted
+                ):
+                    ro.promote_done.add(r.index)
+                    del ro.deploys[r.index]
         for r in pending:
-            if r.index in self._promote_done:
-                self._record_event("promoted_replica", replica=r.index)
-        if self._deploys:
-            if time.monotonic() > self._canary_deadline:
+            if r.index in ro.promote_done:
+                self._record_event("promoted_replica", policy=ro.policy,
+                                   replica=r.index)
+        if ro.deploys:
+            if time.monotonic() > ro.deadline:
                 self._canary_rollback(
+                    ro,
                     f"promote attestation timed out on "
-                    f"{sorted(self._deploys)}"
+                    f"{sorted(ro.deploys)}"
                 )
             return
         nxt = next(
-            (r for r in baselines if r.index not in self._promote_done), None
+            (r for r in baselines if r.index not in ro.promote_done), None
         )
         if nxt is not None:
             try:
-                self._backup_bundle(nxt)
-                mt = self._deploy_bundle(self._canary_dir, nxt.bundle_dir)
+                self._backup_bundle(ro, nxt)
+                mt = self._deploy_bundle(
+                    ro.src_dir, nxt.bundle_dirs[ro.policy]
+                )
             except OSError as e:
                 # same contract as the idle-path deploy guard: a promote
                 # whose source vanished or whose disk filled must roll the
                 # whole rollout back, not spin in "promoting" re-raising
                 # into the control loop's catch-all every tick
                 self._canary_rollback(
-                    f"deploy I/O error during promote: {e!r}"
+                    ro, f"deploy I/O error during promote: {e!r}"
                 )
                 return
-            self._deploys = {nxt.index: mt}
-            self._canary_deadline = time.monotonic() + self._attest_timeout_s
-            self._record_event("promote_replica", replica=nxt.index)
+            ro.deploys = {nxt.index: mt}
+            ro.deadline = time.monotonic() + self._attest_timeout_s
+            self._record_event("promote_replica", policy=ro.policy,
+                               replica=nxt.index)
             return
         # nxt is None: every baseline rolled forward — terminal event
         # BEFORE the state flip: a healthz reader that polls for
         # state=="idle" must find the terminal event already in
         # events_tail (the soak and tests do exactly that)
         self.stats.inc("canary_promotions")
-        self._record_event("canary_promoted",
-                           version=self._canary_version)
+        self._record_event("canary_promoted", policy=ro.policy,
+                           version=ro.version)
         with self._lock:
             for r in self._replicas:
-                r.canary = False
-            self._canary_state = "idle"
-        self._cleanup_rollback_dir()
+                r.canary_for.discard(ro.policy)
+            ro.state = "idle"
+        self._cleanup_rollback_dir(ro)
 
-    def _canary_rollback(self, reason: str, **verdict) -> None:
+    def _canary_rollback(self, ro: _Rollout, reason: str, **verdict) -> None:
         """Restore every replica the rollout touched to the saved old
-        bundle and RE-EJECT it until its healthz attests that old version
-        (then the normal K-consecutive-probes re-admission applies).
-        Baselines that were never deployed to are never touched."""
+        bundle for THIS policy and RE-EJECT it until its healthz attests
+        that old version (then the normal K-consecutive-probes
+        re-admission applies). Baselines that were never deployed to —
+        and every other policy's bundles — are never touched. A REMOVED
+        replica still gets its bundle dir restored (nothing half-deployed
+        may remain on disk) but is never gated or ejected: it has already
+        left the fleet."""
         # State flips FIRST: once canary_rollbacks ticks (next line), a
         # healthz reader must never see the rollout still "idle"/
         # "observing" — a rollback entered from idle (deploy I/O error)
         # does file restores below before the gates land, and that window
         # read as a settled fleet.
         with self._lock:
-            self._canary_state = "rolling_back"
+            ro.state = "rolling_back"
         # deadline BEFORE the restores: if one raises below, the next
         # _canary_check_rollback tick must compare against a real deadline,
         # not a stale/None one (TypeError every control tick = a
         # permanently wedged rollout machine)
-        self._rollback_deadline = time.monotonic() + 4 * self._attest_timeout_s
+        ro.rollback_deadline = time.monotonic() + 4 * self._attest_timeout_s
         self.stats.inc("canary_rollbacks")
-        self._record_event("canary_rollback", reason=reason,
-                           version=self._canary_version, **verdict)
+        self._record_event("canary_rollback", policy=ro.policy,
+                           reason=reason, version=ro.version, **verdict)
         gates = {}
         restore_failed = []
-        for i in sorted(self._backed_up):
+        for i in sorted(ro.backed_up):
             r = self._replicas[i]
             try:
                 gates[i] = self._deploy_bundle(
-                    os.path.join(self._rollback_dir, str(i)), r.bundle_dir
+                    os.path.join(ro.rollback_dir, str(i)),
+                    r.bundle_dirs[ro.policy],
                 )
             except OSError as e:
                 # the restore itself failed (ENOSPC again, backup dir
@@ -1153,16 +1747,18 @@ class Router:
         to_close = []
         ejected = []
         with self._lock:
-            for i in sorted(self._backed_up):
+            for i in sorted(ro.backed_up):
                 r = self._replicas[i]
+                if r.removed:
+                    continue  # restored above; no gate, no eject
                 if i in gates:
-                    self._readmit_gate[i] = gates[i]
+                    self._readmit_gate[(i, ro.policy)] = gates[i]
                 if r.admitted:
                     to_close.append(self._eject_locked(r, "rollback"))
                     ejected.append(i)
                 else:
                     r.healthy_streak = 0
-        self._deploys = {}
+        ro.deploys = {}
         for c in to_close:
             if c is not None:
                 try:
@@ -1170,59 +1766,66 @@ class Router:
                 except OSError:
                     pass
         for i, e in restore_failed:
-            self._record_event("rollback_restore_failed", replica=i,
-                               error=repr(e))
+            self._record_event("rollback_restore_failed", policy=ro.policy,
+                               replica=i, error=repr(e))
         for i in ejected:
             self._record_event("eject", replica=i,
                                addr=self._replicas[i].addr, reason="rollback")
 
-    def _canary_check_rollback(self) -> None:
+    def _canary_check_rollback(self, ro: _Rollout) -> None:
         with self._lock:
             # every replica the rollout DEPLOYED to (canaries, plus any
             # baseline a failed promote already rolled forward) must attest
             # the restored bundle and re-admit before the rollback is done
+            # — except removed replicas, which left the fleet (their dirs
+            # were restored; there is no process to wait for)
             waiting = [
                 r.index for r in self._replicas
-                if r.index in self._backed_up
-                and (r.index in self._readmit_gate or not r.admitted)
+                if r.index in ro.backed_up and not r.removed
+                and ((r.index, ro.policy) in self._readmit_gate
+                     or not r.admitted)
             ]
         if not waiting:
             # terminal event BEFORE the state flip (see _canary_promote)
-            self._record_event("canary_rolled_back",
-                               version=self._canary_version)
+            self._record_event("canary_rolled_back", policy=ro.policy,
+                               version=ro.version)
             with self._lock:
                 for r in self._replicas:
-                    r.canary = False
-                self._canary_state = "idle"
-            self._cleanup_rollback_dir()
+                    r.canary_for.discard(ro.policy)
+                ro.state = "idle"
+            self._cleanup_rollback_dir(ro)
             return
-        if time.monotonic() > self._rollback_deadline:
+        if time.monotonic() > ro.rollback_deadline:
             # the replica never came back (killed and not restarted?) —
             # stop gating on it so a fresh process serving the restored
             # bundle can re-admit normally, and say so loudly
-            self._record_event("canary_rollback_timeout",
-                               version=self._canary_version,
-                               waiting=waiting)
+            self._record_event("canary_rollback_timeout", policy=ro.policy,
+                               version=ro.version, waiting=waiting)
             with self._lock:
                 for r in self._replicas:
-                    r.canary = False
-                self._readmit_gate.clear()
-                self._canary_state = "idle"
-            self._cleanup_rollback_dir()
+                    r.canary_for.discard(ro.policy)
+                for key in [
+                    k for k in self._readmit_gate if k[1] == ro.policy
+                ]:
+                    del self._readmit_gate[key]
+                ro.state = "idle"
+            self._cleanup_rollback_dir(ro)
 
-    def _backup_bundle(self, r: Replica) -> None:
-        if r.index in self._backed_up:
+    def _backup_bundle(self, ro: _Rollout, r: Replica) -> None:
+        if r.index in ro.backed_up:
             # never overwrite the pristine pre-rollout copy: a re-entered
             # promote step after a partial deploy would otherwise save the
             # half-deployed dir (new params + old json) AS the backup, and
             # a later rollback would restore that corrupt mixture
             return
-        dst = os.path.join(self._rollback_dir, str(r.index))
+        dst = os.path.join(ro.rollback_dir, str(r.index))
         os.makedirs(dst, exist_ok=True)
         for fname in (_PARAMS_FILE, _META_FILE):
-            shutil.copyfile(os.path.join(r.bundle_dir, fname),
-                            os.path.join(dst, fname))
-        self._backed_up.add(r.index)
+            shutil.copyfile(
+                os.path.join(r.bundle_dirs[ro.policy], fname),
+                os.path.join(dst, fname),
+            )
+        ro.backed_up.add(r.index)
 
     def _deploy_bundle(self, src_dir: str, dst_dir: str,
                        corrupt: bool = False) -> float:
@@ -1254,25 +1857,37 @@ class Router:
                 raise
         return os.stat(os.path.join(dst_dir, _META_FILE)).st_mtime
 
-    def _cleanup_rollback_dir(self) -> None:
-        if self._rollback_dir is not None:
-            shutil.rmtree(self._rollback_dir, ignore_errors=True)
-            self._rollback_dir = None
-        self._backed_up = set()
+    def _cleanup_rollback_dir(self, ro: _Rollout) -> None:
+        if ro.rollback_dir is not None:
+            shutil.rmtree(ro.rollback_dir, ignore_errors=True)
+            ro.rollback_dir = None
+        ro.backed_up = set()
 
     # ----------------------------------------------------------------- status
+    # healthz keeps at most this many REMOVED replica rows (newest first
+    # by index): scale-down tombstones stay in _replicas forever for
+    # index stability, and without a bound a long-lived autoscaled
+    # router would serialize every dead row into every probe reply.
+    _HEALTHZ_REMOVED_ROWS = 16
+
     def healthz(self) -> dict:
         with self._lock:
+            removed_idx = [r.index for r in self._replicas if r.removed]
+            drop = set(removed_idx[:-self._HEALTHZ_REMOVED_ROWS]) \
+                if len(removed_idx) > self._HEALTHZ_REMOVED_ROWS else set()
             replicas = [
                 {
                     "index": r.index,
                     "addr": r.addr,
                     "admitted": r.admitted,
+                    "removed": r.removed,
                     "ejected_reason": r.ejected_reason,
-                    "canary": r.canary,
+                    "canary": sorted(r.canary_for),
+                    "policies": list(r.policies),
                     "inflight": r.inflight,
                     "healthy_streak": r.healthy_streak,
                     "bundle_mtime": r.bundle_mtime,
+                    "policy_mtimes": dict(r.policy_mtimes),
                     "pid": r.pid,
                     "replica_id": r.health.get("replica_id"),
                     "status": r.health.get("status"),
@@ -1282,17 +1897,21 @@ class Router:
                     "errors": r.errors,
                 }
                 for r in self._replicas
+                if r.index not in drop
             ]
-            admitted = sum(1 for r in self._replicas if r.admitted)
-            inflight = sum(r.inflight for r in self._replicas)
-            canary = {
-                "state": self._canary_state,
-                "fraction": self._canary_permille / 1000.0,
-                "version": self._canary_version,
-                "window_baseline": len(self._windows["baseline"]),
-                "window_canary": len(self._windows["canary"]),
+            admitted = sum(
+                1 for r in self._replicas if r.admitted and not r.removed
+            )
+            inflight = sum(
+                r.inflight for r in self._replicas if not r.removed
+            )
+            rollouts = {
+                pol: ro.snapshot_row(self._canary_permille)
+                for pol, ro in self._rollouts.items()
             }
             obs_dim = self._obs_dim
+            obs_dims = dict(self._obs_dims)
+            capacity = admitted * self._replica_capacity
         snap = self.stats.snapshot()
         snap["router"] = True
         snap["status"] = "draining" if self._shutdown.is_set() else (
@@ -1302,8 +1921,30 @@ class Router:
         snap["admitted"] = admitted
         snap["inflight"] = inflight
         snap["obs_dim"] = obs_dim
+        snap["obs_dims"] = obs_dims
         snap["replicas"] = replicas
-        snap["canary"] = canary
+        # Back-compat: ``canary`` stays the DEFAULT policy's rollout view
+        # (the PR-8 single-rollout schema); every rollout — default
+        # included — also appears under ``rollouts`` keyed by policy.
+        default_ro = rollouts.get(protocol.DEFAULT_POLICY)
+        snap["canary"] = default_ro if default_ro is not None else {
+            "state": "idle",
+            "fraction": self._canary_permille / 1000.0,
+            "version": None,
+            "window_baseline": 0,
+            "window_canary": 0,
+        }
+        snap["rollouts"] = rollouts
+        # The multi-tenant admission surface: capacity model + exact
+        # per-(tenant, class) accounting rows. answered == requests on
+        # every row at quiesce — the machine-checked identity.
+        snap["capacity"] = {
+            "replica_capacity": self._replica_capacity,
+            "bulk_fraction": self._bulk_fraction,
+            "total": capacity,
+            "bulk_limit": int(capacity * self._bulk_fraction),
+        }
+        snap["tenants"] = self.stats.tenants_snapshot()
         with self._events_lock:
             snap["events_total"] = self._events_total
             snap["events_tail"] = list(self._events)[-20:]
@@ -1314,10 +1955,21 @@ class Router:
     def _metrics_row(self) -> dict:
         """Numeric-only flat row (MetricsLogger contract)."""
         snap = self.stats.snapshot()
+        for cls in ("interactive", "bulk"):
+            sub = snap.pop(cls, None) or {}
+            for k, v in sub.items():
+                if v is not None:
+                    snap[f"{cls}_{k}"] = v
         with self._lock:
-            snap["admitted"] = sum(1 for r in self._replicas if r.admitted)
-            snap["inflight"] = sum(r.inflight for r in self._replicas)
-            snap["canary_active"] = float(self._canary_state != "idle")
+            snap["admitted"] = sum(
+                1 for r in self._replicas if r.admitted and not r.removed
+            )
+            snap["inflight"] = sum(
+                r.inflight for r in self._replicas if not r.removed
+            )
+            snap["canary_active"] = float(any(
+                ro.state != "idle" for ro in self._rollouts.values()
+            ))
         return {
             k: float(v) for k, v in snap.items()
             if isinstance(v, (int, float)) and not isinstance(v, bool)
@@ -1340,6 +1992,38 @@ def _ms(v: Optional[float]):
 
 
 # --------------------------------------------------------------------- CLI
+def parse_bundle_spec(spec: str):
+    """One --backend-bundles entry: '' -> None, bare DIR -> default
+    policy, 'name=dir+name2=dir2' -> multi-policy mapping."""
+    if not spec:
+        return None
+    if "=" not in spec:
+        return spec
+    out = {}
+    for part in spec.split("+"):
+        name, sep, path = part.partition("=")
+        if not sep or not name or not path:
+            raise SystemExit(
+                f"--backend-bundles spec wants name=dir[+name=dir...], "
+                f"got {part!r}"
+            )
+        out[name] = path
+    return out
+
+
+def parse_quota(q: str):
+    """'RPS[:BURST]' -> (rate, burst); burst defaults to 2×rate."""
+    rate_s, _, burst_s = q.partition(":")
+    try:
+        rate = float(rate_s)
+        burst = float(burst_s) if burst_s else 2.0 * rate
+    except ValueError:
+        raise SystemExit(f"quota wants RPS[:BURST], got {q!r}") from None
+    if rate <= 0 or burst < 1:
+        raise SystemExit(f"quota must have RPS > 0 and BURST >= 1, got {q!r}")
+    return rate, burst
+
+
 def build_parser():
     import argparse
 
@@ -1351,9 +2035,12 @@ def build_parser():
     p.add_argument("--backends", required=True,
                    help="comma-separated host:port of the serve/ replicas")
     p.add_argument("--backend-bundles", default=None,
-                   help="comma-separated bundle dirs, 1:1 with --backends "
-                        "(required for canary rollout: the router rolls a "
-                        "replica forward by writing into its bundle dir)")
+                   help="comma-separated bundle-dir specs, 1:1 with "
+                        "--backends (required for canary rollout: the "
+                        "router rolls a replica forward by writing into "
+                        "its bundle dir). Each spec is a bare DIR (the "
+                        "default policy) or 'name=dir+name2=dir2' for a "
+                        "multi-policy replica")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=7430,
                    help="0 = ephemeral (printed on startup)")
@@ -1376,9 +2063,35 @@ def build_parser():
                    help="block startup until N replicas admitted "
                         "(default: all backends)")
     p.add_argument("--wait-timeout", type=float, default=120.0)
-    p.add_argument("--canary-bundle", default=None,
+    p.add_argument("--canary-bundle", action="append", default=None,
+                   metavar="[POLICY=]DIR",
                    help="bundle dir to watch for rollouts: each new "
-                        "bundle.json mtime there starts a canary rollout")
+                        "bundle.json mtime there starts a canary rollout. "
+                        "Bare DIR rolls the default policy; POLICY=DIR "
+                        "rolls that policy only (repeatable — one "
+                        "independent rollout state machine per policy)")
+    p.add_argument("--tenant-quota", action="append", default=[],
+                   metavar="TENANT=RPS[:BURST]",
+                   help="per-tenant token-bucket admission quota "
+                        "(repeatable); requests past it shed OVERLOADED "
+                        "'quota' before dispatch. BURST defaults to 2×RPS")
+    p.add_argument("--default-quota", default=None, metavar="RPS[:BURST]",
+                   help="quota applied to tenants without an explicit "
+                        "--tenant-quota (unset = unlimited)")
+    p.add_argument("--replica-capacity", type=int, default=0,
+                   help="per-replica inflight capacity for the "
+                        "class-aware shed: fleet capacity = admitted "
+                        "replicas × this. Bulk requests shed past "
+                        "--bulk-fraction of it, interactive past all of "
+                        "it — bulk sheds FIRST under overload. 0 "
+                        "disables the class tier (quotas still apply)")
+    p.add_argument("--bulk-fraction", type=float, default=0.5,
+                   help="fraction of fleet capacity the bulk class may "
+                        "occupy before it sheds (the interactive-p99 "
+                        "protection knob)")
+    p.add_argument("--flood-burst", type=int, default=200,
+                   help="synthetic request count per tenant_flood / "
+                        "policy_skew chaos injection")
     p.add_argument("--canary-fraction", type=float, default=0.25,
                    help="deterministic request fraction routed to canary "
                         "replicas while observing")
@@ -1404,8 +2117,73 @@ def build_parser():
     p.add_argument("--chaos", default=None, metavar="PLAN",
                    help="deterministic fault injection (d4pg_tpu/chaos.py): "
                         "replica_kill@N / replica_slow@N:ms / "
-                        "canary_corrupt@N")
+                        "canary_corrupt@N / tenant_flood@N:tenant / "
+                        "policy_skew@N (scaledown_during_canary@N ticks "
+                        "in the autoscaler)")
+    g = p.add_argument_group("autoscaler (serve/autoscaler.py)")
+    g.add_argument("--autoscale", action="store_true",
+                   help="run the healthz-driven autoscaler in-process: "
+                        "spawn/drain replicas via scripts/spawnlib.py "
+                        "between --autoscale-min and --autoscale-max")
+    g.add_argument("--autoscale-bundle", default=None,
+                   help="source bundle dir for spawned replicas (each "
+                        "spawn gets its OWN copy under "
+                        "--autoscale-workdir; default: the first "
+                        "--backend-bundles default-policy dir)")
+    g.add_argument("--autoscale-workdir", default=None,
+                   help="where spawned replicas' bundle copies and "
+                        "the pool bookkeeping live (default: a mkdtemp)")
+    g.add_argument("--autoscale-min", type=int, default=1)
+    g.add_argument("--autoscale-max", type=int, default=4)
+    g.add_argument("--autoscale-interval", type=float, default=2.0,
+                   help="seconds between control samples")
+    g.add_argument("--autoscale-samples", type=int, default=3,
+                   help="CONSECUTIVE breaching samples before any action "
+                        "(never scale on one sample)")
+    g.add_argument("--autoscale-cooldown", type=float, default=30.0,
+                   help="hold after any action: new capacity needs warmup "
+                        "+ K-probe admission before its effect is "
+                        "measurable")
+    g.add_argument("--autoscale-up-load", type=float, default=0.8,
+                   help="inflight/capacity above this breaches toward "
+                        "scale-up")
+    g.add_argument("--autoscale-down-load", type=float, default=0.3,
+                   help="inflight/capacity below this breaches toward "
+                        "scale-down (hysteresis: well under the up "
+                        "threshold)")
+    g.add_argument("--autoscale-p99-slo", type=float, default=None,
+                   help="interactive-tier p99 SLO in ms: violating it "
+                        "breaches toward scale-up regardless of load")
+    g.add_argument("--autoscale-shed", type=float, default=0.05,
+                   help="shed rate (since last sample) above this "
+                        "breaches toward scale-up")
+    g.add_argument("--replica-args", default="",
+                   help="extra args for spawned serve replicas, e.g. "
+                        "'--max-batch 8 --max-wait-us 500'")
     return p
+
+
+def _load_spawnlib():
+    """Import ``scripts/spawnlib.py`` (the shared CLI subprocess harness)
+    from the repo checkout this package runs out of."""
+    import importlib.util
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        ))),
+        "scripts", "spawnlib.py",
+    )
+    if not os.path.exists(path):
+        raise SystemExit(
+            f"--autoscale needs scripts/spawnlib.py (looked at {path}); "
+            "run from a repo checkout"
+        )
+    spec = importlib.util.spec_from_file_location("spawnlib", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def main(argv=None) -> None:
@@ -1418,8 +2196,26 @@ def main(argv=None) -> None:
     bundles = None
     if args.backend_bundles:
         bundles = [
-            b.strip() or None for b in args.backend_bundles.split(",")
+            parse_bundle_spec(b.strip())
+            for b in args.backend_bundles.split(",")
         ]
+    canary = None
+    if args.canary_bundle:
+        canary = {}
+        for spec in args.canary_bundle:
+            name, sep, path = spec.partition("=")
+            pol, src = (name, path) if sep and path else (
+                protocol.DEFAULT_POLICY, spec
+            )
+            if pol in canary:
+                raise SystemExit(f"--canary-bundle for {pol!r} given twice")
+            canary[pol] = src
+    quotas = {}
+    for spec in args.tenant_quota:
+        name, sep, q = spec.partition("=")
+        if not sep or not name:
+            raise SystemExit(f"--tenant-quota wants TENANT=RPS[:BURST], got {spec!r}")
+        quotas[name] = parse_quota(q)
     chaos = None
     if args.chaos:
         from d4pg_tpu.chaos import ChaosInjector, ChaosPlan
@@ -1430,13 +2226,20 @@ def main(argv=None) -> None:
         host=args.host,
         port=args.port,
         bundle_dirs=bundles,
+        tenant_quotas=quotas or None,
+        default_quota=(
+            parse_quota(args.default_quota) if args.default_quota else None
+        ),
+        replica_capacity=args.replica_capacity,
+        bulk_fraction=args.bulk_fraction,
+        flood_burst=args.flood_burst,
         probe_interval_s=args.probe_interval,
         probe_timeout_s=args.probe_timeout,
         readmit_after=args.readmit_after,
         dispatch_retries=args.dispatch_retries,
         stuck_after_s=args.stuck_after,
         retry_seed=args.retry_seed,
-        canary_bundle=args.canary_bundle,
+        canary_bundle=canary,
         canary_fraction=args.canary_fraction,
         canary_window=args.canary_window,
         canary_min_samples=args.canary_min_samples,
@@ -1463,7 +2266,66 @@ def main(argv=None) -> None:
         admitted = router.wait_for_replicas(want, timeout_s=args.wait_timeout)
         print(f"[router] admitted {admitted}/{len(backends)} replicas",
               flush=True)
+    scaler = pool = None
+    if args.autoscale:
+        import shlex
+        import tempfile as _tempfile
+
+        from d4pg_tpu.serve.autoscaler import (
+            Autoscaler,
+            RouterReplicaPool,
+            ServingSignalSource,
+        )
+
+        src = args.autoscale_bundle
+        if src is None:
+            for b in bundles or []:
+                if isinstance(b, str):
+                    src = b
+                    break
+                if isinstance(b, dict) and protocol.DEFAULT_POLICY in b:
+                    src = b[protocol.DEFAULT_POLICY]
+                    break
+        if src is None:
+            raise SystemExit(
+                "--autoscale needs --autoscale-bundle (or a "
+                "--backend-bundles default-policy dir to clone)"
+            )
+        workdir = args.autoscale_workdir or _tempfile.mkdtemp(
+            prefix="d4pg-autoscale-"
+        )
+        pool = RouterReplicaPool(
+            router, src, workdir, _load_spawnlib().spawn,
+            replica_args=shlex.split(args.replica_args),
+        )
+        scaler = Autoscaler(
+            ServingSignalSource(router.healthz),
+            pool.scale_up,
+            pool.scale_down,
+            min_replicas=args.autoscale_min,
+            max_replicas=args.autoscale_max,
+            interval_s=args.autoscale_interval,
+            up_load=args.autoscale_up_load,
+            down_load=args.autoscale_down_load,
+            p99_slo_ms=args.autoscale_p99_slo,
+            shed_threshold=args.autoscale_shed,
+            samples=args.autoscale_samples,
+            cooldown_s=args.autoscale_cooldown,
+            chaos=chaos,
+            on_event=lambda kind, **f: router._record_event(kind, **f),
+        )
+        scaler.start()
+        print(
+            f"[router] autoscaler on: {args.autoscale_min}.."
+            f"{args.autoscale_max} replicas, bundle={src}",
+            flush=True,
+        )
     router.serve_until_shutdown()
+    if scaler is not None:
+        scaler.close()
+        print(f"[router] autoscaler: {scaler.snapshot()}", flush=True)
+    if pool is not None:
+        pool.close()
     snap = router.healthz()
     print(
         f"[router] drained: {snap['replies_ok']} ok, "

@@ -68,14 +68,79 @@ def load_best_actor_params(run_dir: str, config):
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+class _PolicyRuntime:
+    """One resident policy: its bundle, its own batcher (own device
+    thread, own per-bucket compile budget under the shared sentinel), and
+    its reload bookkeeping. The multi-tenant tier is N of these behind
+    one socket front-end — the v1 ``ACT`` path serves the DEFAULT one.
+
+    No threads of its own; the server's reload watcher is the only writer
+    of the mutable fields below after construction (d4pglint
+    shared-mutable-state: readers take atomic reference snapshots and
+    tolerate being one reload stale — the same contract the single-policy
+    server carried on PolicyServer itself)."""
+
+    _THREAD_SAFE = (
+        "bundle", "_bundle_mtime", "_serving_bundle_mtime", "_last_reload",
+    )
+
+    def __init__(self, policy_id: str, bundle: PolicyBundle, batcher,
+                 watch_bundle: bool):
+        self.policy_id = policy_id
+        self.bundle = bundle
+        self.batcher = batcher
+        self._watch_bundle = watch_bundle and bundle.path is not None
+        self._bundle_mtime = (
+            bundle_mtime(bundle.path) if self._watch_bundle else None
+        )
+        # The json mtime of the bundle this policy is actually SERVING —
+        # the per-policy rollout version vector the router's prober keys
+        # on. Distinct from ``_bundle_mtime`` (the watch bookmark), which
+        # advances even when a reload FAILS: a canary offered a corrupt
+        # bundle must keep attesting the OLD version, or the router would
+        # promote a rollout nobody loaded.
+        self._serving_bundle_mtime = (
+            bundle_mtime(bundle.path) if bundle.path is not None else None
+        )
+        self._last_reload: Optional[str] = None
+
+    def healthz_row(self) -> dict:
+        """The per-policy healthz surface (docs/serving.md schema): the
+        rollout version vector, reload outcome, and this policy's own
+        stats — the router's per-policy canary machinery attests and
+        observes on exactly these fields."""
+        snap = self.batcher.stats.snapshot()
+        last_reload = self._last_reload
+        return {
+            "bundle_mtime": self._serving_bundle_mtime,
+            "last_reload": last_reload,
+            "status": (
+                "degraded"
+                if last_reload is not None and last_reload.startswith("failed")
+                else "ok"
+            ),
+            "compile_count": self.batcher.compile_count,
+            "buckets": list(self.batcher.buckets),
+            "queue_depth": self.batcher.queue_depth,
+            "obs_dim": self.bundle.obs_dim,
+            "action_dim": self.bundle.action_dim,
+            "inflight": snap["inflight"],
+            "requests_total": snap["requests_total"],
+            "replies_ok": snap["replies_ok"],
+            "shed_total": snap["shed_total"],
+            "params_reloads": snap["params_reloads"],
+            "p99_ms": snap["p99_ms"],
+        }
+
+
 class PolicyServer:
     # d4pglint shared-mutable-state: the reload watcher thread is the ONLY
-    # writer of all five after start() (check_reload is watcher-only);
-    # readers (healthz, conn threads) take atomic reference snapshots and
-    # tolerate being one reload stale.
+    # writer of both after start() (check_reload is watcher-only); readers
+    # (healthz, conn threads) take atomic reference snapshots and tolerate
+    # being one reload stale. Per-policy reload state lives on
+    # _PolicyRuntime (same contract, declared there).
     _THREAD_SAFE = (
-        "bundle", "_bundle_mtime", "_best_mtime", "_last_reload",
-        "_serving_bundle_mtime",
+        "bundle", "_best_mtime",
     )
     # d4pglint thread-lifecycle: per-connection reader threads are not
     # joined — drain() closes every socket in _conns, which unblocks the
@@ -102,6 +167,7 @@ class PolicyServer:
         debug_guards: bool = False,
         chaos=None,
         replica_id: Optional[int] = None,
+        policies: Optional[dict] = None,
     ):
         self.bundle = bundle
         # Fleet attribution (--replica-id): stamped into healthz and every
@@ -124,44 +190,52 @@ class PolicyServer:
 
             self.ledger = StagingLedger("serve")
             self.sentinel = RecompileSentinel().start()
-        self.batcher = DynamicBatcher(
-            bundle.config,
-            bundle.actor_params,
-            max_batch=max_batch,
-            max_wait_us=max_wait_us,
-            queue_limit=queue_limit,
-            action_low=bundle.action_low,
-            action_high=bundle.action_high,
-            obs_norm_stats=bundle.obs_norm,
-            ledger=self.ledger,
-            sentinel=self.sentinel,
-            guard_transfers=debug_guards,
-        )
+        # N resident policies behind one front-end: ``bundle`` is the
+        # DEFAULT (the one a v1 ACT frame — an old client — lands on);
+        # ``policies`` maps extra policy ids to their bundles. Each policy
+        # gets its OWN DynamicBatcher (own device thread, own compile
+        # budget, own ledger staging groups via the batcher ``name``) and
+        # its own hot-reload watch — a reload/rollout on policy A never
+        # touches policy B's compiled programs or params.
+        def _mk_batcher(pid: str, b: PolicyBundle):
+            return DynamicBatcher(
+                b.config,
+                b.actor_params,
+                max_batch=max_batch,
+                max_wait_us=max_wait_us,
+                queue_limit=queue_limit,
+                action_low=b.action_low,
+                action_high=b.action_high,
+                obs_norm_stats=b.obs_norm,
+                ledger=self.ledger,
+                sentinel=self.sentinel,
+                guard_transfers=debug_guards,
+                name="serve" if pid == protocol.DEFAULT_POLICY
+                else f"serve[{pid}]",
+            )
+
+        extra = dict(policies) if policies else {}
+        if protocol.DEFAULT_POLICY in extra:
+            raise ValueError(
+                f"policy id {protocol.DEFAULT_POLICY!r} is reserved for the "
+                "--bundle default policy (the v1 backward-compat target)"
+            )
+        self._policies: dict = {}
+        for pid, b in [(protocol.DEFAULT_POLICY, bundle)] + sorted(
+            extra.items()
+        ):
+            self._policies[pid] = _PolicyRuntime(
+                pid, b, _mk_batcher(pid, b), watch_bundle
+            )
+        self._default = self._policies[protocol.DEFAULT_POLICY]
+        self.batcher = self._default.batcher
         self.stats = self.batcher.stats
         # Chaos harness (ChaosInjector or None): the sock_reset site ticks
         # once per received frame and force-resets the connection — proves
         # the reader/reply paths survive abrupt client death end-to-end.
         self._chaos = chaos
-        # Degraded-state surface for healthz: outcome of the most recent
-        # hot-reload attempt (None until one happens). A failed reload
-        # means the server is healthy but serving older params — operators
-        # alert on it without grepping logs.
-        self._last_reload: Optional[str] = None
         self._watch_run = watch_run
-        self._watch_bundle = watch_bundle and bundle.path is not None
         self._poll_interval_s = poll_interval_s
-        self._bundle_mtime = (
-            bundle_mtime(bundle.path) if self._watch_bundle else None
-        )
-        # The json mtime of the bundle this server is actually SERVING —
-        # the rollout version vector the replica front-end's prober keys
-        # on. Distinct from ``_bundle_mtime`` (the watch bookmark), which
-        # advances even when a reload FAILS: a canary offered a corrupt
-        # bundle must keep attesting the OLD version, or the router would
-        # promote a rollout nobody loaded.
-        self._serving_bundle_mtime = (
-            bundle_mtime(bundle.path) if bundle.path is not None else None
-        )
         self._best_mtime = self._stat_best() if watch_run else None
         self._log_dir = log_dir
         self._metrics_interval_s = metrics_interval_s
@@ -181,7 +255,9 @@ class PolicyServer:
         if self._started:
             raise RuntimeError("server already started")
         self._started = True
-        self.batcher.start(warmup=True)  # every bucket compiled before accept
+        for p in self._policies.values():
+            # every bucket of every policy compiled before accept
+            p.batcher.start(warmup=True)
         self._listen_sock = socket.create_server(
             (self.host, self._requested_port)
         )
@@ -190,7 +266,7 @@ class PolicyServer:
             target=self._accept_loop, name="serve-accept", daemon=True
         )
         self._accept_thread.start()
-        if self._watch_bundle or self._watch_run:
+        if any(p._watch_bundle for p in self._policies.values()) or self._watch_run:
             self._watch_thread = threading.Thread(
                 target=self._watch_loop, name="serve-reload", daemon=True
             )
@@ -238,7 +314,8 @@ class PolicyServer:
                 self._listen_sock.close()
             except OSError:
                 pass
-        self.batcher.stop(drain=True, timeout=timeout)
+        for p in self._policies.values():
+            p.batcher.stop(drain=True, timeout=timeout)
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=5)
         if self._watch_thread is not None:
@@ -282,54 +359,73 @@ class PolicyServer:
         except (OSError, TypeError):
             return None
 
-    def check_reload(self) -> bool:
-        """One reload poll (also callable directly from tests — the watch
-        thread is just this on a timer). Returns True if params swapped."""
+    def _check_policy_reload(self, p: _PolicyRuntime) -> bool:
+        """One reload poll for one resident policy. Returns True if its
+        params swapped."""
+        if not p._watch_bundle:
+            return False
+        m = bundle_mtime(p.bundle.path)
+        if m is None or m == p._bundle_mtime:
+            return False
         swapped = False
-        if self._watch_bundle:
-            m = bundle_mtime(self.bundle.path)
-            if m is not None and m != self._bundle_mtime:
-                try:
-                    # Reload the WHOLE bundle, not just the params: a
-                    # re-export from a live --obs-norm run carries fresher
-                    # normalizer statistics, and serving new params under
-                    # stale μ/σ silently scales the net's inputs off its
-                    # trained distribution. Config/bounds changes are
-                    # REFUSED (they are baked into the compiled bucket
-                    # programs — honoring them needs a restart).
-                    fresh = load_bundle(self.bundle.path)
-                    if fresh.config != self.bundle.config:
-                        raise ValueError(
-                            "agent config changed; restart the server to "
-                            "serve it (compiled programs are config-shaped)"
-                        )
-                    if not (
-                        np.array_equal(fresh.action_low, self.bundle.action_low)
-                        and np.array_equal(
-                            fresh.action_high, self.bundle.action_high
-                        )
-                    ):
-                        raise ValueError(
-                            "action bounds changed; restart the server to "
-                            "serve them (bounds are baked into the "
-                            "compiled programs)"
-                        )
-                    self.batcher.set_params(fresh.actor_params)
-                    self.batcher.set_obs_norm(fresh.obs_norm)
-                    self.bundle = fresh
-                    swapped = True
-                    self._serving_bundle_mtime = m
-                    self._last_reload = "ok: bundle"
-                    print(f"[serve] reloaded bundle {self.bundle.path}")
-                except Exception as e:
-                    # ANY load/validation failure (a malformed bundle.json
-                    # raises KeyError/TypeError, not just OSError/
-                    # ValueError) means: keep serving the old params. The
-                    # mtime bookmark still advances below, so a bad export
-                    # logs once instead of retrying every poll forever.
-                    self._last_reload = f"failed: {e}"
-                    print(f"[serve] bundle reload failed (serving old params): {e}")
-                self._bundle_mtime = m
+        try:
+            # Reload the WHOLE bundle, not just the params: a
+            # re-export from a live --obs-norm run carries fresher
+            # normalizer statistics, and serving new params under
+            # stale μ/σ silently scales the net's inputs off its
+            # trained distribution. Config/bounds changes are
+            # REFUSED (they are baked into the compiled bucket
+            # programs — honoring them needs a restart).
+            fresh = load_bundle(p.bundle.path)
+            if fresh.config != p.bundle.config:
+                raise ValueError(
+                    "agent config changed; restart the server to "
+                    "serve it (compiled programs are config-shaped)"
+                )
+            if not (
+                np.array_equal(fresh.action_low, p.bundle.action_low)
+                and np.array_equal(
+                    fresh.action_high, p.bundle.action_high
+                )
+            ):
+                raise ValueError(
+                    "action bounds changed; restart the server to "
+                    "serve them (bounds are baked into the "
+                    "compiled programs)"
+                )
+            p.batcher.set_params(fresh.actor_params)
+            p.batcher.set_obs_norm(fresh.obs_norm)
+            p.bundle = fresh
+            if p is self._default:
+                self.bundle = fresh  # keep the compat alias current
+            swapped = True
+            p._serving_bundle_mtime = m
+            p._last_reload = "ok: bundle"
+            print(
+                f"[serve] reloaded bundle {p.bundle.path} "
+                f"(policy {p.policy_id})"
+            )
+        except Exception as e:
+            # ANY load/validation failure (a malformed bundle.json
+            # raises KeyError/TypeError, not just OSError/
+            # ValueError) means: keep serving the old params. The
+            # mtime bookmark still advances below, so a bad export
+            # logs once instead of retrying every poll forever.
+            p._last_reload = f"failed: {e}"
+            print(
+                f"[serve] bundle reload failed (policy {p.policy_id} "
+                f"serving old params): {e}"
+            )
+        p._bundle_mtime = m
+        return swapped
+
+    def check_reload(self) -> bool:
+        """One reload poll across every resident policy (also callable
+        directly from tests — the watch thread is just this on a timer).
+        Returns True if any policy's params swapped."""
+        swapped = False
+        for p in self._policies.values():
+            swapped = self._check_policy_reload(p) or swapped
         if self._watch_run:
             m = self._stat_best()
             if m is not None and m != self._best_mtime:
@@ -343,12 +439,15 @@ class PolicyServer:
                     )
                     self.batcher.set_params(params)
                     swapped = True
-                    self._last_reload = "ok: best_actor.npz"
+                    # --watch-run is a default-policy contract (the
+                    # training-run fast path); extra policies reload via
+                    # their own bundle dirs only
+                    self._default._last_reload = "ok: best_actor.npz"
                     print(
                         f"[serve] reloaded best_actor.npz from {self._watch_run}"
                     )
                 except Exception as e:  # same contract as the bundle branch
-                    self._last_reload = f"failed: {e}"
+                    self._default._last_reload = f"failed: {e}"
                     print(f"[serve] run-dir reload failed (serving old params): {e}")
                 self._best_mtime = m
         return swapped
@@ -364,8 +463,15 @@ class PolicyServer:
     def _metrics_row(self) -> dict:
         """Stats row with the replica identity stamped in (numeric-only,
         per the MetricsLogger contract) — multi-replica soak logs stay
-        attributable per process."""
+        attributable per process. Extra resident policies contribute
+        their own rows under a ``policy_<id>_`` prefix (the default
+        policy keeps the bare PR-3 keys so existing plots don't move)."""
         row = self.stats.metrics_row()
+        for pid, p in self._policies.items():
+            if p is self._default:
+                continue
+            for k, v in p.batcher.stats.metrics_row().items():
+                row[f"policy_{pid}_{k}"] = v
         if self.replica_id is not None:
             row["replica_id"] = float(self.replica_id)
         return row
@@ -461,16 +567,47 @@ class PolicyServer:
                         json.dumps(self.healthz()).encode(),
                     )
                     continue
-                if msg_type != protocol.ACT:
+                if msg_type == protocol.ACT:
+                    # v1 path: an old client negotiates down to the
+                    # DEFAULT policy implicitly — reply bytes (version
+                    # byte included, via the per-type frame floor) are
+                    # identical to the PR-8 server's.
+                    pol = self._default
+                    obs, deadline_us = protocol.decode_act(
+                        payload, pol.bundle.obs_dim
+                    )
+                elif msg_type == protocol.ACT2:
+                    obs, deadline_us, policy_id, _qos, _tenant = (
+                        protocol.decode_act2(payload)
+                    )
+                    # QoS/tenant ride the frame for the ROUTER's admission
+                    # tier; the replica itself routes on policy only.
+                    pol = self._policies.get(policy_id)
+                    if pol is None:
+                        # well-formed frame, wrong policy: a per-request
+                        # ERROR, not a ProtocolError — the connection
+                        # (and its pipelined siblings) survives
+                        self.stats.inc("unknown_policy")
+                        reply(
+                            protocol.ERROR, req_id,
+                            f"unknown policy {policy_id!r} (resident: "
+                            f"{sorted(self._policies)})".encode(),
+                        )
+                        continue
+                    if obs.shape[0] != pol.bundle.obs_dim:
+                        reply(
+                            protocol.ERROR, req_id,
+                            f"obs is {obs.shape[0]}-dim, policy "
+                            f"{policy_id!r} wants {pol.bundle.obs_dim}".encode(),
+                        )
+                        continue
+                else:
                     raise ProtocolError(f"unexpected message type {msg_type}")
-                obs, deadline_us = protocol.decode_act(
-                    payload, self.bundle.obs_dim
-                )
                 deadline_s = (
                     deadline_us / 1e6 if deadline_us else self.default_deadline_s
                 )
                 try:
-                    fut = self.batcher.submit(obs, deadline_s)
+                    fut = pol.batcher.submit(obs, deadline_s)
                 except ShedError as e:
                     reply(protocol.OVERLOADED, req_id, e.reason.encode())
                     continue
@@ -516,32 +653,45 @@ class PolicyServer:
     def healthz(self) -> dict:
         snap = self.stats.snapshot()
         # Degraded-state contract: "draining" once shutdown is requested;
-        # "degraded" while healthy-but-stale (the last hot-reload attempt
-        # failed, so traffic is served on older params); "ok" otherwise.
-        # (No quarantined-worker field: serving has no worker pool — the
-        # single device thread either lives or the process is down.)
-        last_reload = self._last_reload
+        # "degraded" while healthy-but-stale (ANY policy's last hot-reload
+        # attempt failed, so its traffic is served on older params); "ok"
+        # otherwise. (No quarantined-worker field: serving has no worker
+        # pool — the device threads either live or the process is down.)
+        rows = {pid: p.healthz_row() for pid, p in self._policies.items()}
         if self._shutdown.is_set():
             status = "draining"
-        elif last_reload is not None and last_reload.startswith("failed"):
+        elif any(r["status"] == "degraded" for r in rows.values()):
             status = "degraded"
         else:
             status = "ok"
         snap["status"] = status
         snap["draining"] = self._shutdown.is_set()
-        snap["last_reload"] = last_reload
+        snap["last_reload"] = rows[protocol.DEFAULT_POLICY]["last_reload"]
         if self._chaos is not None:
             snap["chaos_injections"] = self._chaos.injections_total
         snap["queue_depth"] = self.batcher.queue_depth
-        snap["compile_count"] = self.batcher.compile_count
+        # Aggregates across EVERY resident policy: compile_count is the
+        # whole process's compiled-program count (the soak's flat-count
+        # assertion must see a stray retrace on ANY policy), inflight is
+        # the dispatch-weight gauge the router/autoscaler read.
+        snap["compile_count"] = sum(
+            p.batcher.compile_count for p in self._policies.values()
+        )
+        snap["inflight"] = sum(r["inflight"] for r in rows.values())
+        snap["params_reloads"] = sum(
+            r["params_reloads"] for r in rows.values()
+        )
         snap["buckets"] = list(self.batcher.buckets)
         snap["obs_dim"] = self.bundle.obs_dim
         snap["action_dim"] = self.bundle.action_dim
         # Prober surface (docs/serving.md schema): the serving-bundle
         # version vector (advances ONLY on successful reload), process
         # identity for fleet attribution / chaos targeting, and the
-        # inflight/uptime_s gauges already in the stats snapshot.
-        snap["bundle_mtime"] = self._serving_bundle_mtime
+        # uptime_s gauge already in the stats snapshot. Top-level
+        # bundle_mtime stays the DEFAULT policy's (the PR-8 field old
+        # routers key on); per-policy vectors ride the ``policies`` rows.
+        snap["bundle_mtime"] = rows[protocol.DEFAULT_POLICY]["bundle_mtime"]
+        snap["policies"] = rows
         snap["replica_id"] = self.replica_id
         snap["pid"] = os.getpid()
         snap["stage_ms"] = {

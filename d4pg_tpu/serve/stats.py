@@ -105,6 +105,7 @@ class ServeStats:
         self.shed_draining = 0
         self.protocol_errors = 0
         self.dropped_replies = 0   # client gone before its reply
+        self.unknown_policy = 0    # well-formed ACT2 naming a non-resident policy
         self.batches_total = 0
         self.padded_rows_total = 0
         self.params_version = 0
@@ -136,6 +137,7 @@ class ServeStats:
                 "shed_draining": self.shed_draining,
                 "protocol_errors": self.protocol_errors,
                 "dropped_replies": self.dropped_replies,
+                "unknown_policy": self.unknown_policy,
                 "batches_total": self.batches_total,
                 "padded_rows_total": self.padded_rows_total,
                 "params_version": self.params_version,

@@ -17,7 +17,19 @@
 #      the same port — the actor must reconnect under its bounded
 #      Backoff and feed the resumed run to completion, with every
 #      emitted window accounted for (acked/stale/shed/dropped — the
-#      zero-torn-windows contract) and guards green throughout.
+#      zero-torn-windows contract) and guards green throughout;
+#   7. multi-tenant serving (ISSUE 12): mixed interactive+bulk load from
+#      several tenants through the router's quota + class-aware
+#      admission across two MULTI-POLICY replicas while (a) a bulk
+#      tenant floods (chaos tenant_flood — interactive p99 must hold
+#      inside its SLO, bulk sheds first, per-tenant accounting identity
+#      exact), (b) traffic skews 95% onto one policy (chaos policy_skew
+#      — the cold policy still meets its deadline), and (c) the
+#      autoscaler scales UP under load then is chaos-forced to scale
+#      DOWN mid-canary (scaledown_during_canary — the rollout must
+#      abort or complete cleanly, never leaving a half-deployed bundle
+#      dir anywhere, and every other policy's replicas end with
+#      params_reloads == 0).
 #
 # Knobs (env vars): SOAK_DIR (default mktemp), SOAK_ENV (Pendulum-v1),
 # SOAK_STEPS (grad steps per leg, default 6), SOAK_HIDDEN (16,16),
@@ -384,6 +396,246 @@ print("CHAOS_SOAK_ROUTER_OK",
        "retries": h["retries"], "ejections": h["ejections"],
        "admissions": h["admissions"],
        "rollbacks": h["canary_rollbacks"]})
+EOF
+
+# ---- leg 7: multi-tenant serving — tenant flood + policy skew + autoscaled
+# scale-down mid-canary (ISSUE 12). Two multi-policy replicas (default +
+# alt, each policy its own bundle dir), a router with per-tenant quotas +
+# class-aware admission and the in-process autoscaler (min 2, max 3,
+# spawning real serve CLIs via spawnlib). Contracts asserted below in the
+# heredoc; SOAK_MT_SLO_MS bounds the interactive tier's p99.
+for rep in 0 1; do
+  cp -r "$DIR/bundle" "$DIR/mt_r${rep}_def"
+  cp -r "$DIR/bundle" "$DIR/mt_r${rep}_alt"
+done
+python - "$DIR" "${SOAK_MT_SLO_MS:-2000}" <<'EOF'
+import json, os, shutil, signal, sys, threading, time
+import numpy as np
+
+sys.path.insert(0, "scripts")
+from spawnlib import spawn
+
+d, slo_ms = sys.argv[1], float(sys.argv[2])
+
+
+def replica(rid):
+    return spawn(
+        [sys.executable, "-m", "d4pg_tpu.serve",
+         "--bundle", f"{d}/mt_r{rid}_def",
+         "--policy", f"alt={d}/mt_r{rid}_alt",
+         "--port", "0", "--max-batch", "8", "--max-wait-us", "500",
+         "--poll-interval", "0.2", "--replica-id", str(rid),
+         "--debug-guards"],
+        f"mt-replica{rid}",
+    )
+
+
+reps = [replica(0), replica(1)]
+ports = [r.wait_port(180) for r in reps]
+
+router = spawn(
+    [sys.executable, "-m", "d4pg_tpu.serve.router",
+     "--backends", ",".join(f"127.0.0.1:{p}" for p in ports),
+     "--backend-bundles",
+     ",".join(f"default={d}/mt_r{r}_def+alt={d}/mt_r{r}_alt"
+              for r in (0, 1)),
+     "--port", "0", "--probe-interval", "0.2", "--readmit-after", "1",
+     "--replica-capacity", "8", "--bulk-fraction", "0.5",
+     "--tenant-quota", "bulky=40:60",
+     "--canary-bundle", f"{d}/mt_canary",
+     "--canary-fraction", "0.5", "--canary-min-samples", "8",
+     "--canary-attest-timeout", "60", "--canary-observe-timeout", "30",
+     "--autoscale", "--autoscale-min", "2", "--autoscale-max", "3",
+     "--autoscale-bundle", f"{d}/bundle",
+     "--autoscale-workdir", f"{d}/mt_autoscale",
+     "--autoscale-interval", "0.4", "--autoscale-samples", "2",
+     "--autoscale-cooldown", "2", "--autoscale-up-load", "0.7",
+     "--replica-args", "--max-batch 8 --max-wait-us 500",
+     "--flood-burst", "150",
+     "--chaos",
+     "seed=17;tenant_flood@60:bulky;policy_skew@120;"
+     "scaledown_during_canary@28"],
+    "mt-router",
+)
+rport = router.wait_port(120)
+for _ in range(300):
+    if any("admitted 2/2" in l for l in router.lines):
+        break
+    time.sleep(0.2)
+else:
+    raise SystemExit("CHAOS_SOAK_FAIL: mt router never admitted both replicas")
+
+from d4pg_tpu.serve.client import PolicyClient
+from d4pg_tpu.serve.protocol import probe_healthz
+
+obs = np.array([0.1, -0.2, 0.05], np.float32)
+stop = threading.Event()
+lock = threading.Lock()
+tallies = {}   # (label) -> {"ok": n, "overloaded": n, "error": n}
+idle = threading.Semaphore(0)
+CHAINS = []
+
+
+def chain(label, client, window, **act_kw):
+    """Closed-loop async chain: every completion immediately re-sends —
+    the client-side half of the accounting identity."""
+    counts = tallies.setdefault(label, {"ok": 0, "overloaded": 0, "error": 0})
+
+    def send():
+        fut = client.act_async(obs, **act_kw)
+
+        def done(f):
+            exc = f.exception()
+            with lock:
+                if exc is None:
+                    counts["ok"] += 1
+                elif type(exc).__name__ == "Overloaded":
+                    counts["overloaded"] += 1
+                else:
+                    counts["error"] += 1
+            if stop.is_set():
+                idle.release()
+            else:
+                send()
+
+        fut.add_done_callback(done)
+
+    for _ in range(window):
+        send()
+    CHAINS.append(window)
+
+
+clients = []
+
+
+def mk_client(**kw):
+    c = PolicyClient("127.0.0.1", rport, timeout=60, **kw)
+    clients.append(c)
+    return c
+
+
+# interactive tenants (the protected tier), a bulk flooder, and the cold
+# alt policy under a per-request deadline
+for i in range(3):
+    chain(f"web{i}", mk_client(tenant="web"), 6)
+chain("bulky", mk_client(tenant="bulky", qos="bulk"), 10)
+chain("alt", mk_client(tenant="web", policy_id="alt"), 2,
+      deadline_ms=slo_ms)
+
+
+def healthz():
+    return probe_healthz("127.0.0.1", rport, timeout_s=5.0)
+
+
+def wait_for(cond, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if cond():
+                return
+        except OSError:
+            pass
+        time.sleep(0.3)
+    raise SystemExit(f"CHAOS_SOAK_FAIL: timed out waiting for {what}")
+
+
+# (a) the load pushes utilization over the line: the autoscaler grows the
+# fleet to 3 (a REAL spawned serve CLI admitted through the probe path)
+wait_for(lambda: healthz()["admitted"] == 3, 120, "autoscaler scale-up")
+print("[chaos-soak] autoscaler scaled 2 -> 3 under load", flush=True)
+
+# (b) the tenant flood + policy skew chaos bursts fire on request counts
+wait_for(lambda: healthz().get("chaos_injections", 0) >= 2, 60,
+         "tenant_flood + policy_skew injections")
+
+# (c) offer a canary for the DEFAULT policy (same params re-attested =
+# a new version), then the chaos-forced scale-down lands mid-rollout
+shutil.copytree(f"{d}/bundle", f"{d}/mt_canary")
+wait_for(
+    lambda: any("scale_down" in l and "scaledown_skipped" not in l
+                for l in router.lines),
+    120, "chaos-forced scale-down",
+)
+print("[chaos-soak] chaos forced a scale-down", flush=True)
+wait_for(
+    lambda: (lambda h: all(
+        ro["state"] == "idle" for ro in h["rollouts"].values()
+    ) and h["admitted"] >= 2)(healthz()),
+    180, "rollout settle after scale-down",
+)
+print("[chaos-soak] rollout settled cleanly after scale-down", flush=True)
+
+time.sleep(2)  # load rides on the settled fleet
+stop.set()
+for _ in range(sum(CHAINS)):
+    idle.acquire(timeout=90)
+for c in clients:
+    c.close()
+
+h = healthz()
+# aggregate + per-(tenant, class) accounting identity, EXACT
+assert h["requests_total"] == h["answered_total"], (
+    h["requests_total"], h["answered_total"])
+for key, row in h["tenants"].items():
+    assert row["requests"] == row["answered"], (key, row)
+# the flood was real and bulk shed FIRST: the bulk tenant absorbed
+# overload at its quota/bulk-capacity lines...
+bulk = h["tenants"]["bulky/bulk"]
+assert bulk["overloaded"] > 0, bulk
+assert h["shed_quota"] + h["shed_bulk_capacity"] > 0, h
+# ...while the interactive tier's p99 stayed inside its SLO
+p99 = h["interactive"]["p99_ms"]
+assert p99 is not None and p99 <= slo_ms, (p99, slo_ms)
+# the cold policy under skew still answered inside its deadline: no
+# errors, sheds bounded, real successes
+alt = h["tenants"]["web/interactive"]
+with lock:
+    alt_counts = dict(tallies["alt"])
+assert alt_counts["error"] == 0, alt_counts
+assert alt_counts["ok"] >= 20, alt_counts
+assert alt_counts["overloaded"] <= alt_counts["ok"], alt_counts
+# client-side totals reconcile with the router's answered counts
+with lock:
+    client_total = sum(sum(t.values()) for t in tallies.values())
+# (synthetic chaos bursts are router-side extras: answered >= client)
+assert h["answered_total"] >= client_total, (h["answered_total"], client_total)
+# scale-down mid-canary stranded NOTHING: every live replica attests the
+# bundle its dirs carry, and every bundle dir on disk (seed fleet,
+# autoscaler spawns, canary source) is params+json CONSISTENT
+for rep_row in h["replicas"]:
+    if rep_row["removed"]:
+        continue
+    for pol, mt in rep_row["policy_mtimes"].items():
+        assert mt is not None, rep_row
+import glob
+from d4pg_tpu.serve.bundle import load_bundle
+for bdir in ([f"{d}/mt_r{r}_{p}" for r in (0, 1) for p in ("def", "alt")]
+             + sorted(glob.glob(f"{d}/mt_autoscale/autoscale_r*"))):
+    load_bundle(bdir)  # raises on a half-deployed params/json mixture
+# no OTHER policy was touched by the default-policy rollout
+for p in ports:
+    rows = probe_healthz("127.0.0.1", p, timeout_s=5.0)["policies"]
+    assert rows["alt"]["params_reloads"] == 0, rows
+
+# graceful drains: rc 0 = sentinel per-policy bucket budgets + guards clean
+router.proc.send_signal(signal.SIGTERM)
+rc = router.proc.wait(timeout=180)
+assert rc == 0, f"mt router exit {rc}"
+for rid in (0, 1):
+    reps[rid].proc.send_signal(signal.SIGTERM)
+    rc = reps[rid].proc.wait(timeout=120)
+    assert rc == 0, f"mt replica {rid} exit {rc} (guards/sentinel not clean?)"
+
+print("CHAOS_SOAK_MT_OK", json.dumps({
+    "interactive_p99_ms": p99, "slo_ms": slo_ms,
+    "requests_total": h["requests_total"],
+    "shed_quota": h["shed_quota"],
+    "shed_bulk_capacity": h["shed_bulk_capacity"],
+    "ejections": h["ejections"], "admissions": h["admissions"],
+    "canary_rollbacks": h["canary_rollbacks"],
+    "canary_promotions": h["canary_promotions"],
+    "tenants": {k: v["requests"] for k, v in h["tenants"].items()},
+}))
 EOF
 
 echo "CHAOS_SOAK_OK"

@@ -1,0 +1,233 @@
+"""The healthz-driven autoscaler (`serve/autoscaler.py`): hysteresis,
+cooldown, never-scale-on-one-sample, floor/ceiling clamps, the forced
+chaos scale-down, and the signal adapters' gauge→signal mapping.
+
+Everything here drives :meth:`Autoscaler.tick` directly (no timer
+thread) so decisions are deterministic; the end-to-end
+subprocess-spawning path runs in chaos_soak.sh leg 7.
+"""
+
+import time
+
+import pytest
+
+from d4pg_tpu.serve.autoscaler import (
+    Autoscaler,
+    IngestSignalSource,
+    ScaleSignal,
+    ServingSignalSource,
+)
+
+
+class _Pool:
+    """Scripted actuators: counts calls, moves a replica gauge."""
+
+    def __init__(self, replicas=1):
+        self.replicas = replicas
+        self.ups = 0
+        self.downs = 0
+
+    def up(self):
+        self.ups += 1
+        self.replicas += 1
+        return True
+
+    def down(self):
+        self.downs += 1
+        self.replicas -= 1
+        return True
+
+
+def _scaler(pool, loads, **kw):
+    it = iter(loads)
+
+    def signal():
+        item = next(it)
+        if isinstance(item, ScaleSignal):
+            return item
+        return ScaleSignal(load=item, replicas=pool.replicas)
+
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    kw.setdefault("samples", 3)
+    kw.setdefault("cooldown_s", 0.0)
+    kw.setdefault("up_load", 0.8)
+    kw.setdefault("down_load", 0.3)
+    return Autoscaler(signal, pool.up, pool.down, **kw)
+
+
+def test_never_scales_on_one_sample():
+    pool = _Pool()
+    s = _scaler(pool, [0.95, 0.1, 0.95, 0.1, 0.95, 0.1])
+    for _ in range(6):
+        s.tick()
+    assert pool.ups == 0 and pool.downs == 0
+    # alternating breaches reset both streaks: no action ever fires
+
+
+def test_scales_up_after_k_consecutive_breaches():
+    pool = _Pool()
+    s = _scaler(pool, [0.9, 0.9, 0.9, 0.9])
+    assert [s.tick() for _ in range(4)] == [None, None, "up", None]
+    assert pool.ups == 1 and pool.replicas == 2
+    # the streak reset after acting: one more breach is not enough again
+
+
+def test_hysteresis_band_holds():
+    """Load between down_load and up_load: no action in either
+    direction, ever."""
+    pool = _Pool(replicas=2)
+    s = _scaler(pool, [0.5] * 10)
+    for _ in range(10):
+        assert s.tick() is None
+    assert pool.ups == 0 and pool.downs == 0
+
+
+def test_scales_down_after_k_quiet_samples_respecting_floor():
+    pool = _Pool(replicas=3)
+    s = _scaler(pool, [0.1] * 10, min_replicas=2)
+    acts = [s.tick() for _ in range(10)]
+    assert acts.count("down") == 1  # 3 -> 2, then pinned at the floor
+    assert pool.replicas == 2 and pool.downs == 1
+
+
+def test_ceiling_clamps_scale_up():
+    pool = _Pool(replicas=4)
+    s = _scaler(pool, [0.95] * 6, max_replicas=4)
+    for _ in range(6):
+        assert s.tick() is None
+    assert pool.ups == 0
+
+
+def test_cooldown_blocks_consecutive_actions():
+    pool = _Pool()
+    s = _scaler(pool, [0.9] * 20, cooldown_s=30.0, max_replicas=8)
+    acts = [s.tick() for _ in range(12)]
+    assert acts.count("up") == 1  # the second action sits out the cooldown
+    # expire the cooldown: the loop may act again
+    with s._lock:
+        s._last_action_t = time.monotonic() - 60.0
+    acts = [s.tick() for _ in range(3)]
+    assert acts.count("up") == 1
+
+
+def test_p99_slo_violation_breaches_even_at_low_load():
+    pool = _Pool()
+    sig = [ScaleSignal(load=0.2, p99_ms=500.0, replicas=1)] * 3
+    s = _scaler(pool, sig, p99_slo_ms=100.0)
+    assert [s.tick() for _ in range(3)] == [None, None, "up"]
+
+
+def test_shed_rate_breaches_toward_scale_up():
+    pool = _Pool()
+    sig = [ScaleSignal(load=0.2, shed_rate=0.2, replicas=1)] * 3
+    s = _scaler(pool, sig, shed_threshold=0.05)
+    assert [s.tick() for _ in range(3)] == [None, None, "up"]
+
+
+def test_signal_error_is_a_noop_sample_not_a_crash():
+    pool = _Pool()
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] % 2:
+            raise OSError("probe refused")
+        return ScaleSignal(load=0.9, replicas=pool.replicas)
+
+    s = Autoscaler(flaky, pool.up, pool.down, samples=2, cooldown_s=0.0)
+    acts = [s.tick() for _ in range(6)]
+    assert s.signal_errors == 3
+    # errored samples don't extend streaks; the 2 good breaches still act
+    assert acts.count("up") >= 1
+
+
+def test_chaos_forced_scaledown_bypasses_streaks_but_not_floor():
+    from d4pg_tpu.chaos import ChaosInjector, ChaosPlan
+
+    inj = ChaosInjector(ChaosPlan.parse("scaledown_during_canary@2"))
+    pool = _Pool(replicas=3)
+    s = _scaler(pool, [0.5] * 6, chaos=inj, min_replicas=2)
+    assert s.tick() is None          # tick 1: no fault, mid-band holds
+    assert s.tick() == "down"        # tick 2: forced, no streak needed
+    assert pool.downs == 1 and pool.replicas == 2
+    assert inj.injections_total == 1
+    # at the floor a forced scale-down is REFUSED: chaos must not be able
+    # to scale the fleet to zero
+    inj2 = ChaosInjector(ChaosPlan.parse("scaledown_during_canary@1"))
+    s2 = _scaler(pool, [0.5] * 2, chaos=inj2, min_replicas=2)
+    assert s2.tick() is None
+    assert pool.downs == 1
+
+
+def test_validation():
+    pool = _Pool()
+    with pytest.raises(ValueError, match="hysteresis"):
+        _scaler(pool, [], up_load=0.3, down_load=0.5)
+    with pytest.raises(ValueError, match="min_replicas"):
+        _scaler(pool, [], min_replicas=3, max_replicas=2)
+
+
+def test_control_thread_lifecycle():
+    pool = _Pool()
+    s = _scaler(pool, [0.5] * 1000, interval_s=0.01)
+    s.start()
+    time.sleep(0.1)
+    s.close(timeout=5)
+    assert s._thread is None and s.ticks >= 1
+    snap = s.snapshot()
+    assert snap["ticks"] == s.ticks and snap["scale_ups"] == 0
+
+
+# ----------------------------------------------------- signal adapters
+def test_serving_signal_maps_router_healthz():
+    rows = iter([
+        {"admitted": 2, "inflight": 8,
+         "capacity": {"total": 16},
+         "requests_total": 100, "replies_overloaded": 0,
+         "interactive": {"p99_ms": 12.0}, "p99_ms": 50.0},
+        {"admitted": 2, "inflight": 15,
+         "capacity": {"total": 16},
+         "requests_total": 200, "replies_overloaded": 20,
+         "interactive": {"p99_ms": 80.0}, "p99_ms": 90.0},
+    ])
+    src = ServingSignalSource(lambda: next(rows))
+    s1 = src()
+    assert s1.load == pytest.approx(0.5) and s1.replicas == 2
+    assert s1.p99_ms == 12.0  # the INTERACTIVE tier's p99, not aggregate
+    s2 = src()
+    # shed rate is the DELTA since the last sample: 20 sheds / 100 new reqs
+    assert s2.shed_rate == pytest.approx(0.2)
+    assert s2.load == pytest.approx(15 / 16)
+
+
+def test_serving_signal_without_capacity_model_falls_back():
+    src = ServingSignalSource(lambda: {
+        "admitted": 2, "inflight": 3, "capacity": {"total": 0},
+        "requests_total": 1, "replies_overloaded": 0,
+    })
+    assert src().load == pytest.approx(1.5)
+
+
+def test_ingest_signal_starved_scales_up_shedding_scales_down(monkeypatch):
+    t = {"now": 100.0}
+    monkeypatch.setattr(time, "monotonic", lambda: t["now"])
+    rows = iter([
+        {"windows_ingested": 0, "windows_shed": 0, "connections": 1},
+        # 10 s later: only 20 windows/s against a 100 w/s target — starved
+        {"windows_ingested": 200, "windows_shed": 0, "connections": 1},
+        # later: the learner sheds most of what arrives — overprovisioned
+        {"windows_ingested": 210, "windows_shed": 500, "connections": 4},
+    ])
+    src = IngestSignalSource(lambda: next(rows), target_windows_per_s=100.0)
+    first = src()
+    assert first.load == 1.0  # no rate yet: hold
+    t["now"] += 10.0
+    starved = src()
+    assert starved.load == pytest.approx(5.0)  # 100 target / 20 observed
+    t["now"] += 10.0
+    shedding = src()
+    assert shedding.load == 0.0 and shedding.shed_rate > 0.9
+    assert shedding.replicas == 4
+    with pytest.raises(ValueError):
+        IngestSignalSource(lambda: {}, target_windows_per_s=0)

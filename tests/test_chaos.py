@@ -47,8 +47,26 @@ def test_plan_parse_full_syntax():
     assert p.entries[2] == ChaosEntry("worker_kill", 12, None, 1)
 
 
+def test_plan_parse_multitenant_sites_and_string_label():
+    """tenant_flood's ``:arg`` is a string LABEL (the tenant name), not a
+    float; the other new sites keep numeric/absent args. str() roundtrips
+    the label form (the injector's fired-entry logging)."""
+    p = ChaosPlan.parse(
+        "tenant_flood@30:bulky;policy_skew@40;scaledown_during_canary@3"
+    )
+    flood = p.entries[0]
+    assert flood == ChaosEntry("tenant_flood", 30, None, None, "bulky")
+    assert flood.arg is None and flood.label == "bulky"
+    assert str(flood) == "tenant_flood@30:bulky"
+    assert p.entries[1] == ChaosEntry("policy_skew", 40)
+    assert p.entries[2] == ChaosEntry("scaledown_during_canary", 3)
+    # a numeric-looking label on a LABEL site stays a string
+    assert ChaosPlan.parse("tenant_flood@1:42").entries[0].label == "42"
+
+
 @pytest.mark.parametrize(
-    "bad", ["boom@3", "env_raise@zero", "env_raise@0", "env_raise", "@3"]
+    "bad", ["boom@3", "env_raise@zero", "env_raise@0", "env_raise", "@3",
+            "policy_skew@2:notanumber"]
 )
 def test_plan_parse_rejects_malformed(bad):
     with pytest.raises(ValueError):

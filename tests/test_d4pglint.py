@@ -42,6 +42,7 @@ HELLO = 7
 HELLO_OK = 8
 WINDOWS = 9
 WINDOWS_OK = 10
+ACT2 = 11
 
 
 class ProtocolError(Exception):
@@ -66,6 +67,14 @@ def encode_act(obs, deadline_us=0):
 
 def decode_act(payload, obs_dim):
     return payload, 0
+
+
+def encode_act2(obs, deadline_us=0, policy_id="default", qos=0, tenant=""):
+    return b""
+
+
+def decode_act2(payload):
+    return payload, 0, "default", 0, ""
 
 
 def encode_action(action):

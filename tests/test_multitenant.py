@@ -1,0 +1,627 @@
+"""Multi-tenant serving (ISSUE 12): the versioned request frame
+(policy-id + QoS + tenant), the multi-policy PolicyServer, the router's
+per-tenant quotas + class-aware shed, and the per-policy canary
+machinery's isolation contract.
+
+Protocol backward compat is pinned at the BYTE level: a PR-8-era client
+(v1 frames, no policy-id field) against the new server must see
+byte-identical replies, and a new client against an old server must fail
+loudly with a clear protocol-version error, never a decode crash.
+"""
+
+import os
+import socket
+import struct
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from d4pg_tpu.agent import act_deterministic
+from d4pg_tpu.agent.state import D4PGConfig
+from d4pg_tpu.serve import PolicyBundle, PolicyClient, PolicyServer, Router
+from d4pg_tpu.serve import protocol
+from d4pg_tpu.serve.bundle import actor_template, export_bundle, load_bundle
+from d4pg_tpu.serve.client import Overloaded, ServerError
+from d4pg_tpu.serve.protocol import ProtocolError
+
+CFG = D4PGConfig(obs_dim=4, action_dim=2, hidden_sizes=(8, 8))
+CFG_ALT = D4PGConfig(obs_dim=3, action_dim=2, hidden_sizes=(8, 8))
+OBS = np.array([0.1, -0.2, 0.05, 0.3], np.float32)
+OBS_ALT = np.array([0.1, -0.2, 0.05], np.float32)
+PARAMS = actor_template(CFG)
+PARAMS_ALT = actor_template(CFG_ALT)
+
+
+def _bundle(config=CFG, params=None, path=None):
+    return PolicyBundle(
+        config=config,
+        actor_params=params if params is not None else (
+            PARAMS if config is CFG else PARAMS_ALT
+        ),
+        action_low=np.full(2, -1.0, np.float32),
+        action_high=np.full(2, 1.0, np.float32),
+        obs_norm=None,
+        meta={"source": "test"},
+        path=path,
+    )
+
+
+def _ref(params, obs=OBS, config=CFG):
+    return np.clip(
+        np.asarray(act_deterministic(config, params, obs[None])[0]), -1.0, 1.0
+    )
+
+
+def _wait(cond, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _server(bundle=None, policies=None, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_wait_us", 200)
+    kw.setdefault("watch_bundle", False)
+    srv = PolicyServer(
+        bundle if bundle is not None else _bundle(),
+        port=0,
+        policies=policies,
+        **kw,
+    )
+    srv.start()
+    return srv
+
+
+# ------------------------------------------------------------ wire codec
+def test_act2_codec_roundtrip():
+    payload = protocol.encode_act2(
+        OBS, 12345, policy_id="alt", qos=protocol.QOS_BULK, tenant="team-a"
+    )
+    obs, deadline, pid, qos, tenant = protocol.decode_act2(payload)
+    np.testing.assert_array_equal(obs, OBS)
+    assert (deadline, pid, qos, tenant) == (12345, "alt", protocol.QOS_BULK,
+                                            "team-a")
+    # empty ids fall back to the default policy / anonymous tenant
+    obs, _, pid, qos, tenant = protocol.decode_act2(
+        protocol.encode_act2(OBS)
+    )
+    assert pid == protocol.DEFAULT_POLICY and tenant == ""
+    assert qos == protocol.QOS_INTERACTIVE
+
+
+def test_act2_codec_rejects_malformed():
+    with pytest.raises(ProtocolError, match="qos"):
+        protocol.encode_act2(OBS, qos=7)
+    with pytest.raises(ProtocolError, match="header"):
+        protocol.decode_act2(b"\x00\x01")
+    good = protocol.encode_act2(OBS, policy_id="alt")
+    with pytest.raises(ProtocolError, match="qos"):
+        protocol.decode_act2(b"\x09" + good[1:])
+    with pytest.raises(ProtocolError, match="float32"):
+        protocol.decode_act2(good[:-2])
+    with pytest.raises(ProtocolError, match="declare"):
+        # policy_len says 200 bytes but the payload ends first
+        protocol.decode_act2(struct.pack("<BBBBI", 0, 200, 0, 0, 0) + b"abc")
+
+
+def test_act2_frames_carry_version_2_plain_frames_version_1():
+    """The per-type frame-version floor: only ACT2 advertises v2, so the
+    whole v1 sublanguage stays byte-compatible with PR-8 peers."""
+    a, b = socket.socketpair()
+    try:
+        protocol.write_frame(a, protocol.ACT, 1, protocol.encode_act(OBS))
+        hdr = b.recv(protocol.HEADER.size, socket.MSG_WAITALL)
+        assert protocol.HEADER.unpack(hdr)[1] == 1  # version byte
+        b.recv(1 << 16)
+        protocol.write_frame(a, protocol.ACT2, 2, protocol.encode_act2(OBS))
+        hdr = b.recv(protocol.HEADER.size, socket.MSG_WAITALL)
+        assert protocol.HEADER.unpack(hdr)[1] == 2
+    finally:
+        a.close()
+        b.close()
+
+
+# ------------------------------------------------- backward-compat pins
+def _raw_v1_act(port, obs, req_id=7):
+    """A PR-8-era client, byte for byte: version-1 header, ACT payload =
+    deadline u32 + obs f32s. Returns the raw reply frame bytes."""
+    payload = struct.pack("<I", 0) + np.asarray(obs, np.float32).tobytes()
+    frame = protocol.HEADER.pack(
+        protocol.MAGIC, 1, protocol.ACT, req_id, len(payload)
+    ) + payload
+    with socket.create_connection(("127.0.0.1", port), timeout=10) as s:
+        s.sendall(frame)
+        s.settimeout(10)
+        hdr = s.recv(protocol.HEADER.size, socket.MSG_WAITALL)
+        magic, version, msg_type, rid, length = protocol.HEADER.unpack(hdr)
+        body = s.recv(length, socket.MSG_WAITALL) if length else b""
+    return hdr + body, (magic, version, msg_type, rid, body)
+
+
+def test_old_client_gets_default_policy_with_identical_reply_bytes():
+    """The hard compat requirement: a v1 client against the multi-policy
+    server lands on the DEFAULT policy and its reply frame is the exact
+    byte sequence a PR-8 server would have produced — version byte 1,
+    ACT_OK, echoed req_id, the default policy's action as f32s."""
+    srv = _server(policies={"alt": _bundle(CFG_ALT)})
+    try:
+        raw, (magic, version, msg_type, rid, body) = _raw_v1_act(
+            srv.port, OBS, req_id=42
+        )
+        assert magic == protocol.MAGIC and version == 1
+        assert msg_type == protocol.ACT_OK and rid == 42
+        # the default policy's action, as served to a CURRENT client over
+        # the same wire — the old client's frame must be the same bytes
+        # modulo the echoed req_id (version byte 1 included)
+        with PolicyClient("127.0.0.1", srv.port) as c:
+            served = c.act(OBS)
+        np.testing.assert_allclose(served, _ref(PARAMS), rtol=1e-5, atol=1e-6)
+        expected = protocol.HEADER.pack(
+            protocol.MAGIC, 1, protocol.ACT_OK, 42, len(body)
+        ) + protocol.encode_action(served)
+        assert raw == expected  # byte-for-byte the PR-8 reply
+    finally:
+        srv.drain()
+
+
+def _old_server(port_box, stop):
+    """A PR-8-era server's read side, faithfully: version != 1 raises the
+    protocol error, answered ERROR + close — the behavior a new client
+    must surface as a clear version error."""
+    lsock = socket.create_server(("127.0.0.1", 0))
+    port_box.append(lsock.getsockname()[1])
+    lsock.settimeout(30)
+    try:
+        conn, _ = lsock.accept()
+        with conn:
+            hdr = conn.recv(protocol.HEADER.size, socket.MSG_WAITALL)
+            magic, version, msg_type, req_id, length = (
+                protocol.HEADER.unpack(hdr)
+            )
+            if length:
+                conn.recv(length, socket.MSG_WAITALL)
+            if version != 1:
+                msg = f"protocol version {version} (this server speaks 1)"
+                conn.sendall(protocol.HEADER.pack(
+                    protocol.MAGIC, 1, protocol.ERROR, 0, len(msg)
+                ) + msg.encode())
+    finally:
+        lsock.close()
+        stop.set()
+
+
+def test_new_client_against_old_server_fails_with_clear_version_error():
+    port_box, stop = [], threading.Event()
+    t = threading.Thread(
+        target=_old_server, args=(port_box, stop),
+        name="old-server", daemon=True,
+    )
+    t.start()
+    _wait(lambda: port_box, msg="old server port")
+    with PolicyClient("127.0.0.1", port_box[0], timeout=10) as c:
+        with pytest.raises(ServerError, match="protocol version"):
+            c.act(OBS, policy_id="alt")
+    t.join(timeout=10)
+
+
+# ------------------------------------------------- multi-policy server
+def test_server_routes_policies_independently():
+    """Two resident policies with different shapes and params: each ACT2
+    lands on ITS policy's batcher, v1 ACT lands on the default, and the
+    per-policy healthz rows carry independent stats."""
+    srv = _server(policies={"alt": _bundle(CFG_ALT)})
+    try:
+        with PolicyClient("127.0.0.1", srv.port) as c:
+            np.testing.assert_allclose(
+                c.act(OBS), _ref(PARAMS), rtol=1e-5, atol=1e-6
+            )
+            np.testing.assert_allclose(
+                c.act(OBS_ALT, policy_id="alt"),
+                _ref(PARAMS_ALT, OBS_ALT, CFG_ALT),
+                rtol=1e-5, atol=1e-6,
+            )
+            # unknown policy: per-request ERROR, the connection SURVIVES
+            with pytest.raises(ServerError, match="unknown policy"):
+                c.act(OBS, policy_id="nope")
+            # wrong obs dim for a resident policy: same contract
+            with pytest.raises(ServerError, match="wants 3"):
+                c.act(OBS, policy_id="alt")
+            np.testing.assert_allclose(
+                c.act(OBS), _ref(PARAMS), rtol=1e-5, atol=1e-6
+            )
+            h = c.healthz()
+        rows = h["policies"]
+        assert set(rows) == {"default", "alt"}
+        assert rows["default"]["obs_dim"] == 4 and rows["alt"]["obs_dim"] == 3
+        assert rows["default"]["replies_ok"] == 2
+        assert rows["alt"]["replies_ok"] == 1
+        assert h["unknown_policy"] == 1
+        # aggregate compile_count sums every policy's bucket programs
+        assert h["compile_count"] == sum(
+            len(p.batcher.buckets) for p in srv._policies.values()
+        )
+    finally:
+        srv.drain()
+
+
+def test_per_policy_hot_reload_is_isolated(tmp_path):
+    """Re-exporting policy B's bundle reloads B only: A's params_reloads
+    stays 0, A's serving params unchanged, and only B's version vector
+    (policies row bundle_mtime) advances."""
+    d_def = str(tmp_path / "def")
+    d_alt = str(tmp_path / "alt")
+    export_bundle(d_def, CFG, PARAMS)
+    export_bundle(d_alt, CFG_ALT, PARAMS_ALT)
+    srv = _server(
+        bundle=load_bundle(d_def),
+        policies={"alt": load_bundle(d_alt)},
+        watch_bundle=True,
+    )
+    try:
+        before = srv.healthz()["policies"]
+        new_alt = jax.tree_util.tree_map(lambda x: x + 0.5, PARAMS_ALT)
+        time.sleep(0.05)  # ensure a distinct mtime
+        export_bundle(d_alt, CFG_ALT, new_alt)
+        assert srv.check_reload() is True
+        h = srv.healthz()["policies"]
+        assert h["alt"]["params_reloads"] == 1
+        assert h["default"]["params_reloads"] == 0
+        assert h["alt"]["bundle_mtime"] != before["alt"]["bundle_mtime"]
+        assert h["default"]["bundle_mtime"] == before["default"]["bundle_mtime"]
+        with PolicyClient("127.0.0.1", srv.port) as c:
+            np.testing.assert_allclose(
+                c.act(OBS), _ref(PARAMS), rtol=1e-5, atol=1e-6
+            )
+            np.testing.assert_allclose(
+                c.act(OBS_ALT, policy_id="alt"),
+                _ref(new_alt, OBS_ALT, CFG_ALT),
+                rtol=1e-5, atol=1e-6,
+            )
+    finally:
+        srv.drain()
+
+
+# ---------------------------------------------------- router admission
+def _router(servers, **kw):
+    kw.setdefault("probe_interval_s", 0.05)
+    kw.setdefault("probe_timeout_s", 1.0)
+    kw.setdefault("readmit_after", 1)
+    r = Router([("127.0.0.1", s.port) for s in servers], port=0, **kw)
+    r.start()
+    r.wait_for_replicas(len(servers), timeout_s=60)
+    return r
+
+
+def test_tenant_quota_sheds_with_exact_per_tenant_identity():
+    srv = _server()
+    router = _router([srv], tenant_quotas={"greedy": (1.0, 2.0)})
+    try:
+        with PolicyClient("127.0.0.1", router.port) as c:
+            outcomes = {"ok": 0, "quota": 0}
+            for _ in range(6):
+                try:
+                    c.act(OBS, tenant="greedy")
+                    outcomes["ok"] += 1
+                except Overloaded as e:
+                    assert str(e) == "quota"
+                    outcomes["quota"] += 1
+            for _ in range(3):
+                c.act(OBS, tenant="modest")  # untouched by greedy's bucket
+            h = c.healthz()
+        assert outcomes["quota"] >= 1 and outcomes["ok"] >= 2, outcomes
+        rows = h["tenants"]
+        assert rows["greedy/interactive"]["overloaded"] == outcomes["quota"]
+        assert rows["modest/interactive"] == {
+            "requests": 3, "ok": 3, "overloaded": 0, "error": 0, "answered": 3,
+        }
+        for key, row in rows.items():
+            assert row["requests"] == row["answered"], (key, row)
+        assert h["requests_total"] == h["answered_total"]
+        assert h["shed_quota"] == outcomes["quota"]
+    finally:
+        router.drain()
+        srv.drain()
+
+
+def test_bulk_sheds_first_interactive_admitted_to_capacity():
+    """The shed-ordering contract, driven through the REAL wiring: pin
+    fleet inflight above the bulk line but below capacity — bulk sheds
+    ``bulk_capacity`` while interactive is still admitted; above
+    capacity, interactive sheds ``capacity`` too."""
+    srv = _server()
+    router = _router([srv], replica_capacity=10, bulk_fraction=0.5)
+    try:
+        rep = router._replicas[0]
+        with PolicyClient("127.0.0.1", router.port) as c:
+            with router._lock:
+                rep.inflight += 6          # between bulk line (5) and cap
+            try:
+                with pytest.raises(Overloaded, match="bulk_capacity"):
+                    c.act(OBS, qos="bulk", tenant="batch")
+                c.act(OBS, tenant="web")   # interactive still admitted
+                with router._lock:
+                    rep.inflight += 4      # now at capacity (10)
+                with pytest.raises(Overloaded, match="capacity"):
+                    c.act(OBS, tenant="web")
+            finally:
+                with router._lock:
+                    rep.inflight -= 10
+            c.act(OBS, qos="bulk", tenant="batch")  # admitted again
+            h = c.healthz()
+        assert h["shed_bulk_capacity"] == 1 and h["shed_capacity"] == 1
+        assert h["capacity"]["total"] == 10 and h["capacity"]["bulk_limit"] == 5
+        for key, row in h["tenants"].items():
+            assert row["requests"] == row["answered"], (key, row)
+    finally:
+        router.drain()
+        srv.drain()
+
+
+def test_router_routes_policy_to_hosting_replicas_only():
+    """Replica 0 hosts default only; replica 1 hosts default+alt: every
+    alt request lands on replica 1, default traffic spreads."""
+    s0 = _server()
+    s1 = _server(policies={"alt": _bundle(CFG_ALT)})
+    router = _router([s0, s1])
+    try:
+        _wait(
+            lambda: "alt" in router._obs_dims,
+            msg="router learns the alt policy from probes",
+        )
+        with PolicyClient("127.0.0.1", router.port) as c:
+            for _ in range(6):
+                np.testing.assert_allclose(
+                    c.act(OBS_ALT, policy_id="alt"),
+                    _ref(PARAMS_ALT, OBS_ALT, CFG_ALT),
+                    rtol=1e-5, atol=1e-6,
+                )
+            for _ in range(6):
+                c.act(OBS)
+            h = c.healthz()
+        assert s1.healthz()["policies"]["alt"]["replies_ok"] == 6
+        assert h["replicas"][0]["policies"] == ["default"]
+        assert sorted(h["replicas"][1]["policies"]) == ["alt", "default"]
+        # default traffic used both replicas
+        assert all(r["ok"] >= 3 for r in h["replicas"]), h["replicas"]
+    finally:
+        router.drain()
+        s0.drain()
+        s1.drain()
+
+
+def test_tenant_flood_chaos_injects_identity_accounted_burst():
+    from d4pg_tpu.chaos import ChaosInjector, ChaosPlan
+
+    inj = ChaosInjector(ChaosPlan.parse("tenant_flood@2:bulky"))
+    srv = _server()
+    router = _router(
+        [srv],
+        chaos=inj,
+        tenant_quotas={"bulky": (2.0, 4.0)},
+        flood_burst=25,
+    )
+    try:
+        with PolicyClient("127.0.0.1", router.port) as c:
+            c.act(OBS, tenant="web")
+            c.act(OBS, tenant="web")  # 2nd request fires the flood
+            assert inj.injections_total == 1
+            _wait(
+                lambda: (
+                    lambda h: h["requests_total"] == h["answered_total"]
+                    and h["requests_total"] >= 27
+                )(router.healthz()),
+                msg="flood answered",
+            )
+            h = c.healthz()
+        row = h["tenants"]["bulky/bulk"]
+        assert row["requests"] == 25 == row["answered"]
+        # the quota absorbed most of the burst before any replica saw it
+        assert row["overloaded"] >= 20, row
+        assert any(
+            e["event"] == "chaos_tenant_flood" for e in h["events_tail"]
+        )
+    finally:
+        router.drain()
+        srv.drain()
+
+
+# -------------------------------------------- per-policy canary isolation
+def _two_policy_fleet(tmp_path, canary_policy="alt", chaos=None, **router_kw):
+    """Two replicas, each serving default+alt from their OWN bundle dirs,
+    plus a canary source for one policy."""
+    dirs = []
+    servers = []
+    for i in range(2):
+        d_def = str(tmp_path / f"r{i}_def")
+        d_alt = str(tmp_path / f"r{i}_alt")
+        export_bundle(d_def, CFG, PARAMS)
+        export_bundle(d_alt, CFG_ALT, PARAMS_ALT)
+        dirs.append({"default": d_def, "alt": d_alt})
+        srv = _server(
+            bundle=load_bundle(d_def),
+            policies={"alt": load_bundle(d_alt)},
+            watch_bundle=True,
+            poll_interval_s=0.05,
+        )
+        servers.append(srv)
+    canary_dir = str(tmp_path / "canary")
+    cfg = CFG_ALT if canary_policy == "alt" else CFG
+    base = PARAMS_ALT if canary_policy == "alt" else PARAMS
+    new_params = jax.tree_util.tree_map(lambda x: x + 0.5, base)
+    export_bundle(canary_dir, cfg, new_params)
+    router = Router(
+        [("127.0.0.1", s.port) for s in servers],
+        port=0,
+        bundle_dirs=dirs,
+        probe_interval_s=0.05,
+        probe_timeout_s=1.0,
+        readmit_after=2,
+        canary_bundle={canary_policy: canary_dir},
+        canary_fraction=0.5,
+        canary_min_samples=5,
+        canary_window=64,
+        canary_attest_timeout_s=20.0,
+        chaos=chaos,
+        **router_kw,
+    )
+    router.start()
+    router.wait_for_replicas(2, timeout_s=60)
+    return servers, router, dirs, new_params
+
+
+def test_per_policy_canary_promotes_without_touching_other_policy(tmp_path):
+    servers, router, dirs, new_alt = _two_policy_fleet(tmp_path)
+    try:
+        state = lambda: router.healthz()["rollouts"]["alt"]["state"]  # noqa: E731
+        _wait(lambda: state() != "idle", msg="alt rollout start")
+        ref_old = _ref(PARAMS_ALT, OBS_ALT, CFG_ALT)
+        ref_new = _ref(new_alt, OBS_ALT, CFG_ALT)
+        with PolicyClient("127.0.0.1", router.port) as c:
+            for _ in range(600):
+                a = c.act(OBS_ALT, policy_id="alt", timeout=30)
+                assert np.allclose(a, ref_old, atol=1e-5) or np.allclose(
+                    a, ref_new, atol=1e-5
+                ), a
+                # default-policy traffic interleaves and must NEVER see
+                # anything but the default params
+                np.testing.assert_allclose(
+                    c.act(OBS, timeout=30), _ref(PARAMS), rtol=1e-5, atol=1e-6
+                )
+                if state() == "idle":
+                    break
+                time.sleep(0.01)
+            _wait(lambda: state() == "idle", msg="alt rollout settle")
+            h = c.healthz()
+        assert h["canary_promotions"] == 1 and h["canary_rollbacks"] == 0
+        # the back-compat "canary" view is the DEFAULT policy's rollout —
+        # no default rollout configured, so it reads idle throughout
+        assert h["canary"]["state"] == "idle"
+        # THE isolation pin: no replica ever reloaded the OTHER policy
+        for s in servers:
+            rows = s.healthz()["policies"]
+            assert rows["default"]["params_reloads"] == 0
+            assert rows["alt"]["params_reloads"] >= 1
+    finally:
+        router.drain()
+        for s in servers:
+            s.drain()
+
+
+def test_per_policy_canary_rollback_leaves_other_policy_untouched(tmp_path):
+    from d4pg_tpu.chaos import ChaosInjector, ChaosPlan
+
+    inj = ChaosInjector(ChaosPlan.parse("canary_corrupt@1"))
+    servers, router, dirs, _new = _two_policy_fleet(tmp_path, chaos=inj)
+    try:
+        _wait(
+            lambda: router.stats.canary_rollbacks >= 1,
+            msg="auto-rollback on corrupt alt canary",
+        )
+        _wait(
+            lambda: (
+                lambda h: h["rollouts"]["alt"]["state"] == "idle"
+                and h["admitted"] == 2
+            )(router.healthz()),
+            msg="rollback settle + re-admission",
+        )
+        # the acceptance pin: a rollback on policy alt leaves every other
+        # policy's replicas with params_reloads == 0
+        for s in servers:
+            rows = s.healthz()["policies"]
+            assert rows["default"]["params_reloads"] == 0
+        with PolicyClient("127.0.0.1", router.port) as c:
+            for _ in range(4):
+                np.testing.assert_allclose(
+                    c.act(OBS_ALT, policy_id="alt"),
+                    _ref(PARAMS_ALT, OBS_ALT, CFG_ALT),
+                    rtol=1e-5, atol=1e-6,
+                )
+                np.testing.assert_allclose(
+                    c.act(OBS), _ref(PARAMS), rtol=1e-5, atol=1e-6
+                )
+    finally:
+        router.drain()
+        for s in servers:
+            s.drain()
+
+
+# ------------------------------------------------- elastic fleet seams
+def test_add_and_remove_backend_at_runtime():
+    s0 = _server()
+    router = _router([s0])
+    s1 = _server()
+    try:
+        idx = router.add_backend("127.0.0.1", s1.port)
+        _wait(lambda: router.healthz()["admitted"] == 2, msg="admission")
+        with PolicyClient("127.0.0.1", router.port) as c:
+            for _ in range(8):
+                c.act(OBS)
+            h = c.healthz()
+            assert all(r["ok"] >= 2 for r in h["replicas"]), h["replicas"]
+            router.remove_backend(idx)
+            assert router.healthz()["admitted"] == 1
+            for _ in range(4):
+                c.act(OBS)  # the survivor keeps serving
+            h = c.healthz()
+        assert h["replicas"][idx]["removed"] is True
+        assert h["requests_total"] == h["answered_total"]
+    finally:
+        router.drain()
+        s0.drain()
+        s1.drain()
+
+
+def test_scaledown_mid_canary_aborts_cleanly_never_strands(tmp_path):
+    """THE scale-down chaos contract: removing the canary replica while
+    its rollout is live must abort the rollout through the normal
+    rollback — its bundle dir is RESTORED to the old content (nothing
+    half-deployed survives on disk), the other replica is untouched, and
+    the rollout machine returns to idle (no stuck gates)."""
+    servers, router, dirs, _new = _two_policy_fleet(tmp_path)
+    try:
+        _wait(
+            lambda: router.healthz()["rollouts"]["alt"]["state"] != "idle",
+            msg="rollout start",
+        )
+        # the canary is the highest-index eligible replica: replica 1
+        canary_idx = 1
+        _wait(
+            lambda: "alt" in router._replicas[canary_idx].canary_for,
+            msg="canary marked",
+        )
+        old_doc = open(os.path.join(dirs[canary_idx]["alt"], "bundle.json")).read()
+        # scale-down: drain the process, then deregister (the autoscaler's
+        # exact call order)
+        servers[canary_idx].drain()
+        router.remove_backend(canary_idx)
+        _wait(
+            lambda: router.healthz()["rollouts"]["alt"]["state"] == "idle",
+            msg="rollout aborted/settled after scale-down",
+        )
+        h = router.healthz()
+        assert h["canary_rollbacks"] >= 1 and h["canary_promotions"] == 0
+        assert not router._readmit_gate, router._readmit_gate
+        # the removed replica's bundle dir was restored — byte-identical
+        # json to the pre-rollout bundle, loadable params
+        restored = open(
+            os.path.join(dirs[canary_idx]["alt"], "bundle.json")
+        ).read()
+        assert restored == old_doc
+        load_bundle(dirs[canary_idx]["alt"])  # params + json consistent
+        # the surviving replica never reloaded anything
+        rows = servers[0].healthz()["policies"]
+        assert rows["alt"]["params_reloads"] == 0
+        assert rows["default"]["params_reloads"] == 0
+        # and the fleet still serves both policies
+        with PolicyClient("127.0.0.1", router.port) as c:
+            c.act(OBS)
+            c.act(OBS_ALT, policy_id="alt")
+    finally:
+        router.drain()
+        servers[0].drain()

@@ -415,7 +415,15 @@ def test_promote_io_error_rolls_back_whole_rollout(tmp_path):
         os.remove(os.path.join(str(tmp_path / "canary"), "actor_params.npz"))
         with PolicyClient("127.0.0.1", router.port) as c:
             for _ in range(400):
-                c.act(OBS, timeout=30)
+                try:
+                    c.act(OBS, timeout=30)
+                except Overloaded:
+                    # the rollback re-ejects EVERY touched replica, and
+                    # this rollout touched both (canary + the backed-up
+                    # promote target): a transient all-ejected window
+                    # answering OVERLOADED(no_replicas) is the documented
+                    # honest behavior, not a failure of this test
+                    pass
                 if router.stats.canary_rollbacks >= 1:
                     break
                 time.sleep(0.01)

@@ -63,6 +63,10 @@ HOST_ONLY_MODULES = (
     # replicas own the devices, the router must restart in milliseconds —
     # a JAX import here would also break the soak's kill/restart timing.
     "d4pg_tpu/serve/router.py",
+    # The autoscaler runs beside (or inside) the router process under the
+    # same restart-in-milliseconds contract: it moves signals and spawns/
+    # drains processes, never tensors.
+    "d4pg_tpu/serve/autoscaler.py",
     # The collection fleet: actor hosts run env + a NumPy policy and must
     # never pull the JAX runtime (the whole point of the numpy-policy
     # contract); the ingest server is constructed by the trainer before
@@ -108,6 +112,10 @@ HOT_PATH_FUNCTIONS = (
     "d4pg_tpu/serve/batcher.py::DynamicBatcher._reply_loop",
     "d4pg_tpu/serve/batcher.py::DynamicBatcher.submit",
     "d4pg_tpu/serve/router.py::Router._pick",
+    # the multi-tenant admission check runs once per request BEFORE
+    # dispatch: one lock hop, token-bucket float math, zero numpy
+    # allocation (ISSUE-12 satellite)
+    "d4pg_tpu/serve/router.py::Router._admit_tenant",
 )
 
 # The jit-traced bodies of the device-resident data plane (the megastep

@@ -95,6 +95,78 @@ def check_router_microbench(path: str) -> list[str]:
     return errs
 
 
+def check_multitenant_microbench(path: str) -> list[str]:
+    """Shape check for ``benchmarks/multitenant_microbench.json`` beyond
+    the generic benchmark rule: the ISSUE-12 acceptance parses these
+    exact fields — and a committed artifact can never attest a broken
+    isolation claim (``isolation_ok``), a broken per-tenant accounting
+    identity, or rps that failed to scale with the autoscaled replica
+    count."""
+    errs = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: unreadable/invalid JSON ({e})"]
+    for key in ("backend", "isolation", "autoscale_scaling",
+                "ratio_repeats", "infer_delay_ms"):
+        if key not in doc:
+            errs.append(f"{path}: missing top-level key {key!r}")
+    iso = doc.get("isolation")
+    if not isinstance(iso, dict):
+        errs.append(f"{path}: 'isolation' must be an object")
+    else:
+        for key in ("isolation_ok", "interactive_p99_ms", "slo_ms",
+                    "bulk_shed_rate", "tenants", "tenant_identity_ok",
+                    "router_identity_ok"):
+            if key not in iso:
+                errs.append(f"{path}: isolation missing {key!r}")
+        if iso.get("isolation_ok") is not True:
+            errs.append(
+                f"{path}: isolation.isolation_ok is "
+                f"{iso.get('isolation_ok')!r} — a committed artifact can "
+                "never attest a bulk flood moving interactive p99 past "
+                "its SLO"
+            )
+        if iso.get("tenant_identity_ok") is not True or (
+            iso.get("router_identity_ok") is not True
+        ):
+            errs.append(
+                f"{path}: per-tenant/router accounting identity not "
+                "attested true"
+            )
+        for name, row in (iso.get("tenants") or {}).items():
+            if row.get("requests") != row.get("answered"):
+                errs.append(
+                    f"{path}: tenants[{name!r}] requests "
+                    f"({row.get('requests')}) != answered "
+                    f"({row.get('answered')}) — identity broken in the "
+                    "committed rows"
+                )
+    scal = doc.get("autoscale_scaling")
+    if not isinstance(scal, dict):
+        errs.append(f"{path}: 'autoscale_scaling' must be an object")
+    else:
+        for key in ("rps_1_replica", "rps_2_replicas", "scaling_2_over_1",
+                    "scale_ups", "identity_ok"):
+            if key not in scal:
+                errs.append(f"{path}: autoscale_scaling missing {key!r}")
+        if scal.get("identity_ok") is not True:
+            errs.append(
+                f"{path}: autoscale_scaling.identity_ok not attested true"
+            )
+        if not (
+            isinstance(scal.get("scaling_2_over_1"), (int, float))
+            and scal["scaling_2_over_1"] > 1.0
+        ):
+            errs.append(
+                f"{path}: autoscale_scaling.scaling_2_over_1 is "
+                f"{scal.get('scaling_2_over_1')!r} — the committed "
+                "artifact must show rps scaling with replica count"
+            )
+    return errs
+
+
 def check_shard_microbench(path: str) -> list[str]:
     """Shape check for ``benchmarks/shard_microbench.json`` beyond the
     generic benchmark rule: the ISSUE-9 acceptance parses these exact
@@ -271,6 +343,8 @@ def check_tree(root: str) -> list[str]:
         errs.extend(check_benchmark_json(path))
         if os.path.basename(path) == "router_microbench.json":
             errs.extend(check_router_microbench(path))
+        if os.path.basename(path) == "multitenant_microbench.json":
+            errs.extend(check_multitenant_microbench(path))
         if os.path.basename(path) == "shard_microbench.json":
             errs.extend(check_shard_microbench(path))
     for path in sorted(

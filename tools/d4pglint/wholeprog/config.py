@@ -14,8 +14,9 @@ from __future__ import annotations
 PROTOCOL_MODULE = "d4pg_tpu/serve/protocol.py"
 
 # Names in the protocol module that look like frame-constants but are NOT
-# message-type ids.
-PROTOCOL_NON_IDS = ("PROTOCOL_VERSION", "MAX_PAYLOAD")
+# message-type ids (QOS_* are ACT2 payload field values).
+PROTOCOL_NON_IDS = ("PROTOCOL_VERSION", "MAX_PAYLOAD",
+                    "QOS_INTERACTIVE", "QOS_BULK")
 
 # Message id -> (payload encoder, payload decoder). ``module.py::func``
 # names a codec function that must exist; the literals mean:
@@ -26,6 +27,10 @@ PROTOCOL_NON_IDS = ("PROTOCOL_VERSION", "MAX_PAYLOAD")
 PROTOCOL_CODECS = {
     "ACT": ("d4pg_tpu/serve/protocol.py::encode_act",
             "d4pg_tpu/serve/protocol.py::decode_act"),
+    # the v2 multi-tenant request (policy_id + QoS + tenant); rides frame
+    # version 2 via protocol.py:_FRAME_MIN_VERSION
+    "ACT2": ("d4pg_tpu/serve/protocol.py::encode_act2",
+             "d4pg_tpu/serve/protocol.py::decode_act2"),
     "ACT_OK": ("d4pg_tpu/serve/protocol.py::encode_action",
                "d4pg_tpu/serve/protocol.py::decode_action"),
     "OVERLOADED": ("utf8", "utf8"),
@@ -52,9 +57,9 @@ PROTOCOL_CODECS = {
 # justified suppression).
 PROTOCOL_ENDPOINTS = {
     "server": ("d4pg_tpu/serve/server.py::PolicyServer._serve_conn",
-               ("HEALTHZ", "ACT")),
+               ("HEALTHZ", "ACT", "ACT2")),
     "router": ("d4pg_tpu/serve/router.py::Router._serve_conn",
-               ("HEALTHZ", "ACT")),
+               ("HEALTHZ", "ACT", "ACT2")),
     "ingest-handshake": ("d4pg_tpu/fleet/ingest.py::IngestServer._handshake",
                          ("HEALTHZ", "HELLO")),
     "ingest": ("d4pg_tpu/fleet/ingest.py::IngestServer._serve_conn",
