@@ -1,0 +1,85 @@
+"""Generate the committed composition matrix (ISSUE 13 acceptance).
+
+``benchmarks/composition_matrix.json`` is the machine-readable claim
+that EVERY scenario × placement cell of the data plane is either
+``pass``, ``negotiated`` (honored with a declared downgrade action), or
+a DECLARED capability gap with a machine-readable reason code — zero
+undeclared refusals. The cells are ``d4pg_tpu.replay.source``'s
+``composition_matrix()`` evaluated over its scenario grid; the
+schema gate (``tools/d4pglint/schema_check.py:check_composition_matrix``)
+re-evaluates the grid at lint time and fails on ANY drift, so a new
+refusal can never land without a declared matrix cell.
+
+The ``wire_encodings`` table states the fleet wire tradeoff the
+negotiation chooses between (bytes per window row per obs mode, at the
+flagship flat shape and the pixel shape — the 17.4 MB/s ingest bench is
+why pixel rows never ride f32).
+
+Chip-independent by construction (pure rule-table evaluation):
+regenerate with ``python benchmarks/composition_matrix.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from d4pg_tpu.fleet import wire  # noqa: E402  (JAX-free)
+from d4pg_tpu.replay import source  # noqa: E402
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "composition_matrix.json")
+
+SCHEMA = "composition-matrix/v1"
+
+
+def build() -> dict:
+    cells = source.composition_matrix()
+    counts = {"pass": 0, "negotiated": 0, "gap": 0}
+    for c in cells:
+        counts[c["verdict"]] += 1
+    encodings = {}
+    for label, (obs_dim, action_dim) in (
+        ("flat_obs17_act6", (17, 6)),
+        ("pixel_48x48x2_act1", (48 * 48 * 2, 1)),
+    ):
+        encodings[label] = {
+            mode: {
+                "row_bytes": wire.window_row_bytes(obs_dim, action_dim, mode),
+                "max_windows_per_frame": wire.max_windows_per_frame(
+                    obs_dim, action_dim, obs_mode=mode
+                ),
+            }
+            for mode in source.OBS_MODES
+        }
+    return {
+        "backend": "chip-independent",
+        "schema": SCHEMA,
+        "generated_by": "benchmarks/composition_matrix.py",
+        "scenarios": [name for name, _ in source.SCENARIOS],
+        "placements": list(source.PLACEMENTS),
+        "counts": counts,
+        "cells": cells,
+        "wire_encodings": encodings,
+    }
+
+
+def main(out: str = OUT) -> int:
+    doc = build()
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    print(
+        f"wrote {out}: {doc['counts']['pass']} pass / "
+        f"{doc['counts']['negotiated']} negotiated / "
+        f"{doc['counts']['gap']} declared gaps over "
+        f"{len(doc['cells'])} cells"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else OUT))

@@ -118,7 +118,7 @@ def _bench_fleet(obs_dim, action_dim, frame_windows, duration_s):
         # timed interval
         for _ in range(3):
             link.acquire_credit(5)
-            link.send_windows(0, cols)
+            link.send_windows((0, 0, False), cols)
         # Wait on the ACK COUNT, not inflight(): the reader pops the
         # pending entry (inflight -> 0) BEFORE invoking on_ack, so an
         # inflight()==0 poll can win that race and the last warmup ack
@@ -134,7 +134,7 @@ def _bench_fleet(obs_dim, action_dim, frame_windows, duration_s):
         while time.perf_counter() - start < duration_s:
             if not link.acquire_credit(5):
                 raise RuntimeError(f"link died: {link.dead}")
-            link.send_windows(0, cols)
+            link.send_windows((0, 0, False), cols)
             sent += fw
         # drain: every sent frame acked before the clock stops (the ack is
         # the admission receipt, so acked/s is honest ingest throughput)

@@ -37,6 +37,16 @@ Spec syntax (entries separated by ``;`` or ``,``)::
                           control tick (mid-rollout it must abort or
                           complete cleanly, never strand a half-deployed
                           replica)
+    stale_stats@2         fleet actor: at its 2nd bundle hot-swap, adopt
+                          the new params but KEEP the old obs-norm stats
+                          (windows advertise the stale stats generation;
+                          ingest counts + drops them)
+    pixel_truncate@4      fleet actor: truncate its 4th frame mid-send
+                          and RST (the torn WINDOWS2 frame must die whole
+                          server-side, its windows counted dropped)
+    her_actor_kill@50     fleet actor: SIGKILL itself on its 50th env
+                          step, mid-episode (the buffered HER episode
+                          dies with the process; nothing torn ships)
 
 A ``:<arg>`` that does not parse as a number is kept as a string LABEL
 (``tenant_flood``'s tenant name); numeric args stay floats.
@@ -95,6 +105,22 @@ site                  tick location               recovery proven
                                                   replica's bundle dir
                                                   restored (never
                                                   half-deployed)
+``stale_stats``       fleet actor, per hot-swap   windows carry the stale
+                                                  stats generation; ingest
+                                                  counts + drops them
+                                                  (windows_dropped_stale_
+                                                  stats), actor recovers
+                                                  at the next swap
+``pixel_truncate``    fleet actor, per frame      torn frame whole-drops
+                                                  server-side (read_frame
+                                                  ProtocolError); windows
+                                                  counted dropped client-
+                                                  side, paced reconnect
+``her_actor_kill``    fleet actor, per env step   buffered HER episode
+                                                  dies with the process;
+                                                  in-flight frames drop
+                                                  whole; supervisor
+                                                  restart reconnects
 ====================  ==========================  =========================
 """
 
@@ -138,6 +164,17 @@ KNOWN_SITES = WORKER_SITES + (
     "tenant_flood",
     "policy_skew",
     "scaledown_during_canary",
+    # one-data-plane sites (ISSUE 13): all three tick inside the fleet
+    # actor CLI's injector — stale_stats per bundle hot-swap (adopt new
+    # params, KEEP old obs-norm stats → ingest must age the windows out),
+    # pixel_truncate per frame send (header promises bytes the body never
+    # delivers, then RST — the torn WINDOWS2 frame must whole-drop),
+    # her_actor_kill per env step (SIGKILL self mid-episode — the
+    # relabeler's buffered episode dies with the process, nothing torn
+    # reaches replay).
+    "stale_stats",
+    "pixel_truncate",
+    "her_actor_kill",
 )
 
 # Sites whose ``:<arg>`` is a string label, not a number (the flood's

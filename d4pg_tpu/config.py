@@ -125,6 +125,14 @@ class TrainConfig:
     fleet_bundle: Optional[str] = None
     fleet_publish_interval: int = 200
     fleet_max_gen_lag: int = 1
+    # Fleet wire encoding for FLAT observation rows (ISSUE 13): "auto" =
+    # float32 (byte-identical to local collection; pixel envs always
+    # negotiate u8-quantized rows, which ARE byte-identical through the
+    # shared quantization point), "bfloat16" halves flat-row wire bytes
+    # with a declared bf16 round (the one lossy mode — see
+    # docs/data_plane.md wire-encoding tradeoffs). Negotiated with each
+    # actor at HELLO (replay/source.py:negotiate_fleet).
+    fleet_wire_dtype: str = "auto"
     # Bounded ingest admission queue (frames): past it the ingest answers
     # OVERLOADED(queue_full) — the serve batcher's explicit-shed contract.
     fleet_queue_limit: int = 64

@@ -192,6 +192,15 @@ def make_host_env(
 
         return make_dmc(name, max_episode_steps, action_repeat=action_repeat)
     _reject_action_repeat(name, action_repeat)
+    if name == "pixel_pendulum_host":
+        # The JAX-free twin of the pure-JAX pixel_pendulum: what a fleet
+        # actor host runs when the learner trains the pixel env (same
+        # MDP, parity-tested render/physics — ISSUE 13's pixel cell).
+        from d4pg_tpu.envs.pixel_pendulum_host import PixelPendulumHost
+
+        return PixelPendulumHost(
+            max_episode_steps=max_episode_steps or 200
+        )
     return GymAdapter(name, max_episode_steps)
 
 

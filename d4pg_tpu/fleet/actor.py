@@ -42,6 +42,7 @@ wait briefly for acks, print the final counter summary, exit 0.
 from __future__ import annotations
 
 import argparse
+import os
 import random
 import sys
 import threading
@@ -53,6 +54,7 @@ import numpy as np
 
 from d4pg_tpu.fleet import wire
 from d4pg_tpu.fleet.policy import NumpyPolicy, bundle_meta_mtime, load_numpy_policy
+from d4pg_tpu.replay.her import HindsightWriter
 from d4pg_tpu.replay.nstep_writer import NStepWriter
 from d4pg_tpu.serve import protocol
 from d4pg_tpu.serve.protocol import ProtocolError
@@ -78,16 +80,19 @@ STAT_KEYS = (
 
 class _Spool:
     """Bounded FIFO of complete windows, each row tagged with the bundle
-    generation that produced it. ``add`` is the duck-typed buffer target
-    :class:`NStepWriter` emits into. Single-threaded (the env loop owns
-    it); bounded so a long disconnection cannot grow host memory — the
-    oldest windows go first (they are the stalest anyway)."""
+    generation / stats generation / relabeled flag in force when it was
+    emitted. ``add`` is the duck-typed buffer target :class:`NStepWriter`
+    emits into. Single-threaded (the env loop owns it); bounded so a long
+    disconnection cannot grow host memory — the oldest windows go first
+    (they are the stalest anyway)."""
 
     def __init__(self, limit: int):
         self.limit = int(limit)
         self.rows: deque = deque()
         self.dropped = 0
-        self.generation = 0  # stamped by the actor at every policy swap
+        self.generation = 0        # stamped by the actor at every policy swap
+        self.stats_generation = 0  # stamped at every STATS swap (obs-norm)
+        self.relabeled = False     # toggled by the HER writer factory
 
     def add(self, obs, action, reward, next_obs, discount) -> None:
         if len(self.rows) >= self.limit:
@@ -95,7 +100,7 @@ class _Spool:
             self.dropped += 1
         self.rows.append(
             (
-                self.generation,
+                (self.generation, self.stats_generation, self.relabeled),
                 np.asarray(obs, np.float32),
                 np.asarray(action, np.float32),
                 float(reward),
@@ -108,22 +113,44 @@ class _Spool:
         return len(self.rows)
 
     def take_frame(self, max_rows: int):
-        """Pop the longest same-generation prefix up to ``max_rows`` →
-        ``(generation, columns)`` or None when empty. Same-generation so a
-        frame's single gen tag is honest across a mid-spool policy swap."""
+        """Pop the longest same-tag prefix up to ``max_rows`` →
+        ``(tag, columns)`` with ``tag = (generation, stats_generation,
+        relabeled)``, or None when empty. Same-tag so a frame's single
+        header stays honest across a mid-spool policy/stats swap or an
+        original→relabeled phase flip."""
         if not self.rows:
             return None
-        gen = self.rows[0][0]
+        tag = self.rows[0][0]
         rows = []
-        while self.rows and len(rows) < max_rows and self.rows[0][0] == gen:
+        while self.rows and len(rows) < max_rows and self.rows[0][0] == tag:
             rows.append(self.rows.popleft())
-        return gen, {
+        return tag, {
             "obs": np.stack([r[1] for r in rows]),
             "action": np.stack([r[2] for r in rows]),
             "reward": np.asarray([r[3] for r in rows], np.float32),
             "next_obs": np.stack([r[4] for r in rows]),
             "discount": np.asarray([r[5] for r in rows], np.float32),
         }
+
+
+class _HerWriterFactory:
+    """The ``writer_factory`` the repo's own :class:`HindsightWriter`
+    calls once for the ORIGINAL trajectory pass and once per relabel
+    pass: the first call per episode flush marks spooled windows
+    original, every later one marks them relabeled — how the wire knows
+    which windows may fold obs-norm statistics. Reset per episode by
+    :meth:`FleetActor._her_flush`."""
+
+    def __init__(self, spool: _Spool, n_step: int, gamma: float):
+        self.spool = spool
+        self.n_step = n_step
+        self.gamma = gamma
+        self.calls = 0
+
+    def __call__(self) -> NStepWriter:
+        self.calls += 1
+        self.spool.relabeled = self.calls > 1
+        return NStepWriter(self.spool, self.n_step, self.gamma)
 
 
 class FleetLink:
@@ -160,6 +187,19 @@ class FleetLink:
                 raise ProtocolError("server closed during handshake")
             msg_type, _req_id, payload = frame
             if msg_type == protocol.ERROR:
+                # Structured refusals (capability/dims mismatch) surface
+                # their machine-readable gap codes; plain-text errors
+                # (old servers, non-handshake failures) pass through raw.
+                refusal = wire.decode_refusal(payload)
+                if refusal is not None:
+                    codes = ",".join(
+                        g.get("code", "?") for g in refusal.get("gaps", ())
+                    )
+                    raise RuntimeError(
+                        f"ingest refused handshake"
+                        f"{f' [{codes}]' if codes else ''}: "
+                        f"{refusal.get('message', '')}"
+                    )
                 raise RuntimeError(
                     f"ingest refused handshake: {payload.decode('utf-8', 'replace')}"
                 )
@@ -172,6 +212,17 @@ class FleetLink:
         self.server_generation = int(ok["generation"])
         self.max_windows = int(ok["max_windows_per_frame"])
         self.max_inflight = int(ok["max_inflight"])
+        # Negotiated capability set (None against a pre-ISSUE-13 server,
+        # which replies without caps): the frame kind every send uses.
+        self.caps: Optional[dict] = ok.get("caps")
+        self.server_stats_generation = int(ok.get("stats_generation", 0))
+        self.obs_mode = (self.caps or {}).get("obs_mode", "f32")
+        # WINDOWS2 only where its header matters (non-f32 rows or stats
+        # tagging): plain f32 no-stats traffic stays on the v1 WINDOWS
+        # frame, byte-identical to a pre-capability actor's.
+        self._use_v2 = (
+            self.obs_mode != "f32" or bool((self.caps or {}).get("obs_norm"))
+        )
         # Reader blocks between acks indefinitely — the handshake timeout
         # must not kill an idle-but-healthy connection.
         self._sock.settimeout(None)
@@ -204,12 +255,21 @@ class FleetLink:
         """Hand back an acquired-but-unused credit (nothing was sent)."""
         self._credits.release()
 
-    def send_windows(self, generation: int, cols: dict) -> int:
-        """Ship one frame (caller holds a credit). Returns its window
-        count; raises OSError on a dead/broken socket. Drop accounting for
-        a failed send lives HERE, exactly once: either this thread pops
-        the pending entry (and counts it), or the reader's death sweep
-        already did — never both."""
+    def send_windows(self, tag, cols: dict, truncate: bool = False) -> int:
+        """Ship one frame (caller holds a credit). ``tag`` is the spool's
+        ``(generation, stats_generation, relabeled)`` triple. Returns its
+        window count; raises OSError on a dead/broken socket. Drop
+        accounting for a failed send lives HERE, exactly once: either
+        this thread pops the pending entry (and counts it), or the
+        reader's death sweep already did — never both.
+
+        ``truncate`` is the ``pixel_truncate`` chaos fault: the header
+        declares the full payload, the body stops halfway, and the socket
+        is abortively closed — the mid-``sendall`` death shape. The
+        server must whole-drop the torn frame (ProtocolError inside
+        read_frame), and this side accounts the windows dropped through
+        the normal failed-send path."""
+        generation, stats_gen, relabeled = tag
         n = len(cols["reward"])
         with self._pending_lock:
             self._next_id = (self._next_id + 1) & 0xFFFFFFFF
@@ -218,20 +278,37 @@ class FleetLink:
         if self._dead is not None:
             self._fail_send(req_id)
             raise OSError("link is dead")
-        try:
-            protocol.write_frame(
-                self._sock,
-                protocol.WINDOWS,
-                req_id,
-                wire.encode_windows(
-                    generation,
-                    cols["obs"],
-                    cols["action"],
-                    cols["reward"],
-                    cols["next_obs"],
-                    cols["discount"],
-                ),
+        if self._use_v2:
+            msg_type = protocol.WINDOWS2
+            payload = wire.encode_windows2(
+                generation,
+                stats_gen,
+                self.obs_mode,
+                relabeled,
+                cols["obs"],
+                cols["action"],
+                cols["reward"],
+                cols["next_obs"],
+                cols["discount"],
             )
+        else:
+            msg_type = protocol.WINDOWS
+            payload = wire.encode_windows(
+                generation,
+                cols["obs"],
+                cols["action"],
+                cols["reward"],
+                cols["next_obs"],
+                cols["discount"],
+            )
+        try:
+            if truncate:
+                protocol.write_truncated_frame(
+                    self._sock, msg_type, req_id, payload, len(payload) // 2
+                )
+                protocol.abortive_close(self._sock)
+                raise OSError("chaos: frame truncated mid-stream")
+            protocol.write_frame(self._sock, msg_type, req_id, payload)
         except OSError:
             self._fail_send(req_id)
             raise
@@ -352,6 +429,8 @@ class FleetActor:
         stop_event: Optional[threading.Event] = None,
         chaos=None,
         actor_id: Optional[str] = None,
+        her: bool = False,
+        her_k: int = 4,
     ):
         host, _, port = connect.rpartition(":")
         if not host or not port.isdigit():
@@ -382,9 +461,12 @@ class FleetActor:
         self._stop = stop_event if stop_event is not None else threading.Event()
         self._chaos = chaos
         self.actor_id = actor_id or f"{self.env_id}-actor"
+        self.her = bool(her)
+        self.her_k = int(her_k)
         self._rng = np.random.default_rng(seed)
         self.spool = _Spool(spool_limit)
         self.spool.generation = self.policy.generation
+        self.spool.stats_generation = self.policy.stats_generation
         self._bundle_mtime = self.policy.mtime
         self._link: Optional[FleetLink] = None
         # Paced-reconnect state: while disconnected the env loop keeps
@@ -400,16 +482,65 @@ class FleetActor:
         from d4pg_tpu.envs.gym_adapter import make_host_env
 
         self.envs = [make_host_env(self.env_id) for _ in range(self.num_envs)]
-        self.writers = [
-            NStepWriter(self.spool, self.policy.n_step, self.policy.gamma)
-            for _ in range(self.num_envs)
-        ]
+        if self.her:
+            # Actor-side HER (ISSUE 13): the repo's OWN HindsightWriter
+            # relabels on this host, pointed at the spool through a
+            # factory that tags original vs relabeled passes — so the
+            # windows that cross the wire are column-for-column what the
+            # learner-side HER path would have inserted (the seeded
+            # parity oracle pins it).
+            for env in self.envs:
+                if not getattr(env, "is_goal_env", False) or not hasattr(
+                    env, "compute_reward"
+                ):
+                    raise ValueError(
+                        f"--her needs a goal-dict env; {self.env_id!r} "
+                        "is not one"
+                    )
+            self._her_factories = [
+                _HerWriterFactory(
+                    self.spool, self.policy.n_step, self.policy.gamma
+                )
+                for _ in range(self.num_envs)
+            ]
+            self.writers = [
+                HindsightWriter(
+                    writer_factory=self._her_factories[i],
+                    compute_reward=self.envs[i].compute_reward,
+                    k_future=self.her_k,
+                    rng=np.random.default_rng(self.seed + 7000 + i),
+                )
+                for i in range(self.num_envs)
+            ]
+        else:
+            self.writers = [
+                NStepWriter(self.spool, self.policy.n_step, self.policy.gamma)
+                for _ in range(self.num_envs)
+            ]
         self._obs = np.stack(
             [
                 env.reset(seed=self.seed + 1000 * i)
                 for i, env in enumerate(self.envs)
             ]
         ).astype(np.float32)
+        if self.her:
+            # goal views for the relabeler: (observation, achieved,
+            # desired) dict BEFORE each step, refreshed after
+            self._goal_prev = [
+                self._goal_view(env) for env in self.envs
+            ]
+            # Per-env (generation, stats_generation) captured at EPISODE
+            # START: HER buffers a whole episode before anything reaches
+            # the spool, so a mid-episode bundle hot-swap must not
+            # re-stamp already-acted experience as fresh — the flush
+            # tags the whole episode with the generation in force when
+            # it BEGAN (the conservative direction: ingest may drop a
+            # partially-fresh episode as stale, never accept stale
+            # windows as fresh).
+            self._her_episode_tag = [
+                (self.policy.generation, self.policy.stats_generation)
+                for _ in range(self.num_envs)
+            ]
         if self._obs.shape[1] != self.policy.obs_dim:
             raise ValueError(
                 f"env {self.env_id!r} observations are "
@@ -448,7 +579,20 @@ class FleetActor:
     def _hello(self) -> dict:
         """The HELLO handshake payload — single source for every connect
         path (_ensure_link and the drain reconnect) so the two can never
-        drift on a field."""
+        drift on a field. The ``caps`` vector states what this host CAN
+        produce; the server picks from it or refuses with a structured
+        reason (replay/source.py:negotiate_fleet)."""
+        obs_modes = ["f32", "u8"]
+        try:
+            # Advertise bf16 only when this host can actually encode it:
+            # ml_dtypes is a lazy extra (f32/u8 hosts never need it), and
+            # negotiating a mode we then crash on at the first send is
+            # exactly the mis-deployment the handshake exists to refuse.
+            import ml_dtypes  # noqa: F401
+
+            obs_modes.append("bf16")
+        except ImportError:
+            pass
         return dict(
             actor_id=self.actor_id,
             env=self.env_id,
@@ -457,7 +601,29 @@ class FleetActor:
             n_step=self.policy.n_step,
             gamma=self.policy.gamma,
             generation=self.policy.generation,
+            caps=dict(
+                wire=2,
+                obs_modes=obs_modes,
+                her=self.her,
+                obs_norm=self.policy.has_obs_norm,
+            ),
         )
+
+    def _check_negotiated(self, link: FleetLink) -> None:
+        """A pre-ISSUE-13 server replies without caps: fine for plain f32
+        traffic, fatal when this host's config NEEDS the capability wire
+        (HER tagging, stats generations, non-f32 rows)."""
+        if link.caps is None and (
+            self.her
+            or self.policy.has_obs_norm
+            or self.policy.pixel_shape is not None
+        ):
+            raise RuntimeError(
+                "ingest server does not speak capability negotiation "
+                "(pre-ISSUE-13 learner) but this actor needs it "
+                f"(her={self.her}, obs_norm={self.policy.has_obs_norm}, "
+                f"pixel={self.policy.pixel_shape is not None})"
+            )
 
     def _ensure_link(self) -> bool:
         """Connected, or ONE non-blocking paced reconnect attempt under the
@@ -492,6 +658,7 @@ class FleetActor:
             )
         except (OSError, ProtocolError) as e:
             return self._retry_later(e)
+        self._check_negotiated(link)  # fatal, not retried: config skew
         if self._chaos is not None:
             e = self._chaos.tick("reconnect_flap")
             if e is not None:
@@ -555,7 +722,8 @@ class FleetActor:
         if frame is None:
             link.release_credit()
             return False
-        gen, cols = frame
+        tag, cols = frame
+        truncate = False
         if self._chaos is not None:
             e = self._chaos.tick("slow_link")
             if e is not None:
@@ -564,8 +732,16 @@ class FleetActor:
                 # control (not queue growth) absorbs the stall.
                 stall = e.arg if e.arg is not None else 100.0
                 self._stop.wait(stall / 1e3)
+            e = self._chaos.tick("pixel_truncate")
+            if e is not None:
+                # pixel_truncate@N — die mid-sendall on this frame (the
+                # header promises bytes the body never delivers) and RST.
+                # The server must whole-drop the torn frame; this side's
+                # windows count dropped, and the normal paced reconnect
+                # takes over.
+                truncate = True
         try:
-            n = link.send_windows(gen, cols)
+            n = link.send_windows(tag, cols, truncate=truncate)
         except OSError:
             # in flight at the disconnect: dropped whole (send_windows /
             # the reader's death sweep counted it — exactly one of them)
@@ -604,9 +780,26 @@ class FleetActor:
                 flush=True,
             )
             return
+        if self._chaos is not None and fresh.has_obs_norm:
+            e = self._chaos.tick("stale_stats")
+            if e is not None:
+                # Injected stale STATS: adopt the fresh params (the
+                # policy generation advances honestly) but keep acting on
+                # the OLD normalizer statistics — emitted windows carry
+                # the old stats generation, and the ingest server must
+                # count + drop them (windows_dropped_stale_stats) once
+                # the lag exceeds fleet_max_gen_lag.
+                fresh.retain_stats_from(self.policy)
+                print(
+                    "[fleet-actor] chaos stale_stats: keeping stats "
+                    f"generation {fresh.stats_generation} under params "
+                    f"generation {fresh.generation}",
+                    flush=True,
+                )
         self._bundle_mtime = fresh.mtime
         self.policy = fresh
         self.spool.generation = fresh.generation
+        self.spool.stats_generation = fresh.stats_generation
         with self._stats_lock:
             self._stats["generation"] = fresh.generation
             self._stats["bundle_reloads"] += 1
@@ -616,6 +809,54 @@ class FleetActor:
         )
 
     # ------------------------------------------------------------- env loop
+    @staticmethod
+    def _goal_view(env) -> tuple:
+        """(observation, achieved_goal, desired_goal) copies from the
+        adapter's ``last_goal_obs`` — copies because the relabeler holds
+        them across the whole episode."""
+        g = env.last_goal_obs
+        return (
+            np.asarray(g["observation"], np.float32).copy(),
+            np.asarray(g["achieved_goal"], np.float32).copy(),
+            np.asarray(g["desired_goal"], np.float32).copy(),
+        )
+
+    def _her_flush(self, i: int, truncated: bool) -> None:
+        """Episode end: relabel + flush through the repo's own
+        HindsightWriter. The factory's call counter restarts so the
+        original pass tags windows original, relabel passes relabeled.
+        The whole flush is stamped with the EPISODE-START generation tag
+        (see ``_her_episode_tag``) — then the spool returns to the live
+        policy's tags for the next episode."""
+        cur = (self.spool.generation, self.spool.stats_generation)
+        self.spool.generation, self.spool.stats_generation = (
+            self._her_episode_tag[i]
+        )
+        try:
+            self._her_factories[i].calls = 0
+            self.writers[i].end_episode(truncated=truncated)
+        finally:
+            self.spool.generation, self.spool.stats_generation = cur
+            self.spool.relabeled = False  # next episode starts original
+        self._her_episode_tag[i] = (
+            self.policy.generation, self.policy.stats_generation
+        )
+
+    def _maybe_her_actor_kill(self) -> None:
+        """her_actor_kill@N — SIGKILL this host on its Nth ENV STEP
+        (ticked once per env per loop, so the count means env steps at
+        any ``--num-envs``), mid-episode: the relabeler's buffered
+        episode dies with the process, so nothing torn can ever reach
+        the wire (HER windows only exist after ``end_episode``), and
+        in-flight frames die under the server's torn-frame whole-drop.
+        A supervisor restarts the host; the learner sees a reconnect."""
+        e = self._chaos.tick("her_actor_kill")
+        if e is not None:
+            import signal as _signal
+
+            print("[chaos] her_actor_kill: SIGKILL self", flush=True)
+            os.kill(os.getpid(), _signal.SIGKILL)
+
     def _step_envs(self) -> None:
         a = self.policy.act(self._obs)
         if self.noise_sigma > 0.0:
@@ -624,7 +865,31 @@ class FleetActor:
             ).astype(np.float32)
         np.clip(a, -1.0, 1.0, out=a)
         for i, env in enumerate(self.envs):
+            if self._chaos is not None:
+                self._maybe_her_actor_kill()
             obs2, r, term, trunc, _info = env.step(a[i])
+            if self.her:
+                g_next = self._goal_view(env)
+                g_prev = self._goal_prev[i]
+                self.writers[i].add(
+                    observation=g_prev[0],
+                    achieved_goal=g_prev[1],
+                    desired_goal=g_prev[2],
+                    action=a[i].copy(),
+                    reward=float(r),
+                    next_observation=g_next[0],
+                    next_achieved_goal=g_next[1],
+                    terminated=bool(term),
+                )
+                if term or trunc:
+                    self._her_flush(i, truncated=not bool(term))
+                    self._obs[i] = env.reset()
+                    self._goal_prev[i] = self._goal_view(env)
+                    self._inc("episodes")
+                else:
+                    self._obs[i] = obs2
+                    self._goal_prev[i] = g_next
+                continue
             # .copy(): NStepWriter stores obs WITHOUT copying, and the
             # `self._obs[i] = ...` below assigns INTO this row — without
             # the copy every emitted window's obs would silently read the
@@ -751,10 +1016,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--reconnect-attempts", type=int, default=60,
                    help="bounded Backoff budget per disconnection; "
                         "exhausting it exits 1 (supervisor restarts)")
+    p.add_argument("--her", action="store_true",
+                   help="actor-side hindsight relabeling (goal-dict envs): "
+                        "the repo's own HindsightWriter runs on THIS host "
+                        "and relabeled windows ship wire-identical to "
+                        "learner-side ones; the learner must run --her too "
+                        "(negotiated at HELLO)")
+    p.add_argument("--her-k", type=int, default=4,
+                   help="relabeled copies per episode (HER 'future' k)")
     p.add_argument("--chaos", default=None, metavar="PLAN",
                    help="deterministic fault injection (d4pg_tpu/chaos.py): "
                         "actor-side sites reconnect_flap@N, stale_bundle@N, "
-                        "slow_link@N:ms")
+                        "slow_link@N:ms, stale_stats@N, pixel_truncate@N, "
+                        "her_actor_kill@N")
     return p
 
 
@@ -779,6 +1053,8 @@ def main(argv=None) -> int:
         stats_interval_s=args.stats_interval,
         reconnect_attempts=args.reconnect_attempts,
         chaos=chaos,
+        her=args.her,
+        her_k=args.her_k,
     )
     from d4pg_tpu.utils.signals import install_graceful_signals
 

@@ -57,6 +57,7 @@ import numpy as np
 
 from d4pg_tpu.analysis.ledger import NULL_LEDGER
 from d4pg_tpu.fleet import wire
+from d4pg_tpu.replay import source
 from d4pg_tpu.replay.uniform import Transition
 from d4pg_tpu.serve import protocol
 from d4pg_tpu.serve.protocol import ProtocolError
@@ -66,13 +67,20 @@ from d4pg_tpu.analysis import lockwitness
 COUNTER_KEYS = (
     "windows_ingested",
     "windows_dropped_stale_gen",
+    # ISSUE 13: windows produced under obs-norm statistics older than the
+    # allowed lag — counted and discarded exactly like stale-generation
+    # ones (a mis-normalized action distribution is the same staleness
+    # class as a stale policy).
+    "windows_dropped_stale_stats",
     "windows_shed",
     "frames_total",
     "bytes_total",
     "connections",
     "connections_total",
     "protocol_errors",
+    "handshake_refusals",
     "generation",
+    "stats_generation",
 )
 
 
@@ -110,6 +118,8 @@ class IngestServer:
         read_timeout_s: float = 120.0,
         max_gen_lag: int = 1,
         max_inflight: int = 8,
+        caps: Optional[dict] = None,
+        obs_norm=None,
         ledger=None,
         chaos=None,
     ):
@@ -126,7 +136,22 @@ class IngestServer:
         self.read_timeout_s = float(read_timeout_s)
         self.max_gen_lag = int(max_gen_lag)
         self.max_inflight = int(max_inflight)
-        self.max_windows = wire.max_windows_per_frame(obs_dim, action_dim)
+        # What the learner's replay REQUIRES of actors (ISSUE 13): obs
+        # wire mode, actor-side HER, generation-tagged obs-norm stats.
+        # None = the pre-capability default (f32, no HER, no stats) —
+        # byte-identical v1 behavior.
+        self.caps = dict(caps) if caps is not None else {
+            "obs_mode": "f32", "her": False, "obs_norm": False,
+        }
+        # The ingest writer is the single statistics writer in fleet-fed
+        # obs-norm runs (the seam's obs_norm_fleet_single_writer gap
+        # guarantees no local collector races this): stats fold once per
+        # ORIGINAL ingested window — the same once-per-observed-step
+        # cadence as Trainer._ingest_obs — never per relabeled copy.
+        self._obs_norm = obs_norm
+        self.max_windows = wire.max_windows_per_frame(
+            obs_dim, action_dim, obs_mode=self.caps["obs_mode"]
+        )
         self._chaos = chaos
 
         # Frame queue: reader threads append decoded column dicts, the
@@ -251,14 +276,22 @@ class IngestServer:
     def set_generation(self, generation: int) -> None:
         """Called by the trainer at every bundle publish: windows produced
         against generations older than ``generation − max_gen_lag`` are
-        dropped from here on."""
+        dropped from here on. Obs-norm statistics ride the same bundle, so
+        the stats generation advances in lockstep (a window acted under
+        stale stats is dropped via the SAME lag rule, counted apart)."""
         with self._counters_lock:
             self._counters["generation"] = int(generation)
+            self._counters["stats_generation"] = int(generation)
 
     @property
     def generation(self) -> int:
         with self._counters_lock:
             return self._counters["generation"]
+
+    @property
+    def stats_generation(self) -> int:
+        with self._counters_lock:
+            return self._counters["stats_generation"]
 
     def counters(self) -> dict:
         """Snapshot of the fleet counters (one lock hop); the trainer
@@ -345,14 +378,27 @@ class IngestServer:
             problems.append(f"n_step {hello['n_step']} != {self.n_step}")
         if abs(hello["gamma"] - self.gamma) > 1e-9:
             problems.append(f"gamma {hello['gamma']} != {self.gamma}")
-        if problems:
+        # Capability negotiation (ISSUE 13): what used to be a CLI-level
+        # refusal matrix (--fleet-listen vs --her/--obs-norm/pixels) is
+        # settled per connection HERE — a caps-less HELLO negotiates as a
+        # pre-capability actor, and a mismatch refuses with a structured
+        # machine-readable reason, never a wrong-distribution stream.
+        actor_caps = hello.get("caps") or source.LEGACY_ACTOR_CAPS
+        chosen, gaps = source.negotiate_fleet(self.caps, actor_caps)
+        if problems or gaps:
             # A mis-configured actor must fail loudly at connect, not
             # stream windows that silently train the wrong MDP.
+            self._inc("handshake_refusals")
             protocol.write_frame(
                 conn,
                 protocol.ERROR,
                 req_id,
-                ("handshake refused: " + "; ".join(problems)).encode(),
+                wire.encode_refusal(
+                    "; ".join(
+                        problems + [g.message for g in gaps]
+                    ),
+                    gaps,
+                ),
             )
             return False
         protocol.write_frame(
@@ -363,6 +409,10 @@ class IngestServer:
                 generation=self.generation,
                 max_windows=self.max_windows,
                 max_inflight=self.max_inflight,
+                # reply caps ONLY to a caps-sending actor: the v1 reply
+                # stays byte-identical for pre-capability actors
+                caps=chosen if hello.get("caps") is not None else None,
+                stats_generation=self.stats_generation,
             ),
         )
         return True
@@ -395,13 +445,39 @@ class IngestServer:
                         json.dumps(self.counters()).encode(),
                     )
                     continue
-                if msg_type != protocol.WINDOWS:
+                if msg_type == protocol.WINDOWS:
+                    # The pre-capability frame: f32 flat rows, no stats
+                    # tag. Only a connection negotiated down to the plain
+                    # f32/no-stats wire may speak it — a WINDOWS frame on
+                    # a u8/bf16/obs-norm ingest would silently bypass the
+                    # negotiated encoding, so it dies as a protocol error.
+                    if self.caps["obs_mode"] != "f32" or self.caps["obs_norm"]:
+                        raise ProtocolError(
+                            "WINDOWS (v1) frame on a connection that "
+                            f"negotiated obs_mode={self.caps['obs_mode']!r}"
+                            f"/obs_norm={self.caps['obs_norm']}; speak "
+                            "WINDOWS2"
+                        )
+                    gen, cols = wire.decode_windows(
+                        payload, self.obs_dim, self.action_dim
+                    )
+                    stats_gen, relabeled = None, False
+                elif msg_type == protocol.WINDOWS2:
+                    gen, stats_gen, obs_mode, relabeled, cols = (
+                        wire.decode_windows2(
+                            payload, self.obs_dim, self.action_dim
+                        )
+                    )
+                    if obs_mode != self.caps["obs_mode"]:
+                        raise ProtocolError(
+                            f"WINDOWS2 frame carries obs_mode={obs_mode!r}, "
+                            f"connection negotiated "
+                            f"{self.caps['obs_mode']!r}"
+                        )
+                else:
                     raise ProtocolError(f"unexpected message type {msg_type}")
                 self._inc("frames_total")
                 self._inc("bytes_total", len(payload))
-                gen, cols = wire.decode_windows(
-                    payload, self.obs_dim, self.action_dim
-                )
                 n = len(cols["reward"])
                 if gen < self.generation - self.max_gen_lag:
                     # Stale-bundle drop: these windows were produced by a
@@ -417,10 +493,33 @@ class IngestServer:
                         wire.encode_windows_ok(0, n),
                     )
                     continue
+                if (
+                    self.caps["obs_norm"]
+                    and stats_gen is not None
+                    and stats_gen < self.stats_generation - self.max_gen_lag
+                ):
+                    # Stale-STATS drop (ISSUE 13): the window's actions
+                    # were chosen under normalizer statistics the learner
+                    # has moved past — same staleness class as a stale
+                    # policy, counted apart so the two failure modes stay
+                    # distinguishable in metrics/healthz.
+                    self._inc("windows_dropped_stale_stats", n)
+                    protocol.write_frame(
+                        conn,
+                        protocol.WINDOWS_OK,
+                        req_id,
+                        wire.encode_windows_ok(0, n),
+                    )
+                    continue
+                # Fold obs-norm statistics once per ORIGINAL window (the
+                # once-per-observed-step cadence); relabeled HER copies
+                # re-observe the same step under substituted goals and
+                # must not multi-count it.
+                fold = bool(self.caps["obs_norm"]) and not relabeled
                 with self._cond:
                     full = len(self._queue) >= self.queue_limit
                     if not full:
-                        self._queue.append(cols)
+                        self._queue.append((cols, fold))
                         self._cond.notify()
                 if full:
                     # Explicit shed at the bounded queue (the batcher's
@@ -476,7 +575,7 @@ class IngestServer:
                     # frames accumulated (the PR-2 drain-and-batch shape).
                     rows = 0
                     while self._queue:
-                        n = len(self._queue[0]["reward"])
+                        n = len(self._queue[0][0]["reward"])
                         if frames and rows + n > self._staging_cap:
                             break
                         frames.append(self._queue.popleft())
@@ -487,9 +586,19 @@ class IngestServer:
             raise
 
     def _write_frames(self, frames: list) -> None:
-        total = sum(len(f["reward"]) for f in frames)
+        """``frames`` is a list of ``(cols, fold)`` pairs popped from the
+        admission queue."""
+        total = sum(len(f["reward"]) for f, _fold in frames)
         if total == 0:
             return
+        if self._obs_norm is not None:
+            # Single-writer statistics fold (this thread is the only
+            # updater — the seam refuses configs with a second one),
+            # BEFORE add_batch so a sampled batch never sees rows its
+            # stats have not absorbed. Original windows only.
+            for f, fold in frames:
+                if fold:
+                    self._obs_norm.update(f["obs"])
         flip = self._staging_flip
         self._staging_flip = 1 - flip
         self._ledger.write(
@@ -500,7 +609,7 @@ class IngestServer:
         # unstaged write below rather than overrunning the slot
         if total <= self._staging_cap:
             pos = 0
-            for f in frames:
+            for f, _fold in frames:
                 n = len(f["reward"])
                 for k in ("obs", "action", "reward", "next_obs", "discount"):
                     staging[k][pos : pos + n] = f[k]
@@ -508,8 +617,8 @@ class IngestServer:
             cols = {k: staging[k][:total] for k in staging}
         else:
             cols = {
-                k: np.concatenate([f[k] for f in frames])
-                for k in frames[0]
+                k: np.concatenate([f[k] for f, _fold in frames])
+                for k in frames[0][0]
             }
         hold = self._ledger.hold(
             self._staging_group, flip, holder="fleet-ingest-add_batch"

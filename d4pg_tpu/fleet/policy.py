@@ -17,14 +17,17 @@ the SAME bundle directory with numpy + stdlib only:
   shape against the chain — a scrambled order or a config/params
   mismatch is a hard load error, never a silently-garbage policy.
 
-Pixel bundles (conv encoder) are refused: the fleet path is for flat
-observation vectors (the conv forward belongs on an accelerator; a pixel
-actor host would be serving-shaped, not fleet-shaped).
+Pixel bundles (conv encoder) load too (ISSUE 13 — the fleet's pixel
+cell): the DrQ-style encoder (4× conv3x3 SAME, stride 2 then 1, relu;
+Dense(embed) → LayerNorm → tanh) is reimplemented in numpy with an
+im2col matmul per layer, parity-tested against the jitted actor. A
+48×48×2 forward is a few MXU-free milliseconds per batched act — actor
+hosts run env-rate, not serving-rate, so numpy is plenty.
 
 The forward is the exact acting-time data path the server runs —
-normalize → MLP(relu) → tanh — in float32 numpy. Parity with the jitted
-``act_deterministic`` is tested to ~1e-5 (XLA may reassociate float
-reductions; exploration noise dwarfs that).
+normalize → [conv-encode] → MLP(relu) → tanh — in float32 numpy. Parity
+with the jitted ``act_deterministic`` is tested to ~1e-5 (XLA may
+reassociate float reductions; exploration noise dwarfs that).
 """
 
 from __future__ import annotations
@@ -41,6 +44,57 @@ import numpy as np
 BUNDLE_VERSION = 1
 PARAMS_FILE = "actor_params.npz"
 META_FILE = "bundle.json"
+
+
+def _conv2d_same(x: np.ndarray, kernel: np.ndarray, bias: np.ndarray,
+                 stride: int) -> np.ndarray:
+    """NHWC conv with SAME padding via im2col matmul — flax ``nn.Conv``'s
+    exact arithmetic (patch order (kh, kw, C) matches the kernel's
+    row-major flatten)."""
+    n, h, w, c = x.shape
+    kh, kw, _, f = kernel.shape
+    out_h, out_w = -(-h // stride), -(-w // stride)
+    pad_h = max((out_h - 1) * stride + kh - h, 0)
+    pad_w = max((out_w - 1) * stride + kw - w, 0)
+    x = np.pad(
+        x,
+        ((0, 0), (pad_h // 2, pad_h - pad_h // 2),
+         (pad_w // 2, pad_w - pad_w // 2), (0, 0)),
+    )
+    cols = np.empty((n, out_h, out_w, kh * kw * c), np.float32)
+    for i in range(kh):
+        for j in range(kw):
+            cols[..., (i * kw + j) * c:(i * kw + j + 1) * c] = x[
+                :, i:i + out_h * stride:stride, j:j + out_w * stride:stride, :
+            ]
+    return cols @ kernel.reshape(kh * kw * c, f) + bias
+
+
+class _NumpyPixelEncoder:
+    """models/encoders.py:PixelEncoder in numpy: conv3x3 SAME (stride 2
+    then 1) + relu ×4, flatten, Dense(embed), LayerNorm(eps=1e-6), tanh."""
+
+    def __init__(self, convs, dense, layer_norm, pixel_shape):
+        self._convs = convs              # [(kernel [3,3,in,out], bias)]
+        self._dense = dense              # (kernel, bias)
+        self._ln = layer_norm            # (bias, scale)
+        self.pixel_shape = tuple(pixel_shape)
+
+    def __call__(self, flat: np.ndarray) -> np.ndarray:
+        x = np.asarray(flat, np.float32).reshape(
+            (-1,) + self.pixel_shape
+        )
+        for i, (kernel, bias) in enumerate(self._convs):
+            x = _conv2d_same(x, kernel, bias, stride=2 if i == 0 else 1)
+            np.maximum(x, 0.0, out=x)
+        x = x.reshape(x.shape[0], -1)
+        dk, db = self._dense
+        x = x @ dk + db
+        lb, ls = self._ln
+        mean = x.mean(axis=-1, keepdims=True)
+        var = ((x - mean) ** 2).mean(axis=-1, keepdims=True)
+        x = (x - mean) / np.sqrt(var + 1e-6) * ls + lb
+        return np.tanh(x)
 
 
 class NumpyPolicy:
@@ -63,6 +117,8 @@ class NumpyPolicy:
         obs_clip: float = 5.0,
         mtime: Optional[float] = None,
         path: Optional[str] = None,
+        encoder: Optional[_NumpyPixelEncoder] = None,
+        stats_generation: int = 0,
     ):
         self._layers = layers            # [(kernel [in, out], bias [out])]
         self.obs_dim = obs_dim
@@ -75,6 +131,27 @@ class NumpyPolicy:
         self._obs_clip = obs_clip
         self.mtime = mtime               # bundle.json mtime at load
         self.path = path
+        self._encoder = encoder          # pixel bundles only
+        # Which published statistics these acting-time obs-norm params
+        # came from (bundle meta.stats_generation) — stamped onto every
+        # emitted window so ingest can age out stale-stats experience.
+        self.stats_generation = int(stats_generation)
+
+    @property
+    def pixel_shape(self) -> Optional[Tuple[int, ...]]:
+        return None if self._encoder is None else self._encoder.pixel_shape
+
+    @property
+    def has_obs_norm(self) -> bool:
+        return self._obs_norm is not None
+
+    def retain_stats_from(self, old: "NumpyPolicy") -> None:
+        """Chaos ``stale_stats`` support: adopt THIS bundle's params but
+        keep acting on ``old``'s normalizer statistics AND their
+        generation — the windows then honestly advertise the stale stats
+        they were produced under, and ingest ages them out."""
+        self._obs_norm = old._obs_norm
+        self.stats_generation = old.stats_generation
 
     def act(self, obs: np.ndarray) -> np.ndarray:
         """Deterministic forward: ``[N, obs_dim]`` → ``[N, action_dim]``
@@ -83,6 +160,8 @@ class NumpyPolicy:
         if self._obs_norm is not None:
             mean, std = self._obs_norm
             x = np.clip((x - mean) / std, -self._obs_clip, self._obs_clip)
+        if self._encoder is not None:
+            x = self._encoder(x)
         last = len(self._layers) - 1
         for i, (kernel, bias) in enumerate(self._layers):
             x = x @ kernel + bias
@@ -128,13 +207,10 @@ def load_numpy_policy(bundle_dir: str) -> NumpyPolicy:
             f"(this code reads {BUNDLE_VERSION})"
         )
     agent = doc["agent"]
-    if agent.get("pixel_shape"):
-        raise ValueError(
-            "pixel bundles (conv encoder) are not supported by the fleet "
-            "actor's numpy policy; fleet hosts serve flat observations only"
-        )
     obs_dim = int(agent["obs_dim"])
     action_dim = int(agent["action_dim"])
+    pixel_shape = tuple(agent["pixel_shape"]) if agent.get("pixel_shape") \
+        else None
     hidden = [int(h) for h in agent.get("hidden_sizes", (256, 256, 256))]
     if len(hidden) > 9:
         # tree_flatten sorts layer names as STRINGS; hidden_10 would sort
@@ -145,14 +221,68 @@ def load_numpy_policy(bundle_dir: str) -> NumpyPolicy:
         )
     with np.load(os.path.join(bundle_dir, PARAMS_FILE)) as z:
         leaves = [z[k] for k in sorted(z.files)]
+    encoder = None
+    trunk_in = obs_dim
+    if pixel_shape is not None:
+        # The conv encoder's leaves sort FIRST ('PixelEncoder_0' <
+        # 'hidden_0' < 'out'), within it 'Conv_*' < 'Dense_0' <
+        # 'LayerNorm_0', (bias, kernel)/(bias, scale) per layer — fully
+        # determined, every leaf shape validated against the declared
+        # architecture (features are the encoder's fixed defaults).
+        features = (32, 32, 32, 32)
+        embed = int(agent.get("encoder_embed_dim", 50))
+        n_enc = 2 * len(features) + 4  # convs + Dense + LayerNorm
+        if len(leaves) < n_enc:
+            raise ValueError(
+                f"pixel bundle has {len(leaves)} param leaves, the conv "
+                f"encoder alone needs {n_enc} — config/params mismatch"
+            )
+        enc_leaves, leaves = leaves[:n_enc], leaves[n_enc:]
+        h, w, c = pixel_shape
+        convs = []
+        prev_c = c
+        for i, feat in enumerate(features):
+            bias, kernel = enc_leaves[2 * i], enc_leaves[2 * i + 1]
+            if bias.shape != (feat,) or kernel.shape != (3, 3, prev_c, feat):
+                raise ValueError(
+                    f"encoder conv {i}: bundle leaves are bias{bias.shape}"
+                    f" / kernel{kernel.shape}, config implies bias({feat},)"
+                    f" / kernel(3, 3, {prev_c}, {feat})"
+                )
+            convs.append((np.asarray(kernel, np.float32),
+                          np.asarray(bias, np.float32)))
+            prev_c = feat
+        flat = -(-h // 2) * -(-w // 2) * features[-1]
+        d_bias, d_kernel = enc_leaves[8], enc_leaves[9]
+        ln_bias, ln_scale = enc_leaves[10], enc_leaves[11]
+        if d_bias.shape != (embed,) or d_kernel.shape != (flat, embed):
+            raise ValueError(
+                f"encoder dense: bundle leaves are bias{d_bias.shape} / "
+                f"kernel{d_kernel.shape}, config implies bias({embed},) / "
+                f"kernel({flat}, {embed})"
+            )
+        if ln_bias.shape != (embed,) or ln_scale.shape != (embed,):
+            raise ValueError(
+                f"encoder layernorm: bundle leaves are {ln_bias.shape} / "
+                f"{ln_scale.shape}, config implies ({embed},) twice"
+            )
+        encoder = _NumpyPixelEncoder(
+            convs,
+            (np.asarray(d_kernel, np.float32),
+             np.asarray(d_bias, np.float32)),
+            (np.asarray(ln_bias, np.float32),
+             np.asarray(ln_scale, np.float32)),
+            pixel_shape,
+        )
+        trunk_in = embed
     widths = hidden + [action_dim]
     if len(leaves) != 2 * len(widths):
         raise ValueError(
-            f"bundle has {len(leaves)} param leaves, config implies "
+            f"bundle has {len(leaves)} trunk param leaves, config implies "
             f"{2 * len(widths)} (MLP {hidden} → {action_dim})"
         )
     layers: List[Tuple[np.ndarray, np.ndarray]] = []
-    prev = obs_dim
+    prev = trunk_in
     for i, width in enumerate(widths):
         bias, kernel = leaves[2 * i], leaves[2 * i + 1]
         if bias.shape != (width,) or kernel.shape != (prev, width):
@@ -166,6 +296,7 @@ def load_numpy_policy(bundle_dir: str) -> NumpyPolicy:
         )
         prev = width
     meta = doc.get("meta") or {}
+    obs_norm_doc = doc.get("obs_norm")
     return NumpyPolicy(
         layers=layers,
         obs_dim=obs_dim,
@@ -174,9 +305,14 @@ def load_numpy_policy(bundle_dir: str) -> NumpyPolicy:
         gamma=float(agent.get("gamma", 0.99)),
         env=meta.get("env"),
         generation=int(meta.get("generation", 0)),
-        obs_norm=_derive_obs_norm(doc.get("obs_norm"), obs_dim),
+        obs_norm=_derive_obs_norm(obs_norm_doc, obs_dim),
         mtime=mtime,
         path=os.path.abspath(bundle_dir),
+        encoder=encoder,
+        stats_generation=(
+            int(meta.get("stats_generation", meta.get("generation", 0)))
+            if obs_norm_doc is not None else 0
+        ),
     )
 
 

@@ -1,4 +1,4 @@
-"""Payload codecs for the fleet frames (HELLO / WINDOWS / WINDOWS_OK).
+"""Payload codecs for the fleet frames (HELLO / WINDOWS / WINDOWS2 / …).
 
 The frame layout itself — magic, version, type, req_id, length — is the
 policy server's (``d4pg_tpu/serve/protocol.py``); this module only defines
@@ -6,10 +6,18 @@ what goes INSIDE the fleet frames:
 
 ``HELLO`` (JSON)
     The actor's opening handshake: ``{actor_id, env, obs_dim, action_dim,
-    n_step, gamma, generation}``. The ingest server validates the data
-    shape against its replay config — a dims/n-step/gamma mismatch is a
-    config error that would silently corrupt training, so it is refused
-    with ``ERROR`` before any window is accepted.
+    n_step, gamma, generation}`` plus — since ISSUE 13 — an optional
+    ``caps`` vector (``{wire, obs_modes, her, obs_norm}``) the ingest
+    server negotiates against the learner's replay requirements
+    (``replay/source.py:negotiate_fleet``). The ingest server validates
+    the data shape against its replay config — a dims/n-step/gamma
+    mismatch is a config error that would silently corrupt training, so
+    it is refused with ``ERROR`` before any window is accepted; a
+    capability mismatch is refused the same way with a STRUCTURED JSON
+    reason (:func:`encode_refusal`) so a mis-deployed actor host fails
+    actionably. A HELLO without ``caps`` negotiates as a pre-ISSUE-13
+    actor (v1 wire, f32 rows, no HER, no stats tagging) and — when the
+    learner requires nothing more — gets the byte-identical v1 reply.
 
 ``HELLO_OK`` (JSON)
     ``{generation, max_windows_per_frame, max_inflight}`` — the learner's
@@ -29,27 +37,110 @@ what goes INSIDE the fleet frames:
     writer path rounds (``ReplayBuffer.add_batch``'s cast), which is what
     makes fleet vs in-process replay content byte-identical.
 
-``WINDOWS_OK`` (struct)
-    ``u32 accepted, u32 dropped_stale`` — the per-frame account. A frame
-    shed at admission (bounded queue full) is answered ``OVERLOADED``
-    with reason ``queue_full`` instead, mirroring the serve batcher's
-    explicit shed contract.
+``WINDOWS2`` (binary, frame version 2 — ISSUE 13)
+    ``u32 generation, u32 stats_generation, u32 count, u8 obs_mode,
+    u8 flags, u16 reserved`` then COLUMNAR blocks: obs rows in the wire
+    mode, actions/rewards/next_obs/discounts (next_obs in the wire mode
+    too, the rest f32). Obs wire modes:
 
-Deliberately JAX-free (numpy + stdlib): imported by actor hosts.
+    - ``f32`` — byte-identical to ``WINDOWS``' columns;
+    - ``u8``  — pixel rows quantized at EXACTLY the point
+      ``ReplayBuffer._encode_obs`` quantizes (``rint(obs·255)`` clipped
+      to [0, 255]) so the stored buffer bytes stay fleet-vs-local
+      identical while the wire carries 1 byte/element (the 17.4 MB/s
+      ingest bench rules out raw f32 pixel rows);
+    - ``bf16`` — flat rows truncated to bfloat16 (round-to-nearest-even,
+      2 bytes/element). The one DECLARED-lossy mode: content is
+      bf16-rounded f32 by contract, stated in the composition matrix.
+
+    ``flags`` bit 0 marks hindsight-RELABELED windows (actor-side HER):
+    content-wise ordinary windows, but excluded from the ingest-side
+    obs-norm statistics fold (the local path folds each observed step
+    once, with its ORIGINAL goal — relabels would multi-count it).
+
+``WINDOWS_OK`` (struct)
+    ``u32 accepted, u32 dropped_stale`` — the per-frame account
+    (``dropped_stale`` covers bundle-generation AND stats-generation
+    drops; the server's counters split them). A frame shed at admission
+    (bounded queue full) is answered ``OVERLOADED`` with reason
+    ``queue_full`` instead, mirroring the serve batcher's explicit shed
+    contract.
+
+Deliberately JAX-free (numpy + stdlib; the bf16 wire mode lazily uses
+``ml_dtypes``, a numpy extension with no JAX runtime): imported by actor
+hosts.
 """
 
 from __future__ import annotations
 
 import json
 import struct
-from typing import Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 
 from d4pg_tpu.serve.protocol import MAX_PAYLOAD, ProtocolError
 
 _WINDOWS_HEAD = struct.Struct("<II")   # generation, count
+# generation, stats_generation, count, obs_mode, flags, reserved
+_WINDOWS2_HEAD = struct.Struct("<IIIBBH")
 _WINDOWS_OK = struct.Struct("<II")     # accepted, dropped_stale
+
+# Obs wire modes (WINDOWS2 header ``obs_mode``); the negotiation
+# vocabulary lives in replay/source.py:OBS_MODES — same names.
+OBS_MODE_IDS = {"f32": 0, "u8": 1, "bf16": 2}
+OBS_MODE_NAMES = {v: k for k, v in OBS_MODE_IDS.items()}
+OBS_MODE_BYTES = {"f32": 4, "u8": 1, "bf16": 2}
+
+FLAG_RELABELED = 1  # WINDOWS2 flags bit 0: hindsight-relabeled window
+
+
+def _bf16_dtype():
+    """bfloat16 as a numpy dtype WITHOUT the JAX runtime (ml_dtypes is a
+    standalone numpy extension). Lazy so f32/u8 actor hosts never pay —
+    or need — the import."""
+    import ml_dtypes
+
+    return ml_dtypes.bfloat16
+
+
+def quantize_obs_u8(obs: np.ndarray) -> np.ndarray:
+    """[0,1]-float rows → wire bytes with EXACTLY the replay buffer's
+    store-time quantization (``ReplayBuffer._encode_obs``): this shared
+    rounding point is what makes u8 fleet windows land byte-identical to
+    locally collected pixel rows after ``add_batch`` re-quantizes. The
+    255 here is an invariant, not a default: quantized buffers REFUSE
+    any other ``obs_scale`` at construction (uniform.py), so the two
+    quantizers cannot diverge."""
+    obs = np.asarray(obs, np.float32)
+    return np.clip(np.rint(obs * 255.0), 0.0, 255.0).astype(np.uint8)
+
+
+def encode_obs_block(obs: np.ndarray, obs_mode: str) -> bytes:
+    if obs_mode == "f32":
+        return np.ascontiguousarray(obs, np.float32).tobytes()
+    if obs_mode == "u8":
+        return np.ascontiguousarray(quantize_obs_u8(obs)).tobytes()
+    if obs_mode == "bf16":
+        return np.ascontiguousarray(
+            np.asarray(obs, np.float32).astype(_bf16_dtype())
+        ).tobytes()
+    raise ProtocolError(f"unknown obs wire mode {obs_mode!r}")
+
+
+def decode_obs_block(buf: bytes, count: int, obs_dim: int,
+                     obs_mode: str) -> np.ndarray:
+    """Wire bytes → f32 rows, inverting :func:`encode_obs_block` (u8
+    decodes ÷255 so the replay's re-quantization round-trips exactly)."""
+    if obs_mode == "f32":
+        return np.frombuffer(buf, np.float32).reshape(count, obs_dim).copy()
+    if obs_mode == "u8":
+        raw = np.frombuffer(buf, np.uint8).reshape(count, obs_dim)
+        return raw.astype(np.float32) / 255.0
+    if obs_mode == "bf16":
+        raw = np.frombuffer(buf, _bf16_dtype()).reshape(count, obs_dim)
+        return raw.astype(np.float32)
+    raise ProtocolError(f"unknown obs wire mode {obs_mode!r}")
 
 
 def window_row_floats(obs_dim: int, action_dim: int) -> int:
@@ -58,18 +149,28 @@ def window_row_floats(obs_dim: int, action_dim: int) -> int:
     return 2 * obs_dim + action_dim + 2
 
 
-def max_windows_per_frame(obs_dim: int, action_dim: int, cap: int = 256) -> int:
+def window_row_bytes(obs_dim: int, action_dim: int,
+                     obs_mode: str = "f32") -> int:
+    """Wire bytes per window row in the given obs mode (obs and next_obs
+    carry the mode; action/reward/discount stay f32)."""
+    return (
+        2 * obs_dim * OBS_MODE_BYTES[obs_mode] + 4 * (action_dim + 2)
+    )
+
+
+def max_windows_per_frame(obs_dim: int, action_dim: int, cap: int = 256,
+                          obs_mode: str = "f32") -> int:
     """Largest window count per frame that fits ``MAX_PAYLOAD``, capped —
     a frame is also the shed/ack granularity, so unboundedly large frames
     would make admission control coarse."""
-    fit = (MAX_PAYLOAD - _WINDOWS_HEAD.size) // (
-        4 * window_row_floats(obs_dim, action_dim)
+    head = max(_WINDOWS_HEAD.size, _WINDOWS2_HEAD.size)
+    fit = (MAX_PAYLOAD - head) // window_row_bytes(
+        obs_dim, action_dim, obs_mode
     )
     if fit < 1:
         raise ValueError(
-            f"one window row (obs_dim={obs_dim}, action_dim={action_dim}) "
-            f"exceeds MAX_PAYLOAD={MAX_PAYLOAD}; the fleet path is for flat "
-            "observation vectors"
+            f"one window row (obs_dim={obs_dim}, action_dim={action_dim}, "
+            f"obs_mode={obs_mode}) exceeds MAX_PAYLOAD={MAX_PAYLOAD}"
         )
     return max(1, min(cap, fit))
 
@@ -84,18 +185,27 @@ def encode_hello(
     n_step: int,
     gamma: float,
     generation: int,
+    caps: Optional[dict] = None,
 ) -> bytes:
-    return json.dumps(
-        {
-            "actor_id": actor_id,
-            "env": env,
-            "obs_dim": int(obs_dim),
-            "action_dim": int(action_dim),
-            "n_step": int(n_step),
-            "gamma": float(gamma),
-            "generation": int(generation),
+    doc = {
+        "actor_id": actor_id,
+        "env": env,
+        "obs_dim": int(obs_dim),
+        "action_dim": int(action_dim),
+        "n_step": int(n_step),
+        "gamma": float(gamma),
+        "generation": int(generation),
+    }
+    if caps is not None:
+        # {wire, obs_modes, her, obs_norm} — absent for pre-ISSUE-13
+        # actors, which negotiate as LEGACY_ACTOR_CAPS server-side.
+        doc["caps"] = {
+            "wire": int(caps.get("wire", 2)),
+            "obs_modes": [str(m) for m in caps.get("obs_modes", ("f32",))],
+            "her": bool(caps.get("her", False)),
+            "obs_norm": bool(caps.get("obs_norm", False)),
         }
-    ).encode()
+    return json.dumps(doc).encode()
 
 
 def decode_hello(payload: bytes) -> dict:
@@ -108,21 +218,46 @@ def decode_hello(payload: bytes) -> dict:
             doc[k] = int(doc[k])
         doc["gamma"] = float(doc["gamma"])
         doc["generation"] = int(doc.get("generation", 0))
+        caps = doc.get("caps")
+        if caps is not None:
+            # same single-coercion-point contract as the numerics above
+            doc["caps"] = {
+                "wire": int(caps.get("wire", 2)),
+                "obs_modes": [str(m) for m in (caps.get("obs_modes")
+                                               or ["f32"])],
+                "her": bool(caps.get("her", False)),
+                "obs_norm": bool(caps.get("obs_norm", False)),
+            }
         return doc
-    except (ValueError, KeyError, TypeError, UnicodeDecodeError) as e:
+    except (ValueError, KeyError, TypeError, AttributeError,
+            UnicodeDecodeError) as e:
         raise ProtocolError(f"malformed HELLO payload: {e}") from e
 
 
 def encode_hello_ok(
-    *, generation: int, max_windows: int, max_inflight: int
+    *,
+    generation: int,
+    max_windows: int,
+    max_inflight: int,
+    caps: Optional[dict] = None,
+    stats_generation: Optional[int] = None,
 ) -> bytes:
-    return json.dumps(
-        {
-            "generation": int(generation),
-            "max_windows_per_frame": int(max_windows),
-            "max_inflight": int(max_inflight),
+    doc = {
+        "generation": int(generation),
+        "max_windows_per_frame": int(max_windows),
+        "max_inflight": int(max_inflight),
+    }
+    if caps is not None:
+        # Only present when the actor negotiated (sent caps): a caps-less
+        # v1 HELLO gets this reply WITHOUT the keys below — byte-identical
+        # to the pre-ISSUE-13 HELLO_OK (the compat regression pins it).
+        doc["caps"] = {
+            "obs_mode": str(caps.get("obs_mode", "f32")),
+            "her": bool(caps.get("her", False)),
+            "obs_norm": bool(caps.get("obs_norm", False)),
         }
-    ).encode()
+        doc["stats_generation"] = int(stats_generation or 0)
+    return json.dumps(doc).encode()
 
 
 def decode_hello_ok(payload: bytes) -> dict:
@@ -130,9 +265,47 @@ def decode_hello_ok(payload: bytes) -> dict:
         doc = json.loads(payload.decode())
         for k in ("generation", "max_windows_per_frame", "max_inflight"):
             doc[k] = int(doc[k])
+        if "caps" in doc:
+            caps = doc["caps"]
+            doc["caps"] = {
+                "obs_mode": str(caps.get("obs_mode", "f32")),
+                "her": bool(caps.get("her", False)),
+                "obs_norm": bool(caps.get("obs_norm", False)),
+            }
+            doc["stats_generation"] = int(doc.get("stats_generation", 0))
         return doc
-    except (ValueError, KeyError, TypeError, UnicodeDecodeError) as e:
+    except (ValueError, KeyError, TypeError, AttributeError,
+            UnicodeDecodeError) as e:
         raise ProtocolError(f"malformed HELLO_OK payload: {e}") from e
+
+
+def encode_refusal(message: str, gaps=()) -> bytes:
+    """Structured handshake refusal: the ERROR payload a capability (or
+    dims) mismatch gets. Keeps the human-readable ``handshake refused:``
+    prefix inside ``message`` (pre-ISSUE-13 actors print the payload
+    verbatim) and adds the machine-readable ``gaps`` list
+    (``[{code, message}]``) new actors parse/alert on."""
+    return json.dumps(
+        {
+            "refused": "handshake",
+            "message": f"handshake refused: {message}",
+            "gaps": [
+                {"code": g.code, "message": g.message} for g in gaps
+            ],
+        }
+    ).encode()
+
+
+def decode_refusal(payload: bytes) -> Optional[dict]:
+    """Parse an ERROR payload as a structured refusal; None when it is a
+    plain-text error (old server / non-handshake failure)."""
+    try:
+        doc = json.loads(payload.decode())
+    except (ValueError, UnicodeDecodeError):
+        return None
+    if isinstance(doc, dict) and doc.get("refused") == "handshake":
+        return doc
+    return None
 
 
 # ---------------------------------------------------------------- WINDOWS
@@ -209,6 +382,104 @@ def decode_windows(
         "next_obs": next_obs,
         "discount": discount,
     }
+
+
+# --------------------------------------------------------------- WINDOWS2
+def encode_windows2(
+    generation: int,
+    stats_generation: int,
+    obs_mode: str,
+    relabeled: bool,
+    obs: np.ndarray,
+    action: np.ndarray,
+    reward: np.ndarray,
+    next_obs: np.ndarray,
+    discount: np.ndarray,
+) -> bytes:
+    """Pack ``n`` complete windows into one WINDOWS2 payload (columnar:
+    obs block, action block, reward, next_obs block, discount). Inputs
+    are f32-shaped like :func:`encode_windows`; obs/next_obs go out in
+    ``obs_mode``."""
+    if obs_mode not in OBS_MODE_IDS:
+        raise ProtocolError(f"unknown obs wire mode {obs_mode!r}")
+    obs = np.atleast_2d(np.asarray(obs, np.float32))
+    next_obs = np.atleast_2d(np.asarray(next_obs, np.float32))
+    action = np.atleast_2d(np.asarray(action, np.float32))
+    n = obs.shape[0]
+    flags = FLAG_RELABELED if relabeled else 0
+    payload = (
+        _WINDOWS2_HEAD.pack(
+            int(generation), int(stats_generation), n,
+            OBS_MODE_IDS[obs_mode], flags, 0,
+        )
+        + encode_obs_block(obs, obs_mode)
+        + np.ascontiguousarray(action).tobytes()
+        + np.asarray(reward, np.float32).tobytes()
+        + encode_obs_block(next_obs, obs_mode)
+        + np.asarray(discount, np.float32).tobytes()
+    )
+    if len(payload) > MAX_PAYLOAD:
+        raise ProtocolError(
+            f"WINDOWS2 payload {len(payload)} bytes > max {MAX_PAYLOAD}; "
+            "send fewer windows per frame"
+        )
+    return payload
+
+
+def decode_windows2(
+    payload: bytes, obs_dim: int, action_dim: int
+) -> Tuple[int, int, str, bool, dict]:
+    """→ ``(generation, stats_generation, obs_mode, relabeled, columns)``
+    with columns decoded to the f32 Transition layout (u8 rows ÷255, bf16
+    widened). ProtocolError on any size inconsistency — the truncated
+    pixel-frame fault path dies HERE, whole."""
+    if len(payload) < _WINDOWS2_HEAD.size:
+        raise ProtocolError(
+            f"WINDOWS2 payload {len(payload)} bytes < header "
+            f"{_WINDOWS2_HEAD.size}"
+        )
+    gen, stats_gen, count, mode_id, flags, _rsvd = _WINDOWS2_HEAD.unpack_from(
+        payload
+    )
+    obs_mode = OBS_MODE_NAMES.get(mode_id)
+    if obs_mode is None:
+        raise ProtocolError(f"WINDOWS2 declares unknown obs mode {mode_id}")
+    ob = obs_dim * OBS_MODE_BYTES[obs_mode]
+    want = _WINDOWS2_HEAD.size + count * (ob * 2 + 4 * (action_dim + 2))
+    if len(payload) != want:
+        raise ProtocolError(
+            f"WINDOWS2 payload is {len(payload)} bytes, header declares "
+            f"{count} rows ({obs_mode} obs) = {want}"
+        )
+    off = _WINDOWS2_HEAD.size
+    obs = decode_obs_block(
+        payload[off:off + count * ob], count, obs_dim, obs_mode
+    )
+    off += count * ob
+    action = np.frombuffer(
+        payload, np.float32, count * action_dim, offset=off
+    ).reshape(count, action_dim).copy()
+    off += 4 * count * action_dim
+    reward = np.frombuffer(payload, np.float32, count, offset=off).copy()
+    off += 4 * count
+    next_obs = decode_obs_block(
+        payload[off:off + count * ob], count, obs_dim, obs_mode
+    )
+    off += count * ob
+    discount = np.frombuffer(payload, np.float32, count, offset=off).copy()
+    return (
+        int(gen),
+        int(stats_gen),
+        obs_mode,
+        bool(flags & FLAG_RELABELED),
+        {
+            "obs": obs,
+            "action": action,
+            "reward": reward,
+            "next_obs": next_obs,
+            "discount": discount,
+        },
+    )
 
 
 # ------------------------------------------------------------- WINDOWS_OK

@@ -60,6 +60,7 @@ from d4pg_tpu.replay import (
     noise_scale_schedule,
 )
 from d4pg_tpu.replay.per import SampledIndices
+from d4pg_tpu.replay.source import validate_train_config
 from d4pg_tpu.runtime.checkpoint import (
     CheckpointManager,
     best_eval_path,
@@ -222,13 +223,19 @@ class Trainer:
         if hasattr(self.env, "max_episode_steps") is False and config.max_episode_steps:
             self.env.max_episode_steps = config.max_episode_steps
         config = _reconcile_config(config, self.env)
-        # --- replay placement (ROADMAP item 1: the megastep data plane) ---
+        self.is_jax_env = not hasattr(self.env, "last_goal_obs")
+        # --- capability negotiation (ISSUE 13: the one data plane) ---
+        # THE validation call site: every placement/scenario rule lives in
+        # replay/source.py:negotiate — a declared gap raises here with the
+        # single-sourced refusal text, a negotiated verdict returns the
+        # declared downgrade actions this constructor applies below.
+        # (train.py validates the same config pre-env for the CLI-only
+        # rules; this post-env pass adds the env-kind-dependent ones.)
+        negotiation = validate_train_config(
+            config, is_jax_env=self.is_jax_env
+        )
         placement = config.replay_placement
-        if placement not in ("host", "device", "hybrid"):
-            raise ValueError(
-                f"replay_placement must be host|device|hybrid, got {placement!r}"
-            )
-        if placement == "device" and config.prioritized:
+        if "per_downgraded_uniform" in negotiation.actions:
             # device placement IS the uniform in-kernel-draw mode; PER needs
             # the host sum-tree, which is exactly what hybrid keeps.
             print(
@@ -241,86 +248,18 @@ class Trainer:
                 prioritized=False,
                 agent=dataclasses.replace(config.agent, prioritized=False),
             )
-        if placement == "hybrid" and not config.prioritized:
-            raise ValueError(
-                "replay_placement=hybrid is the PER mode (host sum-tree "
-                "indices + on-device gather); use replay_placement=device "
-                "for uniform replay"
+        if "prefetch_ignored" in negotiation.actions:
+            print(
+                "[replay] --prefetch double-buffers the host batch "
+                f"upload, which replay_placement={placement} removes; "
+                "ignoring it"
             )
-        if placement != "host":
-            if config.agent.pixel_shape:
-                raise ValueError(
-                    "replay_placement=device/hybrid mirrors f32 rows into "
-                    "HBM; pixel (uint8-quantized) buffers are host-path only "
-                    "for now"
-                )
-            if config.obs_norm:
-                raise ValueError(
-                    "--obs-norm normalizes sampled batches on the host; "
-                    "it is incompatible with a device-resident ring "
-                    "(rows are gathered in-kernel)"
-                )
-            if config.transfer_dtype != "float32":
-                raise ValueError(
-                    "--transfer-dtype compresses the per-dispatch batch "
-                    "upload, which replay_placement=device/hybrid removes "
-                    "entirely; use float32"
-                )
-            if config.dp:
-                # The sharded megastep (ROADMAP item 2): the uniform ring
-                # shards over a dp mesh — rows striped across shards,
-                # in-kernel shard-local draws, deterministic gradient mean
-                # (runtime/megastep.py:make_megastep_uniform_sharded).
-                if placement == "hybrid":
-                    raise ValueError(
-                        "replay_placement=hybrid is single-device: the "
-                        "host sum-tree's [K, B] index blocks are global, "
-                        "so shard-local gathers can't serve them; use "
-                        "--replay-placement device for the sharded "
-                        "(uniform) megastep"
-                    )
-                if config.tp != 1:
-                    raise ValueError(
-                        "the sharded megastep mesh is dp-only (tp=1); "
-                        "tensor parallelism composes via the host-path "
-                        "GSPMD step (--replay-placement host --tp N)"
-                    )
-                if config.dp_hogwild:
-                    raise ValueError(
-                        "--dp-hogwild is a host-path DP mode; the sharded "
-                        "megastep syncs gradients every step"
-                    )
-                if config.batch_size % config.dp:
-                    raise ValueError(
-                        f"--batch-size {config.batch_size} must be "
-                        f"divisible by --dp {config.dp} (each shard draws "
-                        "batch/dp rows)"
-                    )
-                if config.replay_capacity % config.dp:
-                    raise ValueError(
-                        f"replay capacity {config.replay_capacity} must "
-                        f"be divisible by --dp {config.dp} (each shard "
-                        "owns capacity/dp ring rows)"
-                    )
-            if config.prefetch:
-                print(
-                    "[replay] --prefetch double-buffers the host batch "
-                    f"upload, which replay_placement={placement} removes; "
-                    "ignoring it"
-                )
-                config = dataclasses.replace(config, prefetch=False)
+            config = dataclasses.replace(config, prefetch=False)
         self.config = config
         self._placement = placement
-        self.is_jax_env = not hasattr(self.env, "last_goal_obs")
-        self.obs_norm = None
-        if config.obs_norm:
-            if self.is_jax_env or config.agent.pixel_shape:
-                raise ValueError(
-                    "--obs-norm supports host state-feature envs only "
-                    "(pure-JAX envs act inside jit; pixel obs are uint8 "
-                    "frames the conv encoder already scales)"
-                )
-            self.obs_norm = RunningObsNorm(config.agent.obs_dim)
+        self.obs_norm = (
+            RunningObsNorm(config.agent.obs_dim) if config.obs_norm else None
+        )
         agent_cfg = config.agent
 
         # replay — pixel observations are stored uint8-quantized (4× less
@@ -334,12 +273,8 @@ class Trainer:
         # uint8 wire format (transfer_dtype="uint8"): sampled pixel rows
         # stay in their stored byte form and dequantize in-jit — 4× fewer
         # link bytes than f32. Only meaningful for quantized (pixel)
-        # buffers.
-        if config.transfer_dtype == "uint8" and obs_dtype != np.uint8:
-            raise ValueError(
-                "--transfer-dtype uint8 requires a pixel env (uint8-"
-                "quantized replay); use bfloat16 for flat observations"
-            )
+        # buffers (the seam's uint8_wire_requires_pixel gap already
+        # refused the flat-env combination above).
         decode_on_sample = config.transfer_dtype != "uint8"
         if config.prioritized:
             self.buffer = PrioritizedReplayBuffer(
@@ -390,19 +325,13 @@ class Trainer:
             self.state = replicate(self.state, self.mesh)
             self._train_step = make_dp_train_step(agent_cfg, self.mesh)
             if config.dp_hogwild:
-                if config.steps_per_dispatch <= 1:
-                    raise ValueError(
-                        "--dp-hogwild needs --steps-per-dispatch > 1: the "
-                        "dispatch window IS the staleness bound (K local "
-                        "steps between param resyncs)"
-                    )
+                # the fused-window requirement (dp_hogwild_needs_fused_
+                # window) and the dp requirement are the seam's gaps now
                 self._fused_step = make_hogwild_dp_train_step(
                     agent_cfg, self.mesh
                 )
             elif config.steps_per_dispatch > 1:
                 self._fused_step = make_dp_fused_train_step(agent_cfg, self.mesh)
-        elif config.dp_hogwild:
-            raise ValueError("--dp-hogwild is a DP mode: it requires --dp")
         else:
             self.mesh = None
             self._train_step = jit_train_step(agent_cfg)
@@ -740,45 +669,19 @@ class Trainer:
         self._fleet_only = (
             config.fleet_listen is not None and config.num_envs == 0
         )
-        if config.fleet_bundle and config.fleet_listen is None:
-            # The publish crossing is gated on the ingest server existing —
-            # without --fleet-listen no bundle would ever be written, so
-            # refuse loudly instead of silently ignoring the flag (the
-            # --on-device --fleet-listen refusal's convention).
-            raise ValueError(
-                "--fleet-bundle does nothing without --fleet-listen: the "
-                "bundle is published at ingest generation bumps (use "
-                "--export-bundle for a one-shot export)"
-            )
         if config.fleet_listen is not None:
-            if config.her:
-                raise ValueError(
-                    "--fleet-listen is incompatible with --her: hindsight "
-                    "relabeling is episode-local in the trainer, and fleet "
-                    "actors ship already-collapsed n-step windows"
-                )
-            if config.obs_norm:
-                raise ValueError(
-                    "--fleet-listen is incompatible with --obs-norm: the "
-                    "normalizer's statistics fold at the trainer's local "
-                    "collection boundary, which remote windows bypass"
-                )
-            if agent_cfg.pixel_shape:
-                raise ValueError(
-                    "--fleet-listen serves flat observation vectors; pixel "
-                    "envs are collection-local (the conv forward belongs "
-                    "on the accelerator, not a numpy actor host)"
-                )
-            if self._fleet_only and config.async_collect:
-                # The steady-state loop paces the async_collect branch
-                # against a collector thread that does not exist in
-                # fleet-only mode — it would spin forever on a frozen
-                # env_steps counter. Refuse instead of deadlocking.
-                raise ValueError(
-                    "--async-collect needs local envs; with --num-envs 0 "
-                    "the fleet is the only collector (drop --async-collect)"
-                )
+            # ISSUE 13: the pre-negotiation refusal matrix (--her /
+            # --obs-norm / pixel) is GONE — those are capabilities the
+            # HELLO handshake negotiates per actor connection now
+            # (replay/source.py:negotiate_fleet). What remains invalid
+            # (--fleet-bundle without listen, fleet-only --async-collect,
+            # obs-norm with a second local stats writer) was already
+            # refused by the seam's validate call above.
             from d4pg_tpu.fleet.ingest import IngestServer
+            from d4pg_tpu.replay.source import (
+                from_train_config,
+                learner_fleet_caps,
+            )
 
             self._fleet = IngestServer(
                 self.buffer,
@@ -790,6 +693,10 @@ class Trainer:
                 port=config.fleet_listen,
                 queue_limit=config.fleet_queue_limit,
                 max_gen_lag=config.fleet_max_gen_lag,
+                caps=learner_fleet_caps(
+                    from_train_config(config, is_jax_env=self.is_jax_env)
+                ),
+                obs_norm=self.obs_norm,
                 ledger=self._ledger,
                 chaos=self._chaos,
             ).start()
@@ -1225,9 +1132,16 @@ class Trainer:
             jax.device_get(self.state.actor_params),
             action_low=None if norm is None else norm.low,
             action_high=None if norm is None else norm.high,
-            obs_norm_state=None,  # fleet + --obs-norm is refused in __init__
+            # Obs-norm stats ride the bundle — the exact mechanism serving
+            # already uses — generation-tagged via meta.stats_generation so
+            # ingest can drop windows produced under stale statistics with
+            # an honest count (windows_dropped_stale_stats).
+            obs_norm_state=(
+                None if self.obs_norm is None else self.obs_norm.state_dict()
+            ),
             meta={
                 "generation": self._fleet_gen,
+                "stats_generation": self._fleet_gen,
                 "env": cfg.env,
                 "grad_steps": self.grad_steps,
                 "log_dir": os.path.abspath(cfg.log_dir),

@@ -55,13 +55,26 @@ layout on its own port with four more message types (payload codecs in
 ``d4pg_tpu/fleet/wire.py``; full table in docs/fleet.md):
 
 - ``HELLO``        → utf-8 JSON: actor handshake (dims, n_step, gamma,
-                     bundle generation). First frame on every connection.
+                     bundle generation, and — since ISSUE 13 — an
+                     optional capability vector the ingest server
+                     negotiates: supported obs wire modes, actor-side
+                     HER, generation-tagged obs-norm stats). First frame
+                     on every connection.
 - ``HELLO_OK``     ← utf-8 JSON: accepted; carries the learner's current
-                     generation and the flow-control window.
+                     generation and the flow-control window (plus the
+                     negotiated capability set when the actor sent one —
+                     a caps-less HELLO gets the byte-identical v1 reply).
 - ``WINDOWS``      → binary batch of complete n-step windows, tagged with
-                     the producing bundle generation.
-- ``WINDOWS_OK``   ← per-frame ack: (accepted, dropped_stale) counts. A
-                     shed frame is answered ``OVERLOADED`` instead.
+                     the producing bundle generation. Always float32 flat
+                     rows — the pre-ISSUE-13 wire, kept byte-identical.
+- ``WINDOWS2``     → the capability-era window frame (rides frame
+                     version 2): adds a stats generation, an obs wire
+                     mode (f32 / u8-quantized pixel rows / bf16), and a
+                     relabeled-window flag. Codec in fleet/wire.py.
+- ``WINDOWS_OK``   ← per-frame ack: (accepted, dropped_stale) counts
+                     (stale covers both bundle-generation and obs-norm
+                     stats-generation drops). A shed frame is answered
+                     ``OVERLOADED`` instead.
 
 ``read_frame`` returns ``None`` on clean EOF (peer closed between frames)
 and raises :class:`ProtocolError` on anything malformed — oversized
@@ -103,6 +116,7 @@ HELLO_OK = 8
 WINDOWS = 9       # batch of complete n-step windows
 WINDOWS_OK = 10
 ACT2 = 11         # versioned multi-tenant request: policy_id + QoS + tenant
+WINDOWS2 = 12     # capability-era window frame: obs mode + stats generation
 
 # QoS classes carried in the ACT2 frame. Interactive is the protected
 # tier (the router sheds bulk FIRST under overload — docs/serving.md);
@@ -115,7 +129,7 @@ QOS_NAMES = {QOS_INTERACTIVE: "interactive", QOS_BULK: "bulk"}
 # PR-8 wire language). ``write_frame`` applies it, so call sites never
 # choose a version — interop with old peers is automatic for old types,
 # and new types fail loudly on old peers with a version error.
-_FRAME_MIN_VERSION = {ACT2: 2}
+_FRAME_MIN_VERSION = {ACT2: 2, WINDOWS2: 2}
 
 
 class ProtocolError(Exception):
@@ -245,6 +259,28 @@ def write_frame(sock, msg_type: int, req_id: int, payload: bytes = b"") -> None:
             len(payload),
         )
         + payload
+    )
+
+
+def write_truncated_frame(
+    sock, msg_type: int, req_id: int, payload: bytes, keep: int
+) -> None:
+    """CHAOS-ONLY: emit a frame whose header declares the full payload
+    but whose body stops after ``keep`` bytes (the ``pixel_truncate``
+    fault — a peer dying mid-``sendall``). Lives here because the header
+    layout is this module's single point of truth; the receiver's
+    ``read_frame`` must die with ``ProtocolError`` (EOF mid-frame) and
+    the torn frame must never half-land."""
+    keep = max(0, min(int(keep), len(payload)))
+    sock.sendall(
+        HEADER.pack(
+            MAGIC,
+            _FRAME_MIN_VERSION.get(msg_type, 1),
+            msg_type,
+            req_id,
+            len(payload),
+        )
+        + payload[:keep]
     )
 
 

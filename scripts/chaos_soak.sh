@@ -29,7 +29,15 @@
 #      DOWN mid-canary (scaledown_during_canary — the rollout must
 #      abort or complete cleanly, never leaving a half-deployed bundle
 #      dir anywhere, and every other policy's replicas end with
-#      params_reloads == 0).
+#      params_reloads == 0);
+#   8. one data plane (ISSUE 13): a fleet-ONLY learner on a goal env
+#      with --her --obs-norm (the cells the old refusal matrix closed)
+#      fed by real actor hosts doing actor-side relabeling with
+#      generation-tagged stats, under stale_stats (ingest must age the
+#      stale-stats windows out with an honest count), pixel_truncate
+#      (torn WINDOWS2 frame whole-drops), and her_actor_kill (SIGKILL
+#      mid-episode; the restart reconnects) — learner rc 0 with guards
+#      green and the at-most-once accounting identity exact.
 #
 # Knobs (env vars): SOAK_DIR (default mktemp), SOAK_ENV (Pendulum-v1),
 # SOAK_STEPS (grad steps per leg, default 6), SOAK_HIDDEN (16,16),
@@ -636,6 +644,117 @@ print("CHAOS_SOAK_MT_OK", json.dumps({
     "canary_promotions": h["canary_promotions"],
     "tenants": {k: v["requests"] for k, v in h["tenants"].items()},
 }))
+EOF
+
+# ---- leg 8: one data plane — fleet-fed HER + obs-norm + pixel wire under ---
+# ---- stale_stats / pixel_truncate / her_actor_kill (ISSUE 13) --------------
+# A fleet-ONLY learner on a goal env with --her --obs-norm (the cells the
+# old refusal matrix closed), fed by a REAL actor host doing actor-side
+# hindsight relabeling with generation-tagged obs-norm stats riding the
+# bundle, WINDOWS2 frames on the wire. Chaos: the actor keeps stale stats
+# across a hot-swap (ingest must age those windows out with an honest
+# count), truncates a frame mid-send (torn frame whole-drops), and
+# SIGKILLs itself mid-episode (the buffered HER episode dies with it; a
+# supervisor restart reconnects). Contracts: learner rc 0 with guards
+# green, the restarted actor's at-most-once accounting identity EXACT,
+# and the stale-stats drop actually observed.
+FLEET8_PORT=$((20000 + RANDOM % 20000))
+FLEET8_STEPS=${SOAK_FLEET8_STEPS:-200}
+export PYTHONPATH="$PWD/tests${PYTHONPATH:+:$PYTHONPATH}"  # ToyGoal-v0
+# env-steps-per-train-step 30 stretches the learner across many actor
+# episodes so the actor-1 death, the restart, AND actor-2's stale-stats
+# swap all land while it still ingests (windows ARE env steps here)
+leg8_learner=(--env "toy_goal_env:ToyGoal-v0" --hidden-sizes "$HIDDEN"
+              --her --her-k 2 --obs-norm --n-step 3
+              --warmup 24 --bsize 8 --rmsize 2048 --eval-interval 100000
+              --num-envs 0 --fleet-listen "$FLEET8_PORT"
+              --fleet-bundle "$DIR/fleet8_bundle"
+              --fleet-publish-interval 3 --fleet-max-gen-lag 1
+              --env-steps-per-train-step 30
+              --debug-guards --no-concurrent-eval
+              --log-dir "$DIR/fleet8")
+
+python train.py "${leg8_learner[@]}" --total-steps "$FLEET8_STEPS" \
+  --checkpoint-interval 100000 \
+  > "$DIR/fleet8_learner.log" 2>&1 &
+F8LEARNER=$!
+for _ in $(seq 1 600); do
+  [ -f "$DIR/fleet8_bundle/bundle.json" ] \
+    && grep -q "ingest listening" "$DIR/fleet8_learner.log" && break
+  kill -0 "$F8LEARNER" 2>/dev/null \
+    || { cat "$DIR/fleet8_learner.log"; echo "CHAOS_SOAK_FAIL: leg8 learner died at startup"; exit 1; }
+  sleep 0.2
+done
+
+# actor 1: truncates its 2nd frame mid-send, then SIGKILLs itself
+# mid-episode (env step 60 ≈ its 3rd ToyGoal episode)
+python -m d4pg_tpu.fleet.actor --connect "127.0.0.1:$FLEET8_PORT" \
+  --bundle "$DIR/fleet8_bundle" --env "toy_goal_env:ToyGoal-v0" \
+  --her --her-k 2 --batch-windows 8 --poll-interval 0.2 \
+  --stats-interval 5 --seed 21 --reconnect-attempts 400 \
+  --chaos "seed=9;pixel_truncate@2;her_actor_kill@60" \
+  > "$DIR/fleet8_actor1.log" 2>&1 &
+F8A1=$!
+# wait for the SIGKILL chaos to fire (the supervisor-restart story)
+for _ in $(seq 1 600); do
+  kill -0 "$F8A1" 2>/dev/null || break
+  sleep 0.2
+done
+kill -0 "$F8A1" 2>/dev/null \
+  && { echo "CHAOS_SOAK_FAIL: her_actor_kill never fired"; exit 1; }
+grep -q "her_actor_kill: SIGKILL self" "$DIR/fleet8_actor1.log" \
+  || { cat "$DIR/fleet8_actor1.log"; echo "CHAOS_SOAK_FAIL: actor died for the wrong reason"; exit 1; }
+
+# actor 2: the restart — its FIRST bundle hot-swap keeps the old stats
+# (stale_stats@1); publishes outpace the 0.3 s poll by design, so the
+# pinned stats generation falls > max-gen-lag behind mid-run and the
+# ingest drop path is observed while the learner still logs
+python -m d4pg_tpu.fleet.actor --connect "127.0.0.1:$FLEET8_PORT" \
+  --bundle "$DIR/fleet8_bundle" --env "toy_goal_env:ToyGoal-v0" \
+  --her --her-k 2 --batch-windows 8 --poll-interval 0.3 \
+  --stats-interval 5 --seed 22 --reconnect-attempts 400 \
+  --chaos "seed=11;stale_stats@1" \
+  > "$DIR/fleet8_actor2.log" 2>&1 &
+F8A2=$!
+
+wait "$F8LEARNER" \
+  || { cat "$DIR/fleet8_learner.log"; echo "CHAOS_SOAK_FAIL: leg8 learner exited non-zero"; exit 1; }
+grep -q "\[lockwitness\].*0 contradictions" "$DIR/fleet8_learner.log" \
+  || { cat "$DIR/fleet8_learner.log"; echo "CHAOS_SOAK_FAIL: leg8 learner recorded no lock-order witness verdict"; exit 1; }
+
+kill -TERM "$F8A2" 2>/dev/null || true
+wait "$F8A2" \
+  || { cat "$DIR/fleet8_actor2.log"; echo "CHAOS_SOAK_FAIL: leg8 actor-2 drain exited non-zero"; exit 1; }
+
+python - "$DIR" <<'EOF'
+import ast, json, sys
+d = sys.argv[1]
+# the restarted actor's at-most-once identity is EXACT
+drained = [l for l in open(f"{d}/fleet8_actor2.log") if "drained:" in l][-1]
+s = ast.literal_eval(drained.split("drained:", 1)[1].strip())
+acct = (s["windows_acked"] + s["windows_stale"] + s["windows_shed"]
+        + s["windows_dropped_reconnect"] + s["windows_dropped_spool"]
+        + s["spool_depth"])
+assert acct == s["windows_emitted"], (acct, s)
+# the learner ingested relabeled + original windows with guards green
+# (rc 0 above) and observed the chaos: stale-stats drops counted, the
+# truncated frame died as a protocol error, never a torn window
+rows = [json.loads(l) for l in open(f"{d}/fleet8/metrics.jsonl")]
+fleet = [r for r in rows if "fleet_windows_ingested" in r]
+assert fleet and fleet[-1]["fleet_windows_ingested"] > 0
+last = fleet[-1]
+a2 = open(f"{d}/fleet8_actor2.log").read()
+assert "chaos stale_stats" in a2, "stale_stats never fired"
+assert last.get("fleet_windows_dropped_stale_stats", 0) > 0, last
+assert last.get("fleet_protocol_errors", 0) >= 1, last  # truncated frame
+assert last.get("fleet_handshake_refusals", 0) == 0, last
+print("CHAOS_SOAK_LEG8_OK", {
+    "ingested": last["fleet_windows_ingested"],
+    "dropped_stale_stats": last["fleet_windows_dropped_stale_stats"],
+    "protocol_errors": last["fleet_protocol_errors"],
+    "actor2": {k: s[k] for k in ("windows_emitted", "windows_acked",
+                                 "windows_dropped_reconnect")},
+})
 EOF
 
 echo "CHAOS_SOAK_OK"

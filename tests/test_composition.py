@@ -1,0 +1,329 @@
+"""Capability-seam tests: the negotiation table, the committed matrix,
+the single validation call site, and the fleet HELLO negotiation
+(ISSUE 13)."""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from d4pg_tpu.config import TrainConfig
+from d4pg_tpu.fleet import wire
+from d4pg_tpu.fleet.ingest import IngestServer
+from d4pg_tpu.ops.obs_norm import RunningObsNorm
+from d4pg_tpu.replay import source
+from d4pg_tpu.replay.uniform import ReplayBuffer
+from d4pg_tpu.serve import protocol
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ARTIFACT = os.path.join(REPO, "benchmarks", "composition_matrix.json")
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return pred()
+
+
+# ----------------------------------------------------------- rule table
+def test_every_cell_has_verdict_and_reasons():
+    cells = source.composition_matrix()
+    assert len(cells) == len(source.SCENARIOS) * len(source.PLACEMENTS)
+    for c in cells:
+        assert c["verdict"] in ("pass", "negotiated", "gap")
+        if c["verdict"] == "gap":
+            assert c["gaps"] and all(
+                g["code"] and g["message"] for g in c["gaps"]
+            )
+        if c["verdict"] == "negotiated":
+            assert c["actions"]
+
+
+def test_issue13_cells_are_open():
+    """The cells the old refusal matrix closed are now pass at host
+    placement: fleet × {pixel, obs-norm, HER, HER+obs-norm}."""
+    by = {(c["scenario"], c["placement"]): c["verdict"]
+          for c in source.composition_matrix()}
+    for scen in ("fleet_pixel", "fleet_obs_norm", "fleet_her",
+                 "fleet_her_obs_norm", "fleet_bf16_wire"):
+        assert by[(scen, "host")] == "pass", scen
+
+
+def test_device_per_is_negotiated_not_refused():
+    n = source.negotiate(source.RequestedCaps(placement="device"))
+    assert n.verdict == "negotiated"
+    assert "per_downgraded_uniform" in n.actions
+
+
+def test_committed_artifact_is_fresh_and_schema_clean():
+    """Tier-1 regeneration smoke: the committed artifact equals a fresh
+    evaluation of the rule table, and the schema gate passes it."""
+    sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+    try:
+        import composition_matrix as gen
+    finally:
+        sys.path.pop(0)
+    with open(ARTIFACT) as f:
+        committed = json.load(f)
+    assert committed == gen.build(), (
+        "benchmarks/composition_matrix.json is stale — regenerate with "
+        "`python benchmarks/composition_matrix.py`"
+    )
+    from tools.d4pglint.schema_check import check_composition_matrix
+
+    assert check_composition_matrix(ARTIFACT) == []
+
+
+def test_schema_gate_refuses_undeclared_refusal(tmp_path):
+    """A gap cell stripped of its machine-readable reasons — an
+    UNDECLARED refusal — must not be committable."""
+    from tools.d4pglint.schema_check import check_composition_matrix
+
+    with open(ARTIFACT) as f:
+        doc = json.load(f)
+    gap_cells = [c for c in doc["cells"] if c["verdict"] == "gap"]
+    gap_cells[0].pop("gaps")
+    p = tmp_path / "composition_matrix.json"
+    p.write_text(json.dumps(doc))
+    errs = check_composition_matrix(str(p))
+    assert any("undeclared refusals" in e for e in errs)
+    # and a stale-cell drift is caught too
+    doc["cells"][0]["verdict"] = "gap"
+    doc["cells"][0]["gaps"] = [{"code": "x", "message": "y"}]
+    p.write_text(json.dumps(doc))
+    errs = check_composition_matrix(str(p))
+    assert any("stale" in e for e in errs)
+
+
+# -------------------------------------------------- single call site
+def test_trainer_refusal_text_is_the_seam_text():
+    """The Trainer and the CLI raise the seam's exact message — the
+    drift the satellite kills. Checked WITHOUT building a Trainer: both
+    call sites call validate_train_config, pinned here on a gap config."""
+    cfg = TrainConfig(replay_placement="hybrid", prioritized=False)
+    n = source.negotiate(source.from_train_config(cfg))
+    with pytest.raises(ValueError) as ei:
+        source.validate_train_config(cfg)
+    assert str(ei.value) == n.message()
+    assert "hybrid is the PER mode" in str(ei.value)
+
+
+def test_cli_and_constructor_share_on_device_rules():
+    cfg = TrainConfig(fleet_listen=5000, obs_norm=True, num_envs=0)
+    with pytest.raises(ValueError) as ei:
+        source.validate_train_config(cfg, on_device=True)
+    msg = str(ei.value)
+    assert "--fleet-listen feeds the HOST replay buffer" in msg
+    assert "--obs-norm is a host data-boundary feature" in msg
+
+
+def test_mixed_mode_obs_norm_single_writer_gap():
+    cfg = TrainConfig(fleet_listen=5000, obs_norm=True, num_envs=2)
+    with pytest.raises(ValueError, match="exactly one"):
+        source.validate_train_config(cfg)
+    # fleet-only is the open cell
+    ok = TrainConfig(fleet_listen=5000, obs_norm=True, num_envs=0)
+    assert source.validate_train_config(
+        ok, is_jax_env=False
+    ).verdict == "pass"
+
+
+# ------------------------------------------------- fleet HELLO negotiation
+OBS, ACT, NSTEP, GAMMA = 5, 2, 3, 0.97
+
+
+def _start(caps=None, obs_norm=None, **kw):
+    buf = ReplayBuffer(256, OBS, ACT)
+    srv = IngestServer(
+        buf, obs_dim=OBS, action_dim=ACT, n_step=NSTEP, gamma=GAMMA,
+        host="127.0.0.1", port=0, caps=caps, obs_norm=obs_norm, **kw,
+    ).start()
+    return srv, buf
+
+
+def _hello(srv, caps=None, generation=0):
+    s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+    s.settimeout(5)
+    protocol.write_frame(
+        s, protocol.HELLO, 1,
+        wire.encode_hello(
+            actor_id="t", env="e", obs_dim=OBS, action_dim=ACT,
+            n_step=NSTEP, gamma=GAMMA, generation=generation, caps=caps,
+        ),
+    )
+    return s, protocol.read_frame(s)
+
+
+def test_legacy_hello_gets_byte_identical_v1_reply():
+    """A caps-less HELLO against a default-caps server: HELLO_OK payload
+    bytes are EXACTLY the pre-ISSUE-13 encoding (no caps key)."""
+    srv, _ = _start()
+    try:
+        s, (t, _r, payload) = _hello(srv)
+        assert t == protocol.HELLO_OK
+        want = wire.encode_hello_ok(
+            generation=0, max_windows=srv.max_windows,
+            max_inflight=srv.max_inflight,
+        )
+        assert payload == want
+        assert "caps" not in json.loads(payload.decode())
+        s.close()
+    finally:
+        srv.close()
+
+
+def test_capability_mismatch_refused_with_structured_reason():
+    """A HER-requiring learner refuses a non-HER actor (and a legacy
+    one) with machine-readable gap codes, never a silent accept."""
+    srv, _ = _start(caps={"obs_mode": "f32", "her": True, "obs_norm": False})
+    try:
+        # legacy actor: no caps at all
+        s, (t, _r, payload) = _hello(srv)
+        assert t == protocol.ERROR
+        doc = wire.decode_refusal(payload)
+        assert doc and [g["code"] for g in doc["gaps"]] == ["her_required"]
+        assert "handshake refused" in doc["message"]
+        s.close()
+        # new actor, explicitly without --her
+        s, (t, _r, payload) = _hello(
+            srv, caps={"obs_modes": ["f32"], "her": False, "obs_norm": False}
+        )
+        doc = wire.decode_refusal(payload)
+        assert t == protocol.ERROR and doc
+        assert [g["code"] for g in doc["gaps"]] == ["her_required"]
+        s.close()
+        assert srv.counters()["handshake_refusals"] == 2
+        # matching actor: accepted, caps echoed
+        s, (t, _r, payload) = _hello(
+            srv, caps={"obs_modes": ["f32"], "her": True, "obs_norm": False}
+        )
+        assert t == protocol.HELLO_OK
+        ok = wire.decode_hello_ok(payload)
+        assert ok["caps"] == {"obs_mode": "f32", "her": True,
+                              "obs_norm": False}
+        s.close()
+    finally:
+        srv.close()
+
+
+def test_u8_negotiation_and_v1_frame_rejected_on_u8_ingest():
+    srv, _ = _start(caps={"obs_mode": "u8", "her": False, "obs_norm": False})
+    try:
+        s, (t, _r, payload) = _hello(
+            srv,
+            caps={"obs_modes": ["f32", "u8", "bf16"], "her": False,
+                  "obs_norm": False},
+        )
+        assert t == protocol.HELLO_OK
+        assert wire.decode_hello_ok(payload)["caps"]["obs_mode"] == "u8"
+        # a v1 WINDOWS frame on the u8 ingest: protocol error, ERROR+close
+        rng = np.random.default_rng(0)
+        protocol.write_frame(
+            s, protocol.WINDOWS, 2,
+            wire.encode_windows(
+                0, rng.random((2, OBS), np.float32),
+                rng.random((2, ACT), np.float32),
+                rng.random(2).astype(np.float32),
+                rng.random((2, OBS), np.float32),
+                rng.random(2).astype(np.float32),
+            ),
+        )
+        t, _r, payload = protocol.read_frame(s)
+        assert t == protocol.ERROR and b"WINDOWS2" in payload
+        assert protocol.read_frame(s) is None
+        s.close()
+        assert _wait(lambda: srv.counters()["protocol_errors"] == 1)
+    finally:
+        srv.close()
+
+
+def _send_w2(s, req_id, gen, stats_gen, relabeled, rows=3, fill=None):
+    rng = np.random.default_rng(req_id)
+    obs = (
+        np.full((rows, OBS), fill, np.float32)
+        if fill is not None else rng.random((rows, OBS), np.float32)
+    )
+    protocol.write_frame(
+        s, protocol.WINDOWS2, req_id,
+        wire.encode_windows2(
+            gen, stats_gen, "f32", relabeled,
+            obs, rng.random((rows, ACT), np.float32),
+            rng.random(rows).astype(np.float32),
+            rng.random((rows, OBS), np.float32),
+            rng.random(rows).astype(np.float32),
+        ),
+    )
+    return protocol.read_frame(s)
+
+
+def test_stale_stats_dropped_and_counted_fold_originals_only():
+    """Windows under stale obs-norm statistics are counted + dropped
+    like stale-generation ones; accepted ORIGINAL windows fold the
+    statistics, relabeled ones never do."""
+    norm = RunningObsNorm(OBS)
+    srv, buf = _start(
+        caps={"obs_mode": "f32", "her": True, "obs_norm": True},
+        obs_norm=norm, max_gen_lag=1,
+    )
+    try:
+        srv.set_generation(5)
+        s, (t, _r, payload) = _hello(
+            srv, caps={"obs_modes": ["f32"], "her": True, "obs_norm": True},
+            generation=5,
+        )
+        assert t == protocol.HELLO_OK
+        assert wire.decode_hello_ok(payload)["stats_generation"] == 5
+        # stats_gen 3 < 5 - 1: stale stats (gen itself is fresh)
+        t, _r, p = _send_w2(s, 2, gen=5, stats_gen=3, relabeled=False)
+        assert t == protocol.WINDOWS_OK
+        assert wire.decode_windows_ok(p) == (0, 3)
+        c = srv.counters()
+        assert c["windows_dropped_stale_stats"] == 3
+        assert c["windows_dropped_stale_gen"] == 0
+        assert norm.count == 0  # dropped windows never fold
+        # fresh original window: accepted AND folded
+        t, _r, p = _send_w2(s, 3, gen=5, stats_gen=5, relabeled=False)
+        assert wire.decode_windows_ok(p) == (3, 0)
+        assert _wait(lambda: srv.counters()["windows_ingested"] == 3)
+        assert norm.count == 3
+        # relabeled window: accepted, NOT folded
+        t, _r, p = _send_w2(s, 4, gen=5, stats_gen=5, relabeled=True)
+        assert wire.decode_windows_ok(p) == (3, 0)
+        assert _wait(lambda: srv.counters()["windows_ingested"] == 6)
+        assert norm.count == 3
+        assert len(buf) == 6
+        s.close()
+    finally:
+        srv.close()
+
+
+# -------------------------------------------------- tier-1 clock guard
+def test_fast_tier_additions_fit_budget():
+    """ISSUE-13 satellite: the new fast-tier suites must stay lean. The
+    parity + composition suites (this file and
+    test_data_plane_parity.py) assert their own combined budget by
+    re-running the parity suite in a subprocess and timing it — well
+    under the ~300 s of tier-1 headroom the ISSUE names (the heavy
+    400-step compositions are slow-marked)."""
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+         os.path.join(REPO, "tests", "test_data_plane_parity.py")],
+        cwd=REPO, capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        timeout=120,
+    )
+    dt = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert dt < 60.0, f"parity suite took {dt:.1f}s — trim it"

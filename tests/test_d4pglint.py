@@ -43,6 +43,7 @@ HELLO_OK = 8
 WINDOWS = 9
 WINDOWS_OK = 10
 ACT2 = 11
+WINDOWS2 = 12
 
 
 class ProtocolError(Exception):

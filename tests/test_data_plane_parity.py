@@ -1,0 +1,281 @@
+"""ISSUE 13 byte-parity suite: fleet vs local window CONTENT per mode.
+
+The one-data-plane contract is that HOW experience reaches replay
+(in-process writers vs the fleet wire) never changes WHAT lands in it:
+
+- f32 flat windows: byte-identical through WINDOWS and WINDOWS2;
+- u8 pixel windows: the wire quantizes at exactly the replay buffer's
+  store-time point, so the STORED uint8 bytes are fleet-vs-local
+  identical;
+- bf16 wire: the one DECLARED-lossy mode — content is pinned to
+  f32-cast-through-bfloat16, nothing else;
+- obs-norm: raw bytes identical AND the ingest-side statistics fold
+  (once per original window) matches the local once-per-observed-step
+  fold exactly;
+- actor-side HER vs the learner-side HER path (the seeded parity
+  oracle): same episode + same relabel rng ⇒ byte-identical buffers.
+
+All at the raw ``add_batch`` level — no sockets, no trainers.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from d4pg_tpu.fleet import wire
+from d4pg_tpu.ops.obs_norm import RunningObsNorm
+from d4pg_tpu.replay.her import HindsightWriter
+from d4pg_tpu.replay.nstep_writer import NStepWriter
+from d4pg_tpu.replay.uniform import ReplayBuffer, Transition
+from d4pg_tpu.serve.protocol import ProtocolError
+from d4pg_tpu.fleet.actor import _HerWriterFactory, _Spool
+
+OBS, ACT, N_STEP, GAMMA = 5, 2, 3, 0.97
+
+
+def _episode(rng, length=17):
+    """One synthetic episode of raw env steps."""
+    steps = []
+    obs = rng.random(OBS).astype(np.float32)
+    for t in range(length):
+        a = (rng.random(ACT) * 2 - 1).astype(np.float32)
+        r = float(rng.standard_normal())
+        nxt = rng.random(OBS).astype(np.float32)
+        steps.append((obs, a, r, nxt, t == length - 1))
+        obs = nxt
+    return steps
+
+
+def _spool_to_buffer(spool, buf, obs_mode="f32", via_v2=True):
+    """Drain a spool through the wire codec into ``buf.add_batch`` — the
+    exact ingest data path, minus the socket."""
+    while True:
+        frame = spool.take_frame(64)
+        if frame is None:
+            return
+        (gen, stats_gen, relabeled), cols = frame
+        if via_v2:
+            payload = wire.encode_windows2(
+                gen, stats_gen, obs_mode, relabeled,
+                cols["obs"], cols["action"], cols["reward"],
+                cols["next_obs"], cols["discount"],
+            )
+            _g, _s, _m, _rel, out = wire.decode_windows2(payload, OBS, ACT)
+        else:
+            payload = wire.encode_windows(
+                gen, cols["obs"], cols["action"], cols["reward"],
+                cols["next_obs"], cols["discount"],
+            )
+            _g, out = wire.decode_windows(payload, OBS, ACT)
+        buf.add_batch(Transition(
+            out["obs"], out["action"], out["reward"],
+            out["next_obs"], out["discount"],
+        ))
+
+
+def _assert_buffers_identical(a: ReplayBuffer, b: ReplayBuffer):
+    assert len(a) == len(b)
+    n = len(a)
+    for col in ("obs", "action", "reward", "next_obs", "discount"):
+        av, bv = getattr(a, col)[:n], getattr(b, col)[:n]
+        assert av.dtype == bv.dtype
+        assert av.tobytes() == bv.tobytes(), f"column {col} differs"
+
+
+@pytest.mark.parametrize("via_v2", [False, True])
+def test_f32_flat_byte_parity(via_v2):
+    """Local NStepWriter → buffer  vs  NStepWriter → spool → wire →
+    add_batch: byte-identical through WINDOWS (v1) AND WINDOWS2."""
+    rng = np.random.default_rng(0)
+    steps = _episode(rng)
+    local = ReplayBuffer(128, OBS, ACT)
+    w = NStepWriter(local, N_STEP, GAMMA)
+    for obs, a, r, nxt, last in steps:
+        w.add(obs, a, r, nxt, terminated=False, truncated=last)
+    fleet = ReplayBuffer(128, OBS, ACT)
+    spool = _Spool(512)
+    w2 = NStepWriter(spool, N_STEP, GAMMA)
+    for obs, a, r, nxt, last in steps:
+        w2.add(obs, a, r, nxt, terminated=False, truncated=last)
+    _spool_to_buffer(spool, fleet, via_v2=via_v2)
+    _assert_buffers_identical(local, fleet)
+
+
+def test_u8_pixel_byte_parity():
+    """Pixel rows: local add_batch quantizes f32→u8 at store time; the
+    fleet wire quantizes at the SAME formula, ships bytes, decodes ÷255,
+    and add_batch re-quantizes — the stored uint8 bytes must be
+    identical (the u8↔f32 round-trip is exact for all 256 values)."""
+    rng = np.random.default_rng(1)
+    pix = 12
+    rows = 40
+    obs = rng.random((rows, pix)).astype(np.float32)
+    nxt = rng.random((rows, pix)).astype(np.float32)
+    act = (rng.random((rows, ACT)) * 2 - 1).astype(np.float32)
+    rew = rng.standard_normal(rows).astype(np.float32)
+    disc = rng.random(rows).astype(np.float32)
+    local = ReplayBuffer(64, pix, ACT, obs_dtype=np.uint8)
+    local.add_batch(Transition(obs, act, rew, nxt, disc))
+    payload = wire.encode_windows2(0, 0, "u8", False, obs, act, rew, nxt, disc)
+    _g, _s, mode, _rel, cols = wire.decode_windows2(payload, pix, ACT)
+    assert mode == "u8"
+    fleet = ReplayBuffer(64, pix, ACT, obs_dtype=np.uint8)
+    fleet.add_batch(Transition(
+        cols["obs"], cols["action"], cols["reward"],
+        cols["next_obs"], cols["discount"],
+    ))
+    _assert_buffers_identical(local, fleet)
+
+
+def test_u8_roundtrip_exact_all_values():
+    """Every uint8 value survives quantize→÷255→re-quantize exactly —
+    the arithmetic fact the pixel parity rests on."""
+    q = np.arange(256, dtype=np.uint8)[None, :]
+    dec = q.astype(np.float32) / 255.0
+    assert (wire.quantize_obs_u8(dec) == q).all()
+
+
+def test_bf16_wire_is_declared_round():
+    """bf16 mode content == f32 cast through bfloat16 — lossy exactly as
+    declared, nothing else."""
+    import ml_dtypes
+
+    rng = np.random.default_rng(2)
+    obs = (rng.standard_normal((9, OBS)) * 3).astype(np.float32)
+    nxt = (rng.standard_normal((9, OBS)) * 3).astype(np.float32)
+    act = (rng.random((9, ACT)) * 2 - 1).astype(np.float32)
+    rew = rng.standard_normal(9).astype(np.float32)
+    disc = rng.random(9).astype(np.float32)
+    payload = wire.encode_windows2(
+        1, 1, "bf16", False, obs, act, rew, nxt, disc
+    )
+    _g, _s, _m, _rel, cols = wire.decode_windows2(payload, OBS, ACT)
+    want = obs.astype(ml_dtypes.bfloat16).astype(np.float32)
+    assert (cols["obs"] == want).all()
+    # the f32 side-columns stay byte-exact
+    assert cols["action"].tobytes() == act.tobytes()
+    assert cols["reward"].tobytes() == rew.tobytes()
+
+
+def test_windows2_malformed():
+    rng = np.random.default_rng(3)
+    obs = rng.random((4, OBS)).astype(np.float32)
+    act = rng.random((4, ACT)).astype(np.float32)
+    r = rng.random(4).astype(np.float32)
+    payload = wire.encode_windows2(0, 0, "f32", False, obs, act, r, obs, r)
+    with pytest.raises(ProtocolError, match="declares"):
+        wire.decode_windows2(payload[:-3], OBS, ACT)  # truncated body
+    with pytest.raises(ProtocolError, match="header"):
+        wire.decode_windows2(payload[:4], OBS, ACT)
+    bad = bytearray(payload)
+    bad[12] = 9  # unknown obs mode id
+    with pytest.raises(ProtocolError, match="unknown obs mode"):
+        wire.decode_windows2(bytes(bad), OBS, ACT)
+
+
+def test_obs_norm_fold_parity_and_relabel_exclusion():
+    """The ingest-side fold (once per ORIGINAL window, in window order)
+    reproduces the local once-per-observed-step fold exactly — and
+    relabeled windows never touch the statistics."""
+    rng = np.random.default_rng(4)
+    steps = _episode(rng, length=10)
+    # local: fold each acted-on obs, in order (Trainer._ingest_obs)
+    local = RunningObsNorm(OBS)
+    for obs, *_ in steps:
+        local.update(obs)
+    # fleet: windows through a 1-step writer (window obs == step obs, in
+    # order), folded per frame like IngestServer._write_frames
+    spool = _Spool(512)
+    w = NStepWriter(spool, 1, GAMMA)
+    for obs, a, r, nxt, last in steps:
+        w.add(obs, a, r, nxt, terminated=False, truncated=last)
+    ingest = RunningObsNorm(OBS)
+    while True:
+        frame = spool.take_frame(3)  # several frames: the fold is per frame
+        if frame is None:
+            break
+        (_g, _s, relabeled), cols = frame
+        if not relabeled:
+            ingest.update(cols["obs"])
+    a, b = local.state_dict(), ingest.state_dict()
+    assert a["count"] == b["count"]
+    np.testing.assert_allclose(a["mean"], b["mean"], rtol=0, atol=1e-12)
+    np.testing.assert_allclose(a["m2"], b["m2"], rtol=0, atol=1e-9)
+    # relabeled windows: excluded
+    spool.relabeled = True
+    spool.add(np.full(OBS, 100.0), np.zeros(ACT), 0.0, np.zeros(OBS), 0.0)
+    (_g, _s, relabeled), cols = spool.take_frame(8)
+    assert relabeled
+    before = ingest.state_dict()
+    if not relabeled:  # pragma: no cover - the guard the ingest applies
+        ingest.update(cols["obs"])
+    assert ingest.state_dict() == before
+
+
+def test_her_actor_side_vs_learner_oracle_byte_parity():
+    """THE parity oracle: one episode through (a) the learner-side
+    HindsightWriter writing straight into a buffer and (b) the
+    actor-side factory+spool+wire path, with the same seeded relabel
+    rng — the two buffers must be byte-identical, including the
+    original→relabel insertion order."""
+    rng = np.random.default_rng(5)
+    length = 9
+    eps = []
+    pos = rng.random(2).astype(np.float32)
+    goal = rng.random(2).astype(np.float32)
+    for t in range(length):
+        a = (rng.random(ACT) * 2 - 1).astype(np.float32)
+        nxt_pos = np.clip(pos + 0.2 * a, 0, 1).astype(np.float32)
+        r = -float(np.linalg.norm(nxt_pos - goal) >= 0.1)
+        eps.append(dict(
+            observation=pos, achieved_goal=pos, desired_goal=goal,
+            action=a, reward=r, next_observation=nxt_pos,
+            next_achieved_goal=nxt_pos, terminated=False,
+        ))
+        pos = nxt_pos
+
+    def reward_fn(ag, dg):
+        return -float(np.linalg.norm(np.asarray(ag) - np.asarray(dg)) >= 0.1)
+
+    obs_dim = 4  # flatten(observation, goal)
+    learner = ReplayBuffer(512, obs_dim, ACT)
+    hw = HindsightWriter(
+        writer_factory=lambda: NStepWriter(learner, N_STEP, GAMMA),
+        compute_reward=reward_fn, k_future=3,
+        rng=np.random.default_rng(77),
+    )
+    for s in eps:
+        hw.add(**s)
+    hw.end_episode(truncated=True)
+
+    spool = _Spool(4096)
+    factory = _HerWriterFactory(spool, N_STEP, GAMMA)
+    hw2 = HindsightWriter(
+        writer_factory=factory, compute_reward=reward_fn, k_future=3,
+        rng=np.random.default_rng(77),
+    )
+    for s in eps:
+        hw2.add(**s)
+    factory.calls = 0
+    hw2.end_episode(truncated=True)
+    # original windows tagged original, relabels relabeled
+    tags = [row[0] for row in spool.rows]
+    assert tags[0] == (0, 0, False) and tags[-1][2] is True
+    fleet = ReplayBuffer(512, obs_dim, ACT)
+
+    while True:
+        frame = spool.take_frame(64)
+        if frame is None:
+            break
+        (gen, sg, rel), cols = frame
+        payload = wire.encode_windows2(
+            gen, sg, "f32", rel, cols["obs"], cols["action"],
+            cols["reward"], cols["next_obs"], cols["discount"],
+        )
+        _g, _s, _m, _rel, out = wire.decode_windows2(payload, obs_dim, ACT)
+        fleet.add_batch(Transition(
+            out["obs"], out["action"], out["reward"],
+            out["next_obs"], out["discount"],
+        ))
+    _assert_buffers_identical(learner, fleet)

@@ -217,14 +217,33 @@ def test_spool_bounded_and_generation_prefix():
         sp.generation = 0 if i < 3 else 1
         sp.add(np.zeros(OBS), np.zeros(ACT), float(i), np.zeros(OBS), 0.9)
     assert len(sp) == 4 and sp.dropped == 2  # oldest two dropped
-    gen, cols = sp.take_frame(max_rows=8)
+    tag, cols = sp.take_frame(max_rows=8)
     # rows 2 (gen 0) then 3..5 (gen 1): the frame stops at the gen flip
-    assert gen == 0 and len(cols["reward"]) == 1
-    gen, cols = sp.take_frame(max_rows=2)
-    assert gen == 1 and len(cols["reward"]) == 2  # capped at max_rows
-    gen, cols = sp.take_frame(max_rows=8)
-    assert gen == 1 and len(cols["reward"]) == 1
+    assert tag == (0, 0, False) and len(cols["reward"]) == 1
+    tag, cols = sp.take_frame(max_rows=2)
+    assert tag[0] == 1 and len(cols["reward"]) == 2  # capped at max_rows
+    tag, cols = sp.take_frame(max_rows=8)
+    assert tag[0] == 1 and len(cols["reward"]) == 1
     assert sp.take_frame(8) is None
+
+
+def test_spool_stats_and_relabel_prefix():
+    """A frame's single (gen, stats_gen, relabeled) tag stays honest
+    across a mid-spool stats swap or an original→relabeled phase flip."""
+    sp = _Spool(limit=16)
+    for i in range(2):
+        sp.add(np.zeros(OBS), np.zeros(ACT), float(i), np.zeros(OBS), 0.9)
+    sp.relabeled = True
+    sp.add(np.zeros(OBS), np.zeros(ACT), 2.0, np.zeros(OBS), 0.9)
+    sp.relabeled = False
+    sp.stats_generation = 3
+    sp.add(np.zeros(OBS), np.zeros(ACT), 3.0, np.zeros(OBS), 0.9)
+    tag, cols = sp.take_frame(8)
+    assert tag == (0, 0, False) and len(cols["reward"]) == 2
+    tag, cols = sp.take_frame(8)
+    assert tag == (0, 0, True) and len(cols["reward"]) == 1
+    tag, cols = sp.take_frame(8)
+    assert tag == (0, 3, False) and len(cols["reward"]) == 1
 
 
 # ----------------------------------------------------------------- ingest
@@ -910,7 +929,7 @@ def test_link_death_sweeps_pending_as_dropped():
     )
     try:
         assert link.acquire_credit(5)
-        link.send_windows(0, _frame_cols(3))
+        link.send_windows((0, 0, False), _frame_cols(3))
         assert link.inflight() == 1
         assert _wait(lambda: state.get("got"))
         state["conn"].close()  # server dies with the frame unacked

@@ -1,0 +1,281 @@
+"""ISSUE 13 end-to-end composition: the newly-opened fleet cells driven
+through the REAL components — Trainer (fleet-only ingest, guards on) fed
+by a REAL FleetActor over real sockets — for HER + obs-norm (goal env)
+and u8 pixels (host pixel env + numpy conv policy). Fast variants run a
+handful of grad steps in the fast tier; the 400-step acceptance runs are
+slow-marked (chaos_soak.sh leg 8 drives them through the CLIs too)."""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+import toy_goal_env  # noqa: F401  (registers ToyGoal-v0)
+from d4pg_tpu.config import TrainConfig
+from d4pg_tpu.fleet.actor import FleetActor
+from d4pg_tpu.fleet.ingest import IngestServer
+from d4pg_tpu.replay.uniform import ReplayBuffer
+
+GOAL_ENV = "toy_goal_env:ToyGoal-v0"
+
+
+def _trainer_cfg(tmp_path, **over):
+    base = dict(
+        env=GOAL_ENV,
+        her=True,
+        her_k=2,
+        obs_norm=True,
+        num_envs=0,
+        fleet_listen=0,
+        fleet_host="127.0.0.1",
+        fleet_bundle=str(tmp_path / "bundle"),
+        fleet_publish_interval=4,
+        fleet_max_gen_lag=2,
+        warmup_steps=24,
+        batch_size=8,
+        replay_capacity=512,
+        n_step=3,
+        total_steps=8,
+        eval_interval=100000,
+        checkpoint_interval=100000,
+        concurrent_eval=False,
+        debug_guards=True,
+        log_dir=str(tmp_path / "run"),
+        seed=3,
+    )
+    base.update(over)
+    agent_over = base.pop("agent_over", {})
+    cfg = TrainConfig(**base)
+    import dataclasses
+
+    agent = dataclasses.replace(
+        cfg.agent, hidden_sizes=(16, 16), **agent_over
+    )
+    return dataclasses.replace(cfg, agent=agent)
+
+
+def _run_fleet_fed(cfg, actor_kwargs, steps):
+    """Build the Trainer, feed it with a real FleetActor thread, train
+    ``steps`` grad steps under guards, and return (trainer_result,
+    fleet_counters, actor_stats)."""
+    from d4pg_tpu.runtime.trainer import Trainer
+
+    t = Trainer(cfg)
+    stop = threading.Event()
+    actor = FleetActor(
+        connect=f"127.0.0.1:{t._fleet.port}",
+        bundle_dir=cfg.fleet_bundle,
+        stop_event=stop,
+        batch_windows=8,
+        poll_interval_s=0.2,
+        seed=11,
+        **actor_kwargs,
+    )
+    th = threading.Thread(target=actor.run, name="test-fleet-actor",
+                          daemon=True)
+    th.start()
+    try:
+        result = t.train(total_steps=steps)
+        counters = t._fleet.counters()
+    finally:
+        stop.set()
+        th.join(timeout=30)
+        t.close()
+    assert not th.is_alive()
+    return result, counters, actor.stats()
+
+
+def test_fleet_her_obsnorm_guarded_smoke(tmp_path):
+    """The flagship newly-opened composition: a fleet-fed HER + obs-norm
+    learner under --debug-guards — actor-side relabeling, stats riding
+    the bundle, generation-tagged windows — trains a few steps with zero
+    guard trips (guards raise on any) and exact ingest accounting."""
+    cfg = _trainer_cfg(tmp_path)
+    result, counters, stats = _run_fleet_fed(
+        cfg, dict(env_id=GOAL_ENV, her=True, her_k=2), steps=8
+    )
+    assert counters["windows_ingested"] > 0
+    assert counters["handshake_refusals"] == 0
+    # the actor relabeled: more windows than env steps ever stepped
+    assert stats["windows_emitted"] > stats["env_steps"] > 0
+    # the learner's statistics really folded from ingested windows
+    # (obs-norm e2e: count tracks ORIGINAL windows only)
+    from_ingest = counters["windows_ingested"]
+    assert 0 < int(result.get("replay_size", 0)) <= from_ingest
+
+
+def test_fleet_her_requires_her_actor(tmp_path):
+    """A non-HER actor against the HER learner is refused at HELLO with
+    the structured reason — the old CLI hard-stop, relocated to the
+    negotiation and made per-connection."""
+    from d4pg_tpu.runtime.trainer import Trainer
+
+    cfg = _trainer_cfg(tmp_path, total_steps=2)
+    t = Trainer(cfg)
+    try:
+        stop = threading.Event()
+        actor = FleetActor(
+            connect=f"127.0.0.1:{t._fleet.port}",
+            bundle_dir=cfg.fleet_bundle,
+            env_id=GOAL_ENV,
+            her=False,  # mismatch: learner negotiates her=True
+            stop_event=stop,
+            reconnect_attempts=1,
+            seed=1,
+        )
+        with pytest.raises(RuntimeError, match="refused handshake"):
+            # the refusal is fatal inside the first connect attempt
+            actor._ensure_link()
+        assert t._fleet.counters()["handshake_refusals"] >= 1
+    finally:
+        t.close()
+
+
+def test_fleet_pixel_u8_ingest_e2e(tmp_path):
+    """The pixel cell, socket to buffer: a REAL FleetActor on the
+    JAX-free host pixel env with the numpy conv policy streams
+    u8-quantized WINDOWS2 frames into an ingest server; the stored
+    uint8 rows must round-trip the wire exactly (spot-checked against
+    the actor's own quantization)."""
+    import jax
+
+    from d4pg_tpu.agent.state import D4PGConfig
+    from d4pg_tpu.fleet import wire
+    from d4pg_tpu.serve.bundle import actor_template, export_bundle
+
+    size = 48
+    obs_dim = size * size * 2
+    agent = D4PGConfig(
+        obs_dim=obs_dim, action_dim=1, hidden_sizes=(16, 16),
+        pixel_shape=(size, size, 2), n_step=3,
+    )
+    bundle = tmp_path / "pixel_bundle"
+    export_bundle(
+        str(bundle), agent, actor_template(agent),
+        meta={"generation": 0, "env": "pixel_pendulum"},
+    )
+    buf = ReplayBuffer(256, obs_dim, 1, obs_dtype=np.uint8)
+    srv = IngestServer(
+        buf, obs_dim=obs_dim, action_dim=1, n_step=3, gamma=0.99,
+        host="127.0.0.1", port=0,
+        caps={"obs_mode": "u8", "her": False, "obs_norm": False},
+    ).start()
+    stop = threading.Event()
+    actor = FleetActor(
+        connect=f"127.0.0.1:{srv.port}",
+        bundle_dir=str(bundle),
+        env_id="pixel_pendulum_host",
+        batch_windows=4,
+        max_env_steps=24,
+        stop_event=stop,
+        seed=5,
+    )
+    try:
+        stats = actor.run()
+        assert stats["windows_acked"] > 0
+        assert srv.counters()["windows_ingested"] == stats["windows_acked"]
+        # stored rows are u8 and consistent with the wire quantizer:
+        # decode(÷255) → re-quantize is identity, so every stored byte
+        # row must survive its own round-trip
+        n = len(buf)
+        assert n > 0 and buf.obs.dtype == np.uint8
+        dec = buf.obs[:n].astype(np.float32) / 255.0
+        assert (wire.quantize_obs_u8(dec) == buf.obs[:n]).all()
+    finally:
+        stop.set()
+        srv.close()
+
+
+def test_her_flush_carries_episode_start_tag(tmp_path):
+    """A mid-episode bundle hot-swap must not re-stamp already-acted HER
+    experience as fresh: the episode buffers in the relabeler until
+    flush, so the flush is tagged with the generation in force when the
+    episode BEGAN (conservative: ingest may drop a partially-fresh
+    episode as stale, never accept stale windows as fresh)."""
+    from d4pg_tpu.runtime.trainer import Trainer
+
+    cfg = _trainer_cfg(tmp_path, total_steps=2)
+    t = Trainer(cfg)
+    try:
+        actor = FleetActor(
+            connect="127.0.0.1:1",  # never dialed: no flush in this test
+            bundle_dir=cfg.fleet_bundle,
+            env_id=GOAL_ENV,
+            her=True,
+            her_k=1,
+            seed=2,
+        )
+        assert actor._her_episode_tag[0] == (
+            actor.policy.generation, actor.policy.stats_generation
+        )
+        start_tag = actor._her_episode_tag[0]
+        # act a few steps, then simulate a mid-episode hot-swap the way
+        # _maybe_reload_bundle applies one
+        for _ in range(3):
+            actor._step_envs()
+        actor.policy.generation += 7
+        actor.policy.stats_generation += 7
+        actor.spool.generation = actor.policy.generation
+        actor.spool.stats_generation = actor.policy.stats_generation
+        # run the episode to its end (ToyGoal truncates at 25 steps)
+        for _ in range(40):
+            actor._step_envs()
+            if len(actor.spool):
+                break
+        assert len(actor.spool) > 0, "episode never flushed"
+        assert all(
+            row[0][:2] == start_tag for row in actor.spool.rows
+        ), "flushed HER windows must carry the episode-START tag"
+        # the NEXT episode adopts the live policy's tag
+        assert actor._her_episode_tag[0] == (
+            actor.policy.generation, actor.policy.stats_generation
+        )
+    finally:
+        t.close()
+
+
+@pytest.mark.slow
+def test_fleet_her_obsnorm_400_steps_acceptance(tmp_path):
+    """ISSUE 13 acceptance: the fleet-fed HER + obs-norm learner runs
+    400 grad steps under --debug-guards with zero guard trips (any trip
+    raises) and the at-most-once accounting identity exact."""
+    cfg = _trainer_cfg(tmp_path, total_steps=400, fleet_publish_interval=50)
+    result, counters, stats = _run_fleet_fed(
+        cfg, dict(env_id=GOAL_ENV, her=True, her_k=2), steps=400
+    )
+    assert counters["windows_ingested"] >= 400
+    acct = (stats["windows_acked"] + stats["windows_stale"]
+            + stats["windows_shed"] + stats["windows_dropped_reconnect"]
+            + stats["windows_dropped_spool"] + stats["spool_depth"])
+    assert acct == stats["windows_emitted"], (acct, stats)
+
+
+@pytest.mark.slow
+def test_fleet_pixel_400_steps_acceptance(tmp_path):
+    """ISSUE 13 acceptance, pixel leg: a fleet-fed pixel learner (u8
+    wire) runs 400 grad steps under --debug-guards, fed by the JAX-free
+    host pixel env twin."""
+    cfg = _trainer_cfg(
+        tmp_path,
+        env="pixel_pendulum",
+        her=False,
+        obs_norm=False,
+        total_steps=400,
+        fleet_publish_interval=100,
+        warmup_steps=16,
+        replay_capacity=256,
+        eval_episodes=1,
+    )
+    result, counters, stats = _run_fleet_fed(
+        cfg,
+        dict(env_id="pixel_pendulum_host", noise_sigma=0.3),
+        steps=400,
+    )
+    assert counters["windows_ingested"] >= 400
+    assert counters["handshake_refusals"] == 0
+    acct = (stats["windows_acked"] + stats["windows_stale"]
+            + stats["windows_shed"] + stats["windows_dropped_reconnect"]
+            + stats["windows_dropped_spool"] + stats["spool_depth"])
+    assert acct == stats["windows_emitted"], (acct, stats)

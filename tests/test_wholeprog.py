@@ -606,7 +606,7 @@ def test_fleet_link_accounts_unexpected_reply_type_as_dropped():
             "discount": np.ones(3, np.float32),
         }
         assert link.acquire_credit(5.0)
-        n = link.send_windows(0, cols)
+        n = link.send_windows((0, 0, False), cols)
         assert n == 3
         deadline = time.monotonic() + 5.0
         while link.dead is None and time.monotonic() < deadline:

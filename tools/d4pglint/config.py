@@ -81,6 +81,15 @@ HOST_ONLY_MODULES = (
     "d4pg_tpu/replay/__init__.py",
     "d4pg_tpu/replay/uniform.py",
     "d4pg_tpu/replay/nstep_writer.py",
+    # Actor-side HER (ISSUE 13): remote hosts run the repo's OWN
+    # HindsightWriter, so the relabeler must stay provably JAX-free.
+    "d4pg_tpu/replay/her.py",
+    # The capability seam: imported by train.py before any backend
+    # decision AND by the (host-only) fleet ingest handshake.
+    "d4pg_tpu/replay/source.py",
+    # The JAX-free twin of the pure-JAX pixel env — what a fleet actor
+    # host runs for the pixel cell (parity-tested against the jnp one).
+    "d4pg_tpu/envs/pixel_pendulum_host.py",
     # utils/__init__ must stay lazy: an eager profiling import there would
     # drag JAX into every utils.retry / utils.signals importer (fleet hosts).
     "d4pg_tpu/utils/__init__.py",

@@ -217,6 +217,78 @@ def check_shard_microbench(path: str) -> list[str]:
     return errs
 
 
+def check_composition_matrix(path: str) -> list[str]:
+    """Shape + invariants for ``benchmarks/composition_matrix.json`` —
+    the ISSUE-13 acceptance artifact:
+
+    - every scenario × placement cell is present, with verdict
+      ``pass`` / ``negotiated`` / ``gap``;
+    - every ``gap`` cell carries machine-readable reasons (code +
+      message) and every ``negotiated`` cell its declared actions —
+      zero undeclared refusals;
+    - the cells match a FRESH evaluation of the rule table
+      (``d4pg_tpu.replay.source.composition_matrix()``, JAX-free):
+      drift means someone changed a capability rule without
+      regenerating — ``python benchmarks/composition_matrix.py``.
+    """
+    from d4pg_tpu.replay import source
+
+    errs = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: unreadable/invalid JSON ({e})"]
+    for key in ("backend", "schema", "cells", "counts", "wire_encodings"):
+        if key not in doc:
+            errs.append(f"{path}: missing top-level key {key!r}")
+    if doc.get("schema") != "composition-matrix/v1":
+        errs.append(
+            f"{path}: unknown schema {doc.get('schema')!r} "
+            "(expected 'composition-matrix/v1')"
+        )
+    cells = doc.get("cells")
+    if not isinstance(cells, list) or not cells:
+        return errs + [f"{path}: 'cells' must be a non-empty list"]
+    for i, c in enumerate(cells):
+        v = c.get("verdict")
+        if v not in ("pass", "negotiated", "gap"):
+            errs.append(f"{path}: cells[{i}] verdict {v!r} unknown")
+            continue
+        if v == "gap":
+            gaps = c.get("gaps")
+            if not gaps or not all(
+                isinstance(g, dict) and g.get("code") and g.get("message")
+                for g in gaps
+            ):
+                errs.append(
+                    f"{path}: cells[{i}] "
+                    f"({c.get('scenario')}×{c.get('placement')}) is a gap "
+                    "without machine-readable code+message reasons — "
+                    "undeclared refusals are not committable"
+                )
+        if v == "negotiated" and not c.get("actions"):
+            errs.append(
+                f"{path}: cells[{i}] negotiated without declared actions"
+            )
+    fresh = source.composition_matrix()
+    if cells != fresh:
+        fresh_by = {(c["scenario"], c["placement"]): c for c in fresh}
+        old_by = {(c["scenario"], c["placement"]): c for c in cells}
+        changed = sorted(
+            f"{s}×{p}"
+            for key in set(fresh_by) | set(old_by)
+            for s, p in [key]
+            if fresh_by.get(key) != old_by.get(key)
+        )
+        errs.append(
+            f"{path}: stale vs the current capability rule table "
+            f"(changed cells: {', '.join(changed) or 'ordering'}) — "
+            "regenerate with `python benchmarks/composition_matrix.py`"
+        )
+    return errs
+
+
 def check_lock_order_graph(path: str, root: str | None = None) -> list[str]:
     """Shape + invariants for ``benchmarks/lock_order_graph.json``:
 
@@ -347,6 +419,8 @@ def check_tree(root: str) -> list[str]:
             errs.extend(check_multitenant_microbench(path))
         if os.path.basename(path) == "shard_microbench.json":
             errs.extend(check_shard_microbench(path))
+        if os.path.basename(path) == "composition_matrix.json":
+            errs.extend(check_composition_matrix(path))
     for path in sorted(
         glob.glob(os.path.join(root, "runs", "**", "metrics.jsonl"),
                   recursive=True)

@@ -43,6 +43,11 @@ PROTOCOL_CODECS = {
                  "d4pg_tpu/fleet/wire.py::decode_hello_ok"),
     "WINDOWS": ("d4pg_tpu/fleet/wire.py::encode_windows",
                 "d4pg_tpu/fleet/wire.py::decode_windows"),
+    # the capability-era window frame (ISSUE 13): obs wire mode (f32 /
+    # u8-quantized pixels / bf16), stats generation, relabeled flag;
+    # rides frame version 2 via protocol.py:_FRAME_MIN_VERSION
+    "WINDOWS2": ("d4pg_tpu/fleet/wire.py::encode_windows2",
+                 "d4pg_tpu/fleet/wire.py::decode_windows2"),
     "WINDOWS_OK": ("d4pg_tpu/fleet/wire.py::encode_windows_ok",
                    "d4pg_tpu/fleet/wire.py::decode_windows_ok"),
 }
@@ -63,7 +68,7 @@ PROTOCOL_ENDPOINTS = {
     "ingest-handshake": ("d4pg_tpu/fleet/ingest.py::IngestServer._handshake",
                          ("HEALTHZ", "HELLO")),
     "ingest": ("d4pg_tpu/fleet/ingest.py::IngestServer._serve_conn",
-               ("HEALTHZ", "WINDOWS")),
+               ("HEALTHZ", "WINDOWS", "WINDOWS2")),
     "client": ("d4pg_tpu/serve/client.py::PolicyClient._read_loop",
                ("ACT_OK", "HEALTHZ_OK", "OVERLOADED", "ERROR")),
     "fleet-link": ("d4pg_tpu/fleet/actor.py::FleetLink._read_loop",

@@ -234,6 +234,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "hot-swap on the bundle.json mtime)")
     p.add_argument("--fleet-publish-interval", type=int, default=200,
                    help="grad steps between fleet bundle publications")
+    p.add_argument("--fleet-max-gen-lag", type=int, default=1,
+                   help="ingest drops windows produced under a bundle (or "
+                        "obs-norm stats) generation older than current "
+                        "minus this lag")
+    p.add_argument("--fleet-wire-dtype", choices=["auto", "float32", "bfloat16"],
+                   default="auto",
+                   help="fleet ingest wire encoding for flat observation "
+                        "rows: auto/float32 = byte-identical f32; bfloat16 "
+                        "halves wire bytes with a declared bf16 round "
+                        "(pixel envs always negotiate u8-quantized rows)")
     p.add_argument("--chaos", default=None, metavar="PLAN",
                    help="deterministic fault injection (d4pg_tpu/chaos.py): "
                         "';'-separated site@count[:arg][#actor] entries, "
@@ -360,6 +370,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         fleet_host=args.fleet_host,
         fleet_bundle=args.fleet_bundle,
         fleet_publish_interval=args.fleet_publish_interval,
+        fleet_max_gen_lag=args.fleet_max_gen_lag,
+        fleet_wire_dtype=args.fleet_wire_dtype,
         debug_guards=args.debug_guards,
         chaos=args.chaos,
         pool_step_timeout_s=args.pool_step_timeout_s,
@@ -556,42 +568,17 @@ def main(argv=None) -> None:
             cfg, log_dir=os.path.join(cfg.log_dir, f"worker{info['process_index']}")
         )
     print(f"config: {cfg}")
-    if args.num_envs == 0 and args.fleet_listen is None:
-        raise SystemExit(
-            "--num-envs 0 means no local collection at all; it requires "
-            "--fleet-listen so remote actor hosts supply the experience"
-        )
+    # THE CLI validation call site (replay/source.py): one negotiation
+    # pass over the capability table replaces the old per-flag refusal
+    # ladder — the Trainer re-validates post-env with the env kind
+    # resolved, against the SAME table, so the two can never drift.
+    from d4pg_tpu.replay.source import validate_train_config
+
+    try:
+        validate_train_config(cfg, on_device=args.on_device)
+    except ValueError as e:
+        raise SystemExit(str(e))
     if args.on_device:
-        if args.fleet_listen is not None:
-            raise SystemExit(
-                "--fleet-listen feeds the HOST replay buffer; --on-device "
-                "keeps replay inside one XLA program (the flag would be "
-                "silently ignored)"
-            )
-        if args.transfer_dtype != "float32":
-            raise SystemExit(
-                "--transfer-dtype is a HOST-path link optimization; "
-                "--on-device envs never transfer batches (the flag would "
-                "be silently ignored)"
-            )
-        if args.obs_norm:
-            raise SystemExit(
-                "--obs-norm is a host data-boundary feature; the on-device "
-                "path keeps observations inside jit (the flag would be "
-                "silently ignored)"
-            )
-        if args.chaos:
-            raise SystemExit(
-                "--chaos targets the host runtime's fault surfaces (pool "
-                "workers, flusher, checkpoint commit); the on-device path "
-                "has none of them (the flag would be silently ignored)"
-            )
-        if args.replay_placement != "host":
-            raise SystemExit(
-                "--replay-placement configures the HOST trainer's data "
-                "plane; --on-device already keeps rollout+replay+learn in "
-                "one XLA program (the flag would be silently ignored)"
-            )
         from d4pg_tpu.runtime.on_device import run_on_device
 
         preempt_event = threading.Event()
