@@ -472,6 +472,7 @@ def bench_host_pipeline(
 def bench_megastep(
     *,
     placement: str = "device",
+    per: bool = False,
     steps: int = 30,
     batch: int = BATCH,
     k: int = 32,
@@ -481,6 +482,7 @@ def bench_megastep(
     rows: int = 65_536,
     compute_dtype: str = "float32",
     dp: int | None = None,
+    device_tree_backend: str = "xla",
 ) -> dict:
     """Device-resident replay + fused megastep: grad-steps/s and per-step
     transfer bytes (``runtime/megastep.py`` + ``replay/device_ring.py``).
@@ -496,6 +498,13 @@ def bench_megastep(
     — it is experience ingest, not grad-step traffic.
 
     ``steps`` counts DISPATCHES; grad-steps/s = steps·k / wall.
+
+    ``per=True`` (placement="device", ISSUE 14) runs DEVICE-RESIDENT PER:
+    the priority segment tree lives in HBM (``replay/device_per.py``) and
+    the descent, IS weights, and write-back all happen inside the fused
+    megastep — prioritized replay at the same ZERO transfer bytes per
+    grad step as the uniform row (``device_tree_backend`` selects the
+    descent kernel: xla reference or the Pallas prefix-scan).
     """
     import jax
     import jax.numpy as jnp
@@ -515,6 +524,10 @@ def bench_megastep(
         raise ValueError(f"placement must be device|hybrid, got {placement!r}")
     if dp and placement != "device":
         raise ValueError("dp>1 shards the uniform ring: placement must be device")
+    if per and placement != "device":
+        raise ValueError(
+            "per=True is device-resident PER; hybrid IS the host-tree PER row"
+        )
     config = D4PGConfig(
         obs_dim=obs_dim,
         action_dim=act_dim,
@@ -549,6 +562,12 @@ def bench_megastep(
     else:
         ring = device_ring_init(rows, obs_dim, act_dim)
         sync = DeviceRingSync(buf)
+    dev_per = None
+    if per:
+        from d4pg_tpu.replay.device_per import DevicePerSync
+
+        dev_per = DevicePerSync(rows, config.per_alpha, mesh=mesh)
+        sync.tree_hook = dev_per.on_chunk  # seeds leaves with the fill below
     ring = sync.flush(ring)  # one-time fill: ingest, not grad-step traffic
     # FLOPs per grad step from XLA's cost model on the single-step program
     # — the same honest unit bench_tpu uses (a scanned body counts once,
@@ -580,21 +599,42 @@ def bench_megastep(
             from jax.sharding import NamedSharding, PartitionSpec
 
             from d4pg_tpu.runtime.megastep import (
+                make_megastep_device_per_sharded,
                 make_megastep_uniform_sharded,
             )
 
-            mega = make_megastep_uniform_sharded(config, k, batch, mesh)
+            if per:
+                mega = make_megastep_device_per_sharded(
+                    config, k, batch, mesh,
+                    tree_backend=device_tree_backend,
+                )
+            else:
+                mega = make_megastep_uniform_sharded(config, k, batch, mesh)
             key = jax.device_put(
                 jax.random.PRNGKey(1), NamedSharding(mesh, PartitionSpec())
             )
         else:
-            mega = make_megastep_uniform(config, k, batch)
+            if per:
+                from d4pg_tpu.runtime.megastep import (
+                    make_megastep_device_per,
+                )
+
+                mega = make_megastep_device_per(
+                    config, k, batch, tree_backend=device_tree_backend
+                )
+            else:
+                mega = make_megastep_uniform(config, k, batch)
             key = jax.device_put(jax.random.PRNGKey(1))
 
         def one_dispatch(i, state, pending):
             nonlocal key
             with timers.stage("megastep_dispatch"):
-                state, key, metrics = mega(state, ring, key)
+                if dev_per is not None:
+                    state, dev_per.tree, key, metrics = mega(
+                        state, ring, dev_per.tree, key
+                    )
+                else:
+                    state, key, metrics = mega(state, ring, key)
             return state, None
     else:
         mega = make_megastep_hybrid(config)
@@ -643,6 +683,7 @@ def bench_megastep(
         "k": k,
         "batch": batch,
         "placement": placement,
+        "per": bool(per),
         "dp": int(dp or 1),
         "stage_ms_per_dispatch": {kk: round(v, 4) for kk, v in stage_ms.items()},
         "host_ms_per_dispatch": round(host_ms, 4),

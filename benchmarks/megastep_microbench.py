@@ -22,9 +22,16 @@ host-pipeline bench pins):
 - ``host_block_k32``   — the PR-2 host data plane (``sample_block`` +
   staged H2D batch), via ``bench.bench_host_pipeline``;
 - ``hybrid_k32``       — host PER indices, on-device gather
-  (``bench.bench_megastep(placement="hybrid")``);
+  (``bench.bench_megastep(placement="hybrid")``) — the LEGACY PER
+  placement since ISSUE 14, kept as the host-tree oracle row;
 - ``device_k32``       — uniform in-kernel draw, zero transfers
-  (``bench.bench_megastep(placement="device")``).
+  (``bench.bench_megastep(placement="device")``);
+- ``device_per_k32``   — DEVICE-RESIDENT PER (ISSUE 14): the priority
+  segment tree in HBM, descent + IS weights + write-back inside the
+  fused megastep (``bench.bench_megastep(placement="device",
+  per=True)``) — prioritized replay at the same ZERO transfer bytes
+  per grad step as the uniform row, the finish line of the raw-speed
+  arc (vs hybrid's [K, B] round-trip and host's full-batch traffic).
 
 Run as a script to (re)generate ``benchmarks/megastep_microbench.json``:
 
@@ -114,6 +121,13 @@ def run_microbench(
                 hidden=hidden, rows=rows,
             ),
         ),
+        (
+            "device_per_k32",
+            lambda: bench_megastep(
+                placement="device", per=True, steps=steps, batch=batch,
+                k=k, hidden=hidden, rows=rows,
+            ),
+        ),
     ]
     for _ in range(repeats):
         for name, fn in variants:
@@ -127,7 +141,7 @@ def run_microbench(
             else:
                 prev["steps_per_sec_repeats"] = r["steps_per_sec_repeats"]
     host = out["host_block_k32"]
-    for name in ("hybrid_k32", "device_k32"):
+    for name in ("hybrid_k32", "device_k32", "device_per_k32"):
         if host["steps_per_sec"] > 0:
             out[f"{name}_steps_ratio"] = round(
                 out[name]["steps_per_sec"] / host["steps_per_sec"], 4

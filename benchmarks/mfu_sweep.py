@@ -22,7 +22,12 @@ claim checkable rather than asserted:
 5. the SHARDED megastep (``--replay-placement device --dp N``): the same
    loop spanning the dp mesh (striped sharded ring, shard-local draws,
    deterministic grad mean — ROADMAP item 2) at the wide shapes where tp/
-   stack sharding is load-bearing, transfer bytes still 0.
+   stack sharding is load-bearing, transfer bytes still 0;
+6. the DEVICE-PER megastep (``--replay-placement device`` with PER on —
+   ISSUE 14): the priority segment tree in HBM, so the wide-shape rows
+   are finally reachable by runs using the sampling scheme the paper's
+   D4PG actually uses (prioritized replay, Horgan et al. 2018) — with
+   ``transfer_bytes_per_grad_step`` still 0 by construction.
 
 Points 1-3 run through ``bench.bench_tpu`` (device-resident pool, fused
 K-step scan); points 4-5 through ``bench.bench_megastep`` (device ring +
@@ -35,11 +40,13 @@ CPU-interpret megastep rows: JAX_PLATFORMS=cpu \
                              python benchmarks/mfu_sweep.py --megastep-only
 CPU sharded rows:            JAX_PLATFORMS=cpu \
                              python benchmarks/mfu_sweep.py --sharded-only
-(--megastep-only / --sharded-only keep the committed on-chip rows — the
-TPU tunnel has been down since round 5 — and replace only their own row
-family, each tagged with the backend that produced it; rerun WITHOUT the
-flags on the TPU VM to refresh everything on-chip. ``--sharded`` adds
-the sharded rows to a full refresh.)
+CPU device-PER rows:         JAX_PLATFORMS=cpu \
+                             python benchmarks/mfu_sweep.py --device-per-only
+(--megastep-only / --sharded-only / --device-per-only keep the committed
+on-chip rows — the TPU tunnel has been down since round 5 — and replace
+only their own row family, each tagged with the backend that produced
+it; rerun WITHOUT the flags on the TPU VM to refresh everything on-chip.
+``--sharded`` / ``--device-per`` add their rows to a full refresh.)
 
 Prints one JSON line per point and writes benchmarks/mfu_sweep_results.json.
 """
@@ -182,6 +189,59 @@ def sharded_rows() -> list[dict]:
     return rows
 
 
+def device_per_point(batch: int, dp: int | None = None, *, hidden: int = 256,
+                     k_steps: int = 32, steps: int = 6) -> dict:
+    """One DEVICE-RESIDENT PER megastep row (ISSUE 14): in-kernel
+    stratified descent + IS weights + write-back, zero per-grad-step
+    transfers WITH prioritized replay on. Wide-shape points because this
+    is what makes the mfu headroom rows reachable by real PER runs; dp
+    spans the virtual mesh with shard-local subtrees."""
+    import jax
+
+    if dp and jax.device_count() < dp:
+        raise RuntimeError(
+            f"device_per_point(dp={dp}) needs {dp} devices, have "
+            f"{jax.device_count()} — on CPU run via the __main__ entry "
+            "(it configures the virtual mesh)"
+        )
+    out = bench_megastep(
+        placement="device", per=True, batch=batch, k=k_steps, steps=steps,
+        hidden=hidden, dp=dp,
+    )
+    row = {
+        "bench": "mfu_sweep",
+        "config": f"device_per_megastep_mlp{hidden}",
+        "batch": batch,
+        "dp": int(dp or 1),
+        "compute_dtype": "float32",
+        "backend": jax.default_backend(),
+        "steps_per_sec": round(out["steps_per_sec"], 1),
+        "transfer_bytes_per_grad_step": out["transfer_bytes_per_grad_step"],
+    }
+    for k, nd in (
+        ("flops_per_grad_step", 0),
+        ("achieved_tflops", 3),
+        ("mfu", 5),
+    ):
+        if k in out:
+            row[k] = round(out[k], nd) if nd else round(out[k])
+    if jax.default_backend() == "cpu":
+        row["note"] = (
+            "CPU-interpret placeholder (TPU tunnel down); rerun "
+            "benchmarks/mfu_sweep.py --device-per on-chip for the real MFU"
+        )
+    return row
+
+
+def device_per_rows() -> list[dict]:
+    rows = []
+    # The flagship shape, the headroom batch, and one mesh-spanning row.
+    for batch, dp in ((256, None), (1024, None), (512, 8)):
+        rows.append(device_per_point(batch, dp))
+        print(json.dumps(rows[-1]), flush=True)
+    return rows
+
+
 def _replace_family(rows: list[dict], prefix: str, new_rows: list[dict]) -> list[dict]:
     """Drop rows whose config starts with ``prefix`` and append the fresh
     ones — the committed on-chip rows for every OTHER family survive a
@@ -195,6 +255,11 @@ def main(argv=None) -> None:
     if "--sharded-only" in argv:
         with open(RESULTS) as f:
             rows = _replace_family(json.load(f), "sharded_megastep", sharded_rows())
+    elif "--device-per-only" in argv:
+        with open(RESULTS) as f:
+            rows = _replace_family(
+                json.load(f), "device_per_megastep", device_per_rows()
+            )
     elif "--megastep-only" in argv:
         # Keep the committed on-chip rows and replace only the megastep
         # family — sharded_megastep rows survive too (prefix-disjoint:
@@ -229,6 +294,10 @@ def main(argv=None) -> None:
         #    refresh: needs a multi-device backend)
         if "--sharded" in argv:
             rows.extend(sharded_rows())
+        # 6. device-resident PER at the headroom shapes (opt-in: the dp
+        #    row needs a multi-device backend)
+        if "--device-per" in argv:
+            rows.extend(device_per_rows())
     with open(RESULTS, "w") as f:
         json.dump(rows, f, indent=1)
     print(f"[mfu_sweep] wrote {RESULTS}", file=sys.stderr)
@@ -236,7 +305,10 @@ def main(argv=None) -> None:
 
 if __name__ == "__main__":
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu" and (
-        "--sharded" in sys.argv or "--sharded-only" in sys.argv
+        "--sharded" in sys.argv
+        or "--sharded-only" in sys.argv
+        or "--device-per" in sys.argv
+        or "--device-per-only" in sys.argv
     ):
         # CPU virtual mesh for the sharded rows (before any jax backend
         # init — bench.py imports jax lazily inside its functions).

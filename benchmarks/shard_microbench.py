@@ -11,12 +11,19 @@ down. Three rows:
 - ``megastep_dp8``   — the SAME shape over the 8-way mesh
   (``bench_megastep(dp=8)``: striped sharded ring, shard-local draws,
   deterministic grad mean). Transfer bytes are counted from the exact
-  arrays staged/fetched and must be ZERO per grad step for both device
-  rows — the zero-transfer budget surviving scale-out is the headline
+  arrays staged/fetched and must be ZERO per grad step for every device
+  row — the zero-transfer budget surviving scale-out is the headline
   here, not CPU steps/s (8 virtual devices time-slice ~2 real cores, so
   the dp8/dp1 ratio on this box measures thread thrash, not the mesh;
   the schema smoke pins the transfer claim and the artifact tags the
   backend);
+- ``megastep_per_dp8`` — DEVICE-RESIDENT PER over the same 8-way mesh
+  (ISSUE 14: ``bench_megastep(dp=8, per=True)`` — shard-local priority
+  subtrees over the striped ring, descent/IS-weights/write-back inside
+  the sharded megastep, root combine via the deterministic fixed-order
+  reductions). The zero-bytes contract now covers PRIORITIZED replay:
+  ``schema_check.check_shard_microbench`` refuses an artifact whose PER
+  row pays any per-grad-step transfer;
 - ``ensemble_mog_wide`` — the capacity row: an E-wide critic ensemble
   with the mixture-of-Gaussians head at an MXU-friendly width through
   the GSPMD dp×tp step, member stack sharded over "tp" via the rule
@@ -105,6 +112,13 @@ def run_microbench(
             lambda: bench_megastep(
                 placement="device", steps=steps, batch=batch, k=k,
                 hidden=hidden, rows=rows, dp=dp,
+            ),
+        ),
+        (
+            f"megastep_per_dp{dp}",
+            lambda: bench_megastep(
+                placement="device", per=True, steps=steps, batch=batch,
+                k=k, hidden=hidden, rows=rows, dp=dp,
             ),
         ),
         (

@@ -146,22 +146,33 @@ class TrainConfig:
     # exactly this. Pure-JAX envs ignore it (their rollout IS the device).
     actor_device: str = "auto"
 
-    # Where sampled batches live (ROADMAP item 1 — the megastep data plane):
+    # Where sampled batches live (ROADMAP items 1/2 — the megastep data
+    # plane):
     #   "host"   — the existing path: host PER/uniform sampling, per-dispatch
     #              H2D batch upload + D2H priority fetch (the seeded oracle);
-    #   "device" — uniform replay mirrored into a device-resident HBM ring
+    #   "device" — replay mirrored into a device-resident HBM ring
     #              (replay/device_ring.py); the fused megastep draws indices
     #              in-kernel and trains with ZERO per-grad-step transfers
-    #              (runtime/megastep.py; implies uniform sampling — PER
-    #              needs the host trees, use "hybrid");
-    #   "hybrid" — PER: the host sum-tree computes indices + IS weights and
-    #              ships only the tiny [K, B] int32/f32 blocks; rows are
-    #              gathered on-device from the ring, priorities come back
-    #              as one [K, B] block per dispatch (same seeded index
-    #              stream as the host path — frozen-literal-tested).
+    #              (runtime/megastep.py). PER composes: the priority
+    #              structure itself is a device-resident segment tree
+    #              (replay/device_per.py) — stratified descent, IS weights,
+    #              and priority write-back all inside the megastep, sharded
+    #              over dp with the striped ring;
+    #   "hybrid" — LEGACY PER: the host sum-tree computes indices + IS
+    #              weights and ships only the tiny [K, B] int32/f32 blocks;
+    #              rows are gathered on-device, priorities come back as one
+    #              [K, B] block per dispatch (same seeded index stream as
+    #              the host path — frozen-literal-tested). Kept as the
+    #              host-data-plane byte-parity oracle.
     # Host experience ingest streams into the ring in large infrequent
     # chunks (the ingest_chunk stage), never per step.
     replay_placement: str = "host"
+    # Device-PER descent implementation (the ops/pallas_projection.py
+    # backend-ladder convention): "xla" is the jnp log-depth gather
+    # descent (the reference program and the oracle), "pallas" the
+    # blocked-prefix-scan kernel (ops/pallas_tree.py), validated against
+    # it and interpreter-run off-TPU.
+    device_tree_backend: str = "xla"
     # replay. Capacity None = "unset": resolved to the env preset's cap if
     # any, else 1M (reference --rmsize default) — a sentinel, so an explicit
     # --rmsize 1000000 is distinguishable from the default and never

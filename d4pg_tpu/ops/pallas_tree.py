@@ -1,0 +1,116 @@
+"""Pallas TPU kernel for the device-PER stratified descent.
+
+The XLA reference (``replay/device_per.py:descend_prefix``) walks the
+segment tree level by level: log2(L) dependent gathers of [n] dynamic
+indices per dispatch — correct, but every level is a scattered HBM/VMEM
+gather the VPU cannot coalesce. This kernel replaces the walk with a
+blocked prefix-scan SEARCH over the LEAF array, which is the
+TPU-friendly formulation of the same function:
+
+    idx(prefix) = #{ i : inclusive_cumsum(leaves)[i] <= prefix }
+
+(the counting identity of the tree descent's ``>=`` semantics: boundary
+prefixes select the next leaf and zero-mass leaves are skipped, exactly
+like ``SumTree.find_prefixsum_idx`` — equality is pinned against the XLA
+path in ``tests/test_device_per.py``). The leaf array stays resident in
+VMEM for the whole grid step ([L] f32: 512 KB at L=128k — comfortably
+inside the ~16 MB budget); each 128-draw tile sweeps it in 128-lane
+blocks, building the block-inclusive cumsum with one tiny
+lower-triangular matmul per block (MXU work, no cumsum primitive needed)
+and accumulating per-draw counts on the VPU.
+
+Numerics caveat (declared, the ``pallas_projection`` oracle-ladder
+convention): the running block sums accumulate left-to-right while the
+tree descent's partial sums are pairwise — identical in exact
+arithmetic, so the two backends can disagree only on prefixes landing
+within one f32 ulp of a leaf boundary (measure-zero for the uniform
+draws; the seeded equivalence tests pin exact agreement on their frozen
+streams). Selectable via ``TrainConfig.device_tree_backend="pallas"``;
+the XLA descent stays the shipping default and the oracle.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_TILE_D = 128   # draws per grid step
+_BLOCK_L = 128  # leaf lanes swept per inner iteration
+
+
+def _count_kernel(n_blocks, leaves_ref, pref_ref, out_ref):
+    """count[d] = #{ i : running + block_cumsum[i] <= prefix[d] } over all
+    leaf blocks. ``leaves_ref`` [1, L] f32, ``pref_ref`` [TILE_D, 1] f32,
+    ``out_ref`` [TILE_D, 1] i32."""
+    pref = pref_ref[:]                                   # [TD, 1]
+    row = jax.lax.broadcasted_iota(jnp.int32, (_BLOCK_L, _BLOCK_L), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (_BLOCK_L, _BLOCK_L), 1)
+    # M[i, j] = 1 iff i <= j: leaves @ M is the block-inclusive cumsum.
+    tri = (row <= col).astype(jnp.float32)
+
+    def body(b, carry):
+        run, count = carry
+        blk = pl.load(leaves_ref, (slice(0, 1), pl.ds(b * _BLOCK_L, _BLOCK_L)))
+        incl = jnp.dot(blk, tri, preferred_element_type=jnp.float32)  # [1, BL]
+        csum = run + incl
+        count = count + jnp.sum(
+            (csum <= pref).astype(jnp.int32), axis=1, keepdims=True
+        )
+        return run + jnp.sum(blk), count
+
+    _, count = jax.lax.fori_loop(
+        0,
+        n_blocks,
+        body,
+        (jnp.zeros((), jnp.float32), jnp.zeros((_TILE_D, 1), jnp.int32)),
+    )
+    out_ref[:] = count
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def find_prefix_pallas(
+    leaves: jax.Array, prefixes: jax.Array, interpret: bool = False
+) -> jax.Array:
+    """Drop-in for :func:`~d4pg_tpu.replay.device_per.descend_prefix`
+    taking the LEAF slice (``sums_lane[L:]``) instead of the whole tree:
+    ``leaves`` [L] f32, ``prefixes`` any shape f32 → int32 leaf indices of
+    the same shape. ``interpret=True`` runs the Pallas interpreter (CPU
+    tests). Leaves/draws are zero-padded to the 128 tiles internally (a
+    zero pad leaf keeps the cumsum flat past ``total``, so padded tail
+    leaves are never selected by an in-range prefix; pad DRAWS count
+    against prefix 0 and are sliced off)."""
+    shape = prefixes.shape
+    flat = prefixes.reshape(-1).astype(jnp.float32)
+    n = flat.shape[0]
+    L = leaves.shape[0]
+    lpad = pl.cdiv(L, _BLOCK_L) * _BLOCK_L
+    npad = pl.cdiv(n, _TILE_D) * _TILE_D
+    leaves2 = jnp.pad(leaves.astype(jnp.float32), (0, lpad - L))[None, :]
+    pref2 = jnp.pad(flat, (0, npad - n))[:, None]
+    kernel = functools.partial(_count_kernel, lpad // _BLOCK_L)
+    counts = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((npad, 1), jnp.int32),
+        grid=(npad // _TILE_D,),
+        in_specs=[
+            pl.BlockSpec(
+                (1, lpad), lambda i: (0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (_TILE_D, 1), lambda i: (i, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (_TILE_D, 1), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        interpret=interpret,
+    )(leaves2, pref2)
+    # A prefix past the last nonzero leaf's cumsum (possible only through
+    # float-edge rounding — the caller clamps to nextafter(total)) counts
+    # every padded leaf too; clamp to the true leaf range like the
+    # reference clamps its descent.
+    return jnp.minimum(counts[:n, 0], jnp.int32(L - 1)).reshape(shape)

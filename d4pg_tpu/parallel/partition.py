@@ -64,6 +64,18 @@ RING_RULES: Sequence[tuple[str, P]] = (
     (r".*", P()),
 )
 
+# The device PER priority structure (replay/device_per.py:DevicePerTree):
+# the [S, 2L] lane-major segment-tree array sharded over "dp" on the lane
+# axis — shard d's subtree covers exactly shard d's striped ring rows, so
+# descent and write-back stay shard-local; the pre-α max-priority scalar
+# replicates (it is combined by an exact fixed-order max in the megastep).
+# Matched against the DevicePerTree FIELD NAMES.
+PER_TREE_RULES: Sequence[tuple[str, P]] = (
+    (r"sums", P("dp", None)),
+    (r"max_priority", P()),
+    (r".*", P()),
+)
+
 
 def stack_axes_for(config, ensemble_axis: str | None = None):
     """The stacked-variant declarations for a config: the twin pair always
@@ -305,6 +317,18 @@ def ring_partition_specs(ring) -> "DeviceRing":  # noqa: F821 - duck-typed
     as_dict = {name: getattr(ring, name) for name in fields}
     specs = match_partition_rules(RING_RULES, as_dict)
     return type(ring)(**{name: specs[name] for name in fields})
+
+
+def tree_partition_specs(tree) -> "DevicePerTree":  # noqa: F821 - duck-typed
+    """PartitionSpecs for a :class:`~d4pg_tpu.replay.device_per.DevicePerTree`
+    from the ``PER_TREE_RULES`` registry: subtree lanes shard over "dp",
+    the max-priority scalar replicates. Same contract as
+    :func:`ring_partition_specs` — one registry, usable as shard_map
+    in/out_specs and (through ``NamedSharding``) as jit shardings."""
+    fields = type(tree)._fields
+    as_dict = {name: getattr(tree, name) for name in fields}
+    specs = match_partition_rules(PER_TREE_RULES, as_dict)
+    return type(tree)(**{name: specs[name] for name in fields})
 
 
 def shard_batch(batch, mesh: Mesh):

@@ -1,0 +1,486 @@
+"""Device-resident PER: the priority structure lives in HBM (ROADMAP item 2).
+
+Until this module, prioritized replay was the one scenario that still
+tethered the learner to the host: ``--replay-placement device`` downgraded
+PER to uniform, and ``hybrid`` shipped [K, B] index/IS-weight blocks from
+the host sum-tree every dispatch — dragging the host lock and staging
+machinery along. Here the sum tree itself moves on-chip: a log-depth
+segment tree over the ring's ``[capacity]`` α-exponentiated priorities,
+stored as the same flat ``[2L]`` array layout the host trees use
+(``replay/segment_tree.py``: root at index 1, leaves at ``[L, 2L)`` with
+``L = next_pow2(capacity)``), so stratified descent, IS-weight
+computation, and post-step priority write-back all happen INSIDE the
+fused megastep (``runtime/megastep.py:megastep_device_per_body``) with
+zero host operands in steady state.
+
+Layout and semantics mirror the host ``PrioritizedReplayBuffer`` exactly
+— same stratified equal-mass segments, same round-robin block dealing,
+same ``(|td| + ε)^α`` write-back, same max-priority seed for new rows —
+but in f32 (device arithmetic) instead of the host trees' f64. The host
+sum-tree stays the SEEDED PARITY ORACLE (the PR-6 discipline): the
+device draw's prefixes are reproducible on host from the same key
+(threefry is backend-deterministic), so tests descend the host tree with
+the identical prefixes and pin identical index draws, f32-close IS
+weights, and f32-close post-writeback priorities — frozen-literal-pinned
+on both host tree backends (``tests/test_device_per.py``).
+
+Sharding (dp): each shard owns a SHARD-LOCAL subtree over its
+``capacity/dp`` striped ring rows (``device_ring.striped_perm`` — the
+same layout the sharded ring uses, so tree row ``i`` of shard ``d`` IS
+ring row ``i`` of shard ``d``), and the only cross-shard arithmetic is a
+tiny replicated root combine — fixed-order reductions over the
+``all_gather``-ed per-shard roots/minima, the PR-9 ``det_pmean``
+discipline — which is what makes the 8-way mesh bit-exact against the
+single-device vmap oracle. Each shard contributes ``batch/dp`` draws
+proportional to its LOCAL mass (the fixed per-shard batch shape the
+megastep needs); the true sampling probability of row ``i`` on shard
+``d`` is therefore ``p_i / (D · T_d)`` and the IS weights correct for
+exactly that two-level distribution, normalized by the GLOBAL max
+weight. Striped ingest keeps shard masses statistically identical, so
+the scheme converges to global-mass PER as priorities mix; at ``dp=1``
+it reduces to the host formula term for term.
+
+Backend ladder (the ``ops/pallas_projection.py`` convention): the jnp
+log-depth gather descent here is the reference program; a Pallas
+blocked-prefix-scan kernel (``ops/pallas_tree.py``) is selectable via
+``TrainConfig.device_tree_backend="pallas"`` with the XLA path kept as
+its equivalence oracle.
+
+The traced functions here are listed in d4pglint's ``MEGASTEP_FUNCTIONS``
+manifest: host numpy / ``.item()`` inside them would smuggle a per-step
+host sync into the zero-transfer loop.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class DevicePerTree(NamedTuple):
+    """The device priority structure: ``sums`` is ``[S, 2L]`` f32 — one
+    flat segment tree per dp shard lane (S = dp, or 1 unsharded), root at
+    ``[lane, 1]``, leaves at ``[lane, L:2L)`` over the shard's LOCAL ring
+    rows; ``max_priority`` is the replicated pre-α running maximum (the
+    host buffer's ``_max_priority`` twin) that seeds newly ingested rows
+    at ``max_priority**α``."""
+
+    sums: jax.Array          # [S, 2L] f32
+    max_priority: jax.Array  # scalar f32, replicated
+
+
+def next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def tree_width(local_capacity: int) -> int:
+    """Flat-array width of one lane's tree: ``2 * next_pow2(local_cap)``."""
+    return 2 * next_pow2(local_capacity)
+
+
+def device_per_init(
+    capacity: int, *, n_shards: int = 1, mesh=None, max_priority: float = 1.0
+) -> DevicePerTree:
+    """A zero-mass tree for a ``capacity``-row ring. With ``mesh``, the
+    lane axis is placed over "dp" (``parallel/partition.py:PER_TREE_RULES``)
+    — shard-local subtrees, replicated max-priority scalar. device_put
+    COMMITS the arrays for the same jit-cache-key reason as
+    ``device_ring_init``."""
+    if capacity % n_shards:
+        raise ValueError(
+            f"device PER tree: capacity {capacity} not divisible by "
+            f"dp={n_shards}"
+        )
+    width = tree_width(capacity // n_shards)
+    tree = DevicePerTree(
+        sums=jnp.zeros((n_shards, width), jnp.float32),
+        max_priority=jnp.float32(max_priority),
+    )
+    return _place_tree(tree, mesh)
+
+
+def _place_tree(tree: DevicePerTree, mesh) -> DevicePerTree:
+    """Commit a host-built tree to device: plain device_put unsharded, or
+    per-leaf NamedSharding placement from ``PER_TREE_RULES`` on a mesh —
+    THE one placement path (init and snapshot-restore share it, so the
+    two can never place differently)."""
+    if mesh is None:
+        return jax.device_put(tree)
+    from jax.sharding import NamedSharding
+
+    from d4pg_tpu.parallel.partition import tree_partition_specs
+
+    specs = tree_partition_specs(tree)
+    return DevicePerTree(
+        *(
+            jax.device_put(leaf, NamedSharding(mesh, spec))
+            for leaf, spec in zip(tree, specs)
+        )
+    )
+
+
+# ----------------------------------------------------- per-lane traced ops
+def repair_ancestors(sums_lane: jax.Array, pos: jax.Array) -> jax.Array:
+    """Recompute every ancestor of the leaf positions ``pos`` (``[n]``
+    int32; out-of-bounds entries ``>= 2L`` stay out of bounds and are
+    dropped), one gather+scatter per level — the log-depth half of every
+    tree write. Duplicate parents all write the identical
+    children-derived value, so the scatter is deterministic."""
+    width = sums_lane.shape[0]
+    depth = (width // 2).bit_length() - 1
+    for _ in range(depth):
+        # Pads keep pointing past the end instead of dividing back into
+        # range (capacity//2 would alias a real node).
+        pos = jnp.where(pos < width, pos // 2, width)
+        vals = sums_lane[2 * pos] + sums_lane[2 * pos + 1]
+        sums_lane = sums_lane.at[pos].set(vals, mode="drop")
+    return sums_lane
+
+
+def set_leaves(
+    sums_lane: jax.Array, slots: jax.Array, values: jax.Array,
+    local_capacity: int,
+) -> jax.Array:
+    """Assign leaf values at ring slots (``slots`` int32; pad entries
+    ``>= local_capacity`` are dropped — the ring ingest's pad-slot
+    convention) and repair ancestors. ``values`` may be a scalar (the
+    max-priority ingest seed) or ``[n]``."""
+    width = sums_lane.shape[0]
+    half = width // 2
+    pos = jnp.where(slots < local_capacity, slots + half, width).astype(
+        jnp.int32
+    )
+    vals = jnp.broadcast_to(values, pos.shape).astype(jnp.float32)
+    sums_lane = sums_lane.at[pos].set(vals, mode="drop")
+    return repair_ancestors(sums_lane, pos)
+
+
+def update_leaves_last_wins(
+    sums_lane: jax.Array, idx: jax.Array, values: jax.Array,
+    local_capacity: int,
+) -> jax.Array:
+    """Leaf update with the HOST trees' duplicate semantics: when the same
+    slot appears more than once in ``idx`` (one transition drawn into
+    several rows of a [K, B] block), the LAST occurrence wins — numpy
+    assignment order, which a bare XLA scatter does not guarantee. A
+    deterministic scatter-max over flat positions picks each slot's last
+    occurrence; losers are routed out of bounds and dropped."""
+    idx = idx.reshape(-1).astype(jnp.int32)
+    vals = values.reshape(-1).astype(jnp.float32)
+    order = jnp.arange(idx.shape[0], dtype=jnp.int32)
+    latest = (
+        jnp.full((local_capacity,), -1, jnp.int32)
+        .at[idx]
+        .max(order, mode="drop")
+    )
+    win = latest[idx] == order
+    slots = jnp.where(win, idx, local_capacity)
+    return set_leaves(sums_lane, slots, vals, local_capacity)
+
+
+def stratified_prefixes(
+    key: jax.Array, k: int, batch: int, total: jax.Array
+) -> jax.Array:
+    """``[k, batch]`` prefix masses: one uniform per equal-mass segment of
+    ``[0, total)``, segment ``j`` dealt to block ``[j % k, j // k]`` — the
+    exact dealing `sample_block` uses, so batch ``i`` of a fused dispatch
+    holds draws evenly spread across the WHOLE priority mass. The
+    ``nextafter`` clamp guards the float edge where a prefix equal to
+    ``total`` would fall off the last nonzero leaf (the host `_draw`
+    guard, in f32)."""
+    n = k * batch
+    u = jax.random.uniform(key, (k, batch), jnp.float32)
+    seg = (
+        jnp.arange(n, dtype=jnp.float32)
+        .reshape(batch, k)
+        .T
+    )
+    pre = (seg + u) * (total / jnp.float32(n))
+    return jnp.minimum(pre, jnp.nextafter(total, jnp.float32(0.0)))
+
+
+def descend_prefix(sums_lane: jax.Array, prefixes: jax.Array) -> jax.Array:
+    """The XLA reference descent: for each prefix mass, the leaf index
+    ``i`` with ``cumsum[0..i-1] <= prefix < cumsum[0..i]`` — one vector
+    gather per tree level for the whole batch (the jnp twin of the host
+    ``SumTree.find_prefixsum_idx``, >= semantics so zero-mass leaves are
+    skipped and boundary prefixes select the next leaf)."""
+    width = sums_lane.shape[0]
+    half = width // 2
+    depth = half.bit_length() - 1
+    flat = prefixes.reshape(-1)
+    idx = jnp.ones(flat.shape, jnp.int32)
+    for _ in range(depth):
+        left = sums_lane[2 * idx]
+        go_right = flat >= left
+        flat = flat - jnp.where(go_right, left, jnp.float32(0.0))
+        idx = 2 * idx + go_right.astype(jnp.int32)
+    return (idx - half).reshape(prefixes.shape)
+
+
+def lane_draw(
+    sums_lane: jax.Array, key: jax.Array, k: int, batch: int,
+    local_filled: jax.Array, *, tree_backend: str = "xla",
+    interpret: bool = False,
+):
+    """One lane's stratified ``[k, batch]`` draw over its local mass.
+
+    Returns ``(idx, p_leaf, total_local)`` — slot indices, their
+    α-exponentiated leaf priorities, and this lane's root mass. The
+    ``local_filled`` clamp mirrors the host ``_draw``'s ``size - 1``
+    guard (at dp=1 ``local_filled`` IS the global fill count).
+    ``tree_backend`` selects the descent implementation: "xla" is the
+    reference log-depth gather descent, "pallas" the blocked prefix-scan
+    kernel (``ops/pallas_tree.py``) validated against it."""
+    width = sums_lane.shape[0]
+    half = width // 2
+    total = sums_lane[1]
+    pre = stratified_prefixes(key, k, batch, total)
+    if tree_backend == "pallas":
+        from d4pg_tpu.ops.pallas_tree import find_prefix_pallas
+
+        idx = find_prefix_pallas(sums_lane[half:], pre, interpret=interpret)
+    else:
+        idx = descend_prefix(sums_lane, pre)
+    idx = jnp.clip(idx, 0, jnp.maximum(local_filled - 1, 0))
+    return idx, sums_lane[half + idx], total
+
+
+def lane_min_leaf(sums_lane: jax.Array) -> jax.Array:
+    """Minimum nonzero leaf priority of one lane — the host MinTree's
+    root, computed on the fly (zero-mass leaves are never-ingested rows /
+    pow2 padding; real priorities are always ``>= eps**α > 0``)."""
+    half = sums_lane.shape[0] // 2
+    leaves = sums_lane[half:]
+    return jnp.min(jnp.where(leaves > 0, leaves, jnp.inf))
+
+
+def beta_at(step: jax.Array, beta0: float, beta_steps: int) -> jax.Array:
+    """``linear_schedule(step, beta_steps, beta0, 1.0)`` in-kernel: the β
+    anneal as a pure function of the learner step (device scalar)."""
+    frac = jnp.clip(
+        step.astype(jnp.float32) / jnp.float32(max(beta_steps, 1)), 0.0, 1.0
+    )
+    return jnp.float32(beta0) + frac * jnp.float32(1.0 - beta0)
+
+
+def importance_weights(
+    p_leaf: jax.Array, total_local: jax.Array, min_ratio_global: jax.Array,
+    n_global: jax.Array, n_shards: int, beta: jax.Array,
+) -> jax.Array:
+    """Max-normalized IS weights for the shard-stratified scheme: row
+    ``i`` on shard ``d`` is drawn with probability ``p_i / (D · T_d)``
+    (each shard contributes batch/D draws from its local mass), so
+    ``w = (N · p)^{-β}`` normalized by the GLOBAL max weight
+    ``(N · min_ratio_global)^{-β}``. At D=1 this is the host formula
+    term for term."""
+    p = p_leaf / (jnp.float32(n_shards) * total_local)
+    w = (p * n_global.astype(jnp.float32)) ** (-beta)
+    max_w = (min_ratio_global * n_global.astype(jnp.float32)) ** (-beta)
+    return (w / max_w).astype(jnp.float32)
+
+
+def write_back_lane(
+    sums_lane: jax.Array, idx: jax.Array, priorities: jax.Array,
+    alpha: float, eps: float, local_capacity: int,
+):
+    """Post-step priority write-back for one lane: ``(|td| + ε)^α`` into
+    the leaves (duplicate draws resolve last-wins, the host semantics)
+    plus this lane's contribution to the max-priority update. Returns
+    ``(sums_lane', local_max_abs_priority)`` — the caller combines the
+    local maxima across shards (an exact, order-independent reduce)."""
+    mag = jnp.abs(priorities) + jnp.float32(eps)
+    pa = mag ** jnp.float32(alpha)
+    sums_lane = update_leaves_last_wins(sums_lane, idx, pa, local_capacity)
+    return sums_lane, jnp.max(mag)
+
+
+# -------------------------------------------------------------- tree ingest
+def tree_ingest_lane_body(
+    alpha: float, local_capacity: int, sums_lane: jax.Array,
+    max_priority: jax.Array, slots: jax.Array,
+) -> jax.Array:
+    """Seed newly mirrored ring rows at ``max_priority**α`` — the
+    ``add_batch`` contract, applied to exactly the slot chunk the ring
+    ingest just scattered (pad slots ``>= local_capacity`` drop). In the
+    d4pglint ``MEGASTEP_FUNCTIONS`` manifest: jit-traced, host coercions
+    here would smuggle a per-flush sync into the device loop."""
+    return set_leaves(
+        sums_lane, slots, max_priority ** jnp.float32(alpha), local_capacity
+    )
+
+
+def make_tree_ingest(alpha: float, local_capacity: int, mesh=None):
+    """The jitted donated-buffer tree-seed program: ``(tree, slots) ->
+    tree``. One fixed slot-chunk shape (the ring sync's) → exactly one
+    compile for the run (recompile-sentinel budget 1, the ``make_ingest``
+    contract — a fresh wrapper per call so two trees never share a jit
+    specialization cache).
+
+    Unsharded: ``slots`` is the ring sync's ``[chunk_cap]`` int32 (pads =
+    capacity). Sharded: ``slots`` is ``[dp, chunk_local]`` local slot ids
+    (pads = local capacity), tree lanes and slot rows both split over
+    "dp" by shard_map — seeding stays shard-local, no collectives."""
+    if mesh is None:
+
+        def _ingest(tree, slots):
+            lane = tree_ingest_lane_body(
+                alpha, local_capacity, tree.sums[0], tree.max_priority, slots
+            )
+            return DevicePerTree(lane[None], tree.max_priority)
+
+        return jax.jit(_ingest, donate_argnums=(0,))
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from d4pg_tpu.parallel.compat import shard_map
+    from d4pg_tpu.parallel.partition import tree_partition_specs
+
+    n_shards = int(mesh.shape["dp"])
+    template = DevicePerTree(
+        sums=np.zeros((n_shards, 2), np.float32),
+        max_priority=np.zeros((), np.float32),
+    )
+    tree_specs = tree_partition_specs(template)
+    slots_spec = P("dp", None)
+
+    def _lane(tree, slots):
+        lane = tree_ingest_lane_body(
+            alpha, local_capacity, tree.sums[0], tree.max_priority, slots[0]
+        )
+        return DevicePerTree(lane[None], tree.max_priority)
+
+    mapped = shard_map(
+        _lane,
+        mesh=mesh,
+        in_specs=(tree_specs, slots_spec),
+        out_specs=tree_specs,
+        check_vma=False,
+    )
+    to_sh = lambda s: jax.tree_util.tree_map(  # noqa: E731
+        lambda x: NamedSharding(mesh, x), s,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return jax.jit(
+        mapped,
+        in_shardings=(to_sh(tree_specs), NamedSharding(mesh, slots_spec)),
+        out_shardings=to_sh(tree_specs),
+        donate_argnums=(0,),
+    )
+
+
+class DevicePerSync:
+    """The trainer-side holder of the device tree between dispatches.
+
+    Rides the ring sync's ``tree_hook`` seam
+    (``device_ring.DeviceRingSync.flush``): every slot chunk the ring
+    ingest ships is immediately seeded into the tree at
+    ``max_priority**α`` from the SAME already-staged device slot array —
+    zero extra H2D bytes, and the ring row and its priority leaf can
+    never desynchronize. The megastep consumes ``self.tree`` (donated)
+    and the trainer stores the returned tree back; ingest and dispatch
+    both run on the learner thread, so the holder needs no lock.
+    """
+
+    def __init__(self, capacity: int, alpha: float, *, mesh=None,
+                 max_priority: float = 1.0):
+        self.capacity = int(capacity)
+        self.alpha = float(alpha)
+        self._mesh = mesh
+        self.n_shards = int(mesh.shape["dp"]) if mesh is not None else 1
+        self.local_capacity = self.capacity // self.n_shards
+        self.tree = device_per_init(
+            self.capacity, n_shards=self.n_shards, mesh=mesh,
+            max_priority=max_priority,
+        )
+        self._ingest = make_tree_ingest(
+            self.alpha, self.local_capacity, mesh=mesh
+        )
+
+    @property
+    def ingest_fn(self):
+        """The jitted tree-seed entry point (recompile-sentinel tracking)."""
+        return self._ingest
+
+    def on_chunk(self, slots_dev) -> None:
+        """The ring sync's tree_hook target: seed this chunk's rows."""
+        self.tree = self._ingest(self.tree, slots_dev)
+
+    # ------------------------------------------------- snapshot / restore
+    def snapshot_host(self) -> tuple[np.ndarray, float]:
+        """Fetch the α-exponentiated leaf priorities in HOST slot order
+        (``[capacity]`` f32) plus the pre-α max priority — the replay
+        snapshot's priority sidecar (cold path: one D2H per checkpoint,
+        never per step)."""
+        sums = np.asarray(jax.device_get(self.tree.sums))
+        half = sums.shape[1] // 2
+        lanes = sums[:, half: half + self.local_capacity]  # [S, local_cap]
+        out = np.zeros(self.capacity, np.float32)
+        from d4pg_tpu.replay.device_ring import striped_perm
+
+        perm = striped_perm(self.capacity, self.n_shards)  # [S, local_cap]
+        out[perm.reshape(-1)] = lanes.reshape(-1)
+        return out, float(np.asarray(jax.device_get(self.tree.max_priority)))
+
+    def restore_host(self, pa_host: np.ndarray, max_priority: float) -> None:
+        """Rebuild the tree from snapshotted host-order α-exponentiated
+        priorities (zeros stay zero-mass: rows the snapshot never
+        covered). Setup path, never per step."""
+        self.tree = tree_from_priorities(
+            pa_host, self.capacity, n_shards=self.n_shards,
+            max_priority=max_priority, mesh=self._mesh,
+        )
+
+
+def tree_from_priorities(
+    pa_host: np.ndarray, capacity: int, *, n_shards: int = 1,
+    max_priority: float = 1.0, mesh=None,
+) -> DevicePerTree:
+    """Build a :class:`DevicePerTree` from HOST-slot-order α-exponentiated
+    priorities — the snapshot-restore path and the parity tests' oracle
+    seeding. Plain numpy level-wise construction with the same f32
+    pairwise sums the device repair computes, then one committed
+    device_put (placed per ``PER_TREE_RULES`` when ``mesh`` is given)."""
+    from d4pg_tpu.replay.device_ring import striped_perm
+
+    pa_host = np.asarray(pa_host, np.float32)
+    if pa_host.shape != (capacity,):
+        raise ValueError(
+            f"device PER tree: priorities shape {pa_host.shape} != "
+            f"({capacity},)"
+        )
+    local_capacity = capacity // n_shards
+    perm = striped_perm(capacity, n_shards)
+    width = tree_width(local_capacity)
+    half = width // 2
+    sums = np.zeros((n_shards, width), np.float32)
+    sums[:, half: half + local_capacity] = pa_host[perm]
+    lo, hi = half, width
+    while lo > 1:
+        child = sums[:, lo:hi]
+        parents = child[:, 0::2] + child[:, 1::2]
+        lo, hi = lo // 2, lo
+        sums[:, lo:hi] = parents
+    tree = DevicePerTree(
+        sums=jnp.asarray(sums), max_priority=jnp.float32(max_priority)
+    )
+    return _place_tree(tree, mesh)
+
+
+# --------------------------------------------------------- host-side oracle
+def host_prefixes(key, k: int, batch: int, total: float) -> np.ndarray:
+    """The parity oracle's half of the RNG contract: reproduce the
+    megastep's prefix draws on host from the same key (threefry is
+    backend-deterministic — the ``draw_uniform_indices`` precedent).
+    Feed these to the HOST tree's ``find_prefixsum_idx`` and the index
+    draws must match the device descent exactly
+    (tests/test_device_per.py pins the frozen literals)."""
+    return np.asarray(
+        stratified_prefixes(key, k, batch, jnp.float32(total))
+    )

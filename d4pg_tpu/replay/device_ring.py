@@ -162,6 +162,11 @@ class DeviceRingSync:
         # counter of exactly the bytes the explicit device_puts staged).
         self.bytes_ingested = 0
         self.chunks_ingested = 0
+        # Device-PER seam (replay/device_per.py:DevicePerSync.on_chunk):
+        # called with each chunk's ALREADY-STAGED device slot array so the
+        # priority tree seeds the same rows the ring just mirrored — zero
+        # extra H2D, and ring row vs priority leaf can never desync.
+        self.tree_hook = None
 
     @property
     def ingest_fn(self):
@@ -196,10 +201,12 @@ class DeviceRingSync:
             gidx[:n] = slots[:n]
             chunk = dict(buf.gather(gidx))  # locked: never a torn row
             dev_chunk = jax.device_put(chunk)  # explicit staging (exempt)
+            slots_dev = jax.device_put(slots)
             ring = self._ingest(
-                ring, dev_chunk, jax.device_put(slots),
-                jax.device_put(new_size),
+                ring, dev_chunk, slots_dev, jax.device_put(new_size),
             )
+            if self.tree_hook is not None:
+                self.tree_hook(slots_dev)
             self.bytes_ingested += sum(v.nbytes for v in chunk.values())
             self.bytes_ingested += slots.nbytes + new_size.nbytes
             self.chunks_ingested += 1
@@ -377,6 +384,10 @@ class ShardedDeviceRingSync:
         self._scalar_sharding = NamedSharding(mesh, P())
         self.bytes_ingested = 0
         self.chunks_ingested = 0
+        # Device-PER seam: same contract as DeviceRingSync.tree_hook, with
+        # the [dp, chunk_local] LOCAL-slot layout this sync stages (pad =
+        # local capacity) — exactly what the sharded tree ingest expects.
+        self.tree_hook = None
 
     @property
     def ingest_fn(self):
@@ -422,12 +433,15 @@ class ShardedDeviceRingSync:
                 k: jax.device_put(v, self._chunk_sharding[k])
                 for k, v in chunk.items()
             }
+            slots_dev = jax.device_put(slots, self._slots_sharding)
             ring = self._ingest(
                 ring,
                 dev_chunk,
-                jax.device_put(slots, self._slots_sharding),
+                slots_dev,
                 jax.device_put(new_size, self._scalar_sharding),
             )
+            if self.tree_hook is not None:
+                self.tree_hook(slots_dev)
             self.bytes_ingested += sum(v.nbytes for v in chunk.values())
             self.bytes_ingested += slots.nbytes + new_size.nbytes
             self.chunks_ingested += 1

@@ -17,11 +17,14 @@ a little more each PR. This module replaces that with ONE rule table:
 
 - :func:`negotiate` maps a :class:`RequestedCaps` (what a config asks
   for) to a :class:`Negotiation` — verdict ``pass``, ``negotiated``
-  (the request is honored with a declared downgrade, e.g. device
-  placement draws uniformly so PER switches off), or ``gap`` (a declared
+  (the request is honored with a declared action, e.g. hybrid placement
+  keeping the legacy host-tree PER round-trip), or ``gap`` (a declared
   capability gap with a machine-readable reason code). Every refusal the
   system can utter lives HERE, once; the messages below are the exact
   strings the CLI and the Trainer raise, so they can never drift again.
+  Since ISSUE 14, ``device`` placement composes with PER outright (the
+  priority structure is device-resident, ``replay/device_per.py``) —
+  the old ``per_downgraded_uniform`` action is gone.
 - :func:`validate_train_config` is the single call site both entry
   points use (``train.py`` pre-env, ``Trainer.__init__`` post-env).
 - :func:`learner_fleet_caps` / :func:`negotiate_fleet` are the fleet
@@ -163,14 +166,18 @@ def negotiate(caps: RequestedCaps) -> Negotiation:
         )
         return Negotiation("gap", (), tuple(gaps))
 
-    prioritized = caps.prioritized
-    if caps.placement == "device" and prioritized:
-        # device placement IS the uniform in-kernel-draw mode; PER needs
-        # the host sum-tree, which is exactly what hybrid keeps. A
-        # DECLARED downgrade, not a refusal: the run proceeds uniform.
-        actions.append("per_downgraded_uniform")
-        prioritized = False
-    if caps.placement == "hybrid" and not prioritized:
+    # Device placement composes with PER outright since ISSUE 14: the
+    # priority structure itself is device-resident (replay/device_per.py
+    # — stratified descent, IS weights, and write-back inside the fused
+    # megastep), so device×PER is a PASS, not the old uniform downgrade.
+    if caps.placement == "hybrid" and caps.prioritized:
+        # Hybrid is now the LEGACY placement: the host sum-tree still
+        # owns the descent and ships [K, B] index/weight blocks every
+        # dispatch. It stays supported as the byte-parity oracle of the
+        # host data plane — a declared action, so the matrix says which
+        # cells still pay the host round-trip.
+        actions.append("hybrid_legacy_host_tree")
+    if caps.placement == "hybrid" and not caps.prioritized:
         gap(
             "hybrid_requires_per",
             "replay_placement=hybrid is the PER mode (host sum-tree "
@@ -388,8 +395,9 @@ def validate_train_config(config, *, on_device: bool = False,
     """THE validation call site (train.py and Trainer.__init__ both land
     here). Raises ``ValueError`` carrying every gap message when the
     composition has a declared gap; returns the :class:`Negotiation` so
-    callers apply the declared downgrade actions (PER→uniform, prefetch
-    ignored) — mutation stays with the owner of the config object."""
+    callers apply/announce the declared actions (prefetch ignored,
+    hybrid's legacy note) — mutation stays with the owner of the config
+    object."""
     n = negotiate(
         from_train_config(config, on_device=on_device, is_jax_env=is_jax_env)
     )

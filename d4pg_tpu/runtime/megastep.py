@@ -242,6 +242,246 @@ def make_megastep_uniform_sharded(
     )
 
 
+# ------------------------------------------------------- device-resident PER
+def megastep_device_per_body(
+    config: D4PGConfig, k: int, b_local: int, n_shards: int,
+    tree_backend: str, interpret: bool,
+    state: TrainState, ring: DeviceRing, sums_lane: jax.Array,
+    max_priority: jax.Array, key: jax.Array,
+):
+    """K grad steps on PER draws from the lane's device-resident segment
+    tree (``replay/device_per.py``) — stratified descent, IS weights, and
+    post-step priority write-back all inside the one jitted call, so
+    steady state has ZERO host operands with prioritized replay ON (the
+    draw that used to be the hybrid placement's host round-trip).
+
+    Per-lane everything: the [k, b_local] draw comes from this shard's
+    local mass (fold_in(shard) key, the sharded-uniform discipline), the
+    gather and the write-back touch only local rows, and the ONLY
+    cross-shard arithmetic is (a) gradients through ``det_pmean`` and
+    (b) two exact order-independent reductions (global min weight ratio,
+    global max |td|) over ``all_gather``-ed per-lane scalars — which is
+    why the dp mesh is bit-exact vs the single-device vmap oracle
+    (``make_megastep_device_per_oracle``), the PR-9 contract. At
+    ``n_shards == 1`` the collectives compile away (static branch) and
+    the sampling scheme reduces to the host ``PrioritizedReplayBuffer``
+    formula term for term — the host-tree parity oracle rides that.
+
+    Returns ``(state, sums_lane', max_priority', key', metrics)``.
+    """
+    from d4pg_tpu.replay import device_per as dper
+
+    if n_shards > 1:
+        shard = jax.lax.axis_index("dp")
+    else:
+        shard = jnp.int32(0)
+    key, k_draw = jax.random.split(key)
+    # Shard-local fill count: striping lands host slot j on shard j % D,
+    # so shard d holds ceil((size - d) / D) mirrored rows (== size at D=1
+    # — the host _draw's size-1 clamp).
+    local_filled = (ring.size - shard + n_shards - 1) // n_shards
+    idx, p_leaf, total_local = dper.lane_draw(
+        sums_lane, jax.random.fold_in(k_draw, shard), k, b_local,
+        local_filled, tree_backend=tree_backend, interpret=interpret,
+    )
+    min_ratio = dper.lane_min_leaf(sums_lane) / (
+        jnp.float32(n_shards) * total_local
+    )
+    if n_shards > 1:
+        # Exact order-independent reduce over the gathered lane scalars
+        # (min is associative+commutative+exact in fp — no fixed-order
+        # unroll needed for bit-parity, unlike the gradient sum).
+        min_ratio = jnp.min(jax.lax.all_gather(min_ratio, "dp"))
+    beta = dper.beta_at(state.step, config.per_beta0, config.per_beta_steps)
+    weights = dper.importance_weights(
+        p_leaf, total_local, min_ratio, ring.size, n_shards, beta
+    )
+    batches = gather_batches(ring, idx)
+    batches["weights"] = weights
+    if n_shards > 1:
+        from d4pg_tpu.parallel.dp import det_pmean
+
+        sync = partial(det_pmean, axis_name="dp", size=n_shards)
+    else:
+        sync = None
+    state, metrics, priorities = fused_train_scan(
+        config, state, batches, sync_fn=sync
+    )
+    sums_lane, mp_local = dper.write_back_lane(
+        sums_lane, idx, priorities, config.per_alpha, config.per_eps,
+        local_capacity=ring.obs.shape[0],
+    )
+    if n_shards > 1:
+        mp_local = jnp.max(jax.lax.all_gather(mp_local, "dp"))
+    max_priority = jnp.maximum(max_priority, mp_local)
+    return (
+        state, sums_lane, max_priority, key,
+        jax.tree.map(lambda x: x.mean(), metrics),
+    )
+
+
+def _pallas_interpret() -> bool:
+    """Pallas kernels run the interpreter off-TPU (the CPU-test mode the
+    projection kernels use; d4pg.py:build sets the same switch)."""
+    return jax.default_backend() != "tpu"
+
+
+def make_megastep_device_per(
+    config: D4PGConfig, k: int, batch: int, tree_backend: str = "xla",
+):
+    """Jitted donated-buffer device-PER megastep, single device:
+    ``(state, ring, tree, key) -> (state, tree', key', metrics)``. State
+    and tree are donated (both update in place); the ring is read-only
+    here and stays resident. One compiled program per (K, B) — the
+    sentinel budgets it exactly like the uniform megastep."""
+    return jax.jit(
+        _device_per_lane_fn(config, k, batch, 1, tree_backend),
+        donate_argnums=(0, 2),
+    )
+
+
+def _device_per_lane_fn(config, k, b_local, n_shards, tree_backend):
+    """The shared per-lane wrapper (tree pytree in/out) that both the
+    shard_map mesh path and the vmap oracle run — same bits, two
+    harnesses, the PR-9 byte-identity recipe."""
+    from d4pg_tpu.replay.device_per import DevicePerTree
+
+    body = partial(
+        megastep_device_per_body, config, k, b_local, n_shards,
+        tree_backend, _pallas_interpret(),
+    )
+
+    def lane(state, ring, tree, key):
+        state, sums, mp, key, metrics = body(
+            state, ring, tree.sums[0], tree.max_priority, key
+        )
+        return state, DevicePerTree(sums[None], mp), key, metrics
+
+    return lane
+
+
+def make_megastep_device_per_sharded(
+    config: D4PGConfig, k: int, batch: int, mesh, tree_backend: str = "xla",
+    rules=None,
+):
+    """Jitted donated-buffer SHARDED device-PER megastep over a dp mesh:
+    ``(state, ring, tree, key) -> (state, tree', key', metrics)`` with
+    in/out shardings from the rule registries (state:
+    ``match_partition_rules``, ring: ``RING_RULES``, tree:
+    ``PER_TREE_RULES``). Same mesh constraints as the uniform sharded
+    megastep (dp-only, divisible batch)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from d4pg_tpu.parallel.compat import shard_map
+    from d4pg_tpu.parallel.partition import (
+        DEFAULT_RULES,
+        _abstract_state,
+        _state_specs,
+        ring_partition_specs,
+        stack_axes_for,
+        tree_partition_specs,
+    )
+    from d4pg_tpu.replay.device_per import DevicePerTree
+
+    n_shards = int(mesh.shape["dp"])
+    if int(mesh.shape.get("tp", 1)) != 1:
+        raise ValueError(
+            "sharded megastep mesh must be dp-only (tp=1); tensor "
+            "parallelism composes via the GSPMD host path "
+            f"(got tp={mesh.shape['tp']})"
+        )
+    if batch % n_shards:
+        raise ValueError(
+            f"sharded megastep: batch {batch} not divisible by dp={n_shards}"
+        )
+    dummy = jax.eval_shape(
+        lambda kk: _abstract_state(config, kk), jax.random.PRNGKey(0)
+    )
+    state_specs = _state_specs(
+        dummy, rules or DEFAULT_RULES, mesh, stack_axes_for(config)
+    )
+    ring_template = DeviceRing(
+        obs=jnp.zeros((2, config.obs_dim)),
+        action=jnp.zeros((2, config.action_dim)),
+        reward=jnp.zeros((2,)),
+        next_obs=jnp.zeros((2, config.obs_dim)),
+        discount=jnp.zeros((2,)),
+        size=jnp.zeros((), jnp.int32),
+    )
+    ring_specs = ring_partition_specs(ring_template)
+    tree_specs = tree_partition_specs(
+        DevicePerTree(
+            sums=jnp.zeros((2, 2), jnp.float32),
+            max_priority=jnp.zeros((), jnp.float32),
+        )
+    )
+    lane = _device_per_lane_fn(
+        config, k, batch // n_shards, n_shards, tree_backend
+    )
+    mapped = shard_map(
+        lane,
+        mesh=mesh,
+        in_specs=(state_specs, ring_specs, tree_specs, P()),
+        out_specs=(state_specs, tree_specs, P(), P()),
+        check_vma=False,
+    )
+    to_shardings = lambda specs: jax.tree_util.tree_map(  # noqa: E731
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    key_sharding = NamedSharding(mesh, P())
+    return jax.jit(
+        mapped,
+        in_shardings=(
+            to_shardings(state_specs), to_shardings(ring_specs),
+            to_shardings(tree_specs), key_sharding,
+        ),
+        out_shardings=(
+            to_shardings(state_specs), to_shardings(tree_specs),
+            key_sharding, NamedSharding(mesh, P()),
+        ),
+        donate_argnums=(0, 2),
+    )
+
+
+def make_megastep_device_per_oracle(
+    config: D4PGConfig, k: int, batch: int, n_shards: int,
+    tree_backend: str = "xla",
+):
+    """The sharded device-PER megastep's SINGLE-DEVICE parity oracle: the
+    same per-lane function under ``vmap(axis_name="dp")`` over striped
+    ring lanes (``striped_lanes``) and tree lanes. ``(state, ring_lanes,
+    tree, key) -> (state, tree', key', metrics)``; the TrainState is
+    BYTE-IDENTICAL to the mesh path's (tests pin it) because the body's
+    cross-lane arithmetic is det_pmean plus exact min/max reduces."""
+    from d4pg_tpu.replay.device_per import DevicePerTree
+
+    body = partial(
+        megastep_device_per_body, config, k, batch // n_shards, n_shards,
+        tree_backend, _pallas_interpret(),
+    )
+    lane_axes = DeviceRing(
+        obs=0, action=0, reward=0, next_obs=0, discount=0, size=None
+    )
+    vm = jax.vmap(
+        body, in_axes=(None, lane_axes, 0, None, None), out_axes=0,
+        axis_name="dp",
+    )
+
+    def run(state, ring_lanes, tree, key):
+        st, sums, mp, keys, metrics = vm(
+            state, ring_lanes, tree.sums, tree.max_priority, key
+        )
+        # Lane outputs are det-synced identical (state/key/metrics/max);
+        # lane 0 IS the result. The subtree lanes stay per-lane.
+        first = lambda t: jax.tree.map(lambda x: x[0], t)  # noqa: E731
+        return (
+            first(st), DevicePerTree(sums, mp[0]), keys[0], first(metrics)
+        )
+
+    return jax.jit(run)
+
+
 def make_megastep_uniform_oracle(config: D4PGConfig, k: int, batch: int,
                                  n_shards: int):
     """The sharded megastep's SINGLE-DEVICE parity oracle: the same

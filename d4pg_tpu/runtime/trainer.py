@@ -235,18 +235,16 @@ class Trainer:
             config, is_jax_env=self.is_jax_env
         )
         placement = config.replay_placement
-        if "per_downgraded_uniform" in negotiation.actions:
-            # device placement IS the uniform in-kernel-draw mode; PER needs
-            # the host sum-tree, which is exactly what hybrid keeps.
+        if "hybrid_legacy_host_tree" in negotiation.actions:
+            # ISSUE 14: the priority structure is device-resident now, so
+            # hybrid's host-tree round-trip is the LEGACY path — declared
+            # (and kept as the host data plane's byte-parity oracle), not
+            # refused.
             print(
-                "[replay] replay_placement=device draws uniformly in-kernel; "
-                "disabling PER for this run (replay_placement=hybrid keeps "
-                "prioritized replay with host-computed indices)"
-            )
-            config = dataclasses.replace(
-                config,
-                prioritized=False,
-                agent=dataclasses.replace(config.agent, prioritized=False),
+                "[replay] replay_placement=hybrid keeps the legacy host "
+                "sum-tree round-trip ([K,B] indices/weights per dispatch); "
+                "--replay-placement device now runs PER fully on-device "
+                "(docs/data_plane.md)"
             )
         if "prefetch_ignored" in negotiation.actions:
             print(
@@ -276,7 +274,22 @@ class Trainer:
         # buffers (the seam's uint8_wire_requires_pixel gap already
         # refused the flat-env combination above).
         decode_on_sample = config.transfer_dtype != "uint8"
-        if config.prioritized:
+        if config.prioritized and placement == "device":
+            # Device-resident PER (ISSUE 14): the priority structure lives
+            # ON DEVICE (replay/device_per.py — built in the device-ring
+            # block below), so the host buffer is a plain ring: writers,
+            # HER, fleet ingest, snapshots all unchanged, but no host
+            # trees to maintain — the descent, IS weights, and write-back
+            # never touch the host.
+            self.buffer = ReplayBuffer(
+                config.replay_capacity,
+                obs_dim,
+                act_dim,
+                obs_dtype=obs_dtype,
+                obs_scale=obs_scale,
+                decode_on_sample=decode_on_sample,
+            )
+        elif config.prioritized:
             self.buffer = PrioritizedReplayBuffer(
                 config.replay_capacity,
                 obs_dim,
@@ -402,6 +415,9 @@ class Trainer:
         self._mega_mesh = None
         self._state_shard_fns = None
         self._state_gather_fns = None
+        # Device-resident PER (ISSUE 14): the priority segment tree +
+        # its ingest hook, set iff placement == "device" and PER is on.
+        self._dev_per = None
         if self._placement != "host":
             from d4pg_tpu.replay.device_ring import (
                 DeviceRingSync,
@@ -409,6 +425,8 @@ class Trainer:
                 device_ring_init,
             )
             from d4pg_tpu.runtime.megastep import (
+                make_megastep_device_per,
+                make_megastep_device_per_sharded,
                 make_megastep_hybrid,
                 make_megastep_uniform,
                 make_megastep_uniform_sharded,
@@ -429,6 +447,21 @@ class Trainer:
             else:
                 self._ring_sync = DeviceRingSync(self.buffer)
             if self._placement == "device":
+                K = max(1, config.steps_per_dispatch)
+                if config.prioritized:
+                    # The on-chip priority structure: shard-local subtrees
+                    # over the striped ring rows, seeded at max_priority^α
+                    # through the ring sync's tree_hook (same staged slot
+                    # arrays — zero extra H2D, rows and leaves can never
+                    # desync).
+                    from d4pg_tpu.replay.device_per import DevicePerSync
+
+                    self._dev_per = DevicePerSync(
+                        config.replay_capacity,
+                        agent_cfg.per_alpha,
+                        mesh=self._mega_mesh,
+                    )
+                    self._ring_sync.tree_hook = self._dev_per.on_chunk
                 if self._mega_mesh is not None:
                     # Sharded megastep (ROADMAP item 2): state placed per
                     # the partition-rule registry, ring rows striped over
@@ -456,17 +489,24 @@ class Trainer:
                     from d4pg_tpu.parallel import apply_fns
 
                     self.state = apply_fns(self._state_shard_fns, self.state)
-                    self._megastep = make_megastep_uniform_sharded(
-                        agent_cfg,
-                        max(1, config.steps_per_dispatch),
-                        config.batch_size,
-                        self._mega_mesh,
+                    if config.prioritized:
+                        self._megastep = make_megastep_device_per_sharded(
+                            agent_cfg, K, config.batch_size,
+                            self._mega_mesh,
+                            tree_backend=config.device_tree_backend,
+                        )
+                    else:
+                        self._megastep = make_megastep_uniform_sharded(
+                            agent_cfg, K, config.batch_size, self._mega_mesh
+                        )
+                elif config.prioritized:
+                    self._megastep = make_megastep_device_per(
+                        agent_cfg, K, config.batch_size,
+                        tree_backend=config.device_tree_backend,
                     )
                 else:
                     self._megastep = make_megastep_uniform(
-                        agent_cfg,
-                        max(1, config.steps_per_dispatch),
-                        config.batch_size,
+                        agent_cfg, K, config.batch_size
                     )
                 # The megastep's index-draw key lives ON DEVICE and is
                 # split inside the jitted call — steady state has no host
@@ -526,6 +566,12 @@ class Trainer:
                 self.sentinel.track(
                     "ring_ingest", self._ring_sync.ingest_fn, budget=1
                 )
+                if self._dev_per is not None:
+                    # Same contract for the priority-seed program: one
+                    # fixed slot-chunk shape → one compile, ever.
+                    self.sentinel.track(
+                        "tree_ingest", self._dev_per.ingest_fn, budget=1
+                    )
             self._dispatch_guard = no_implicit_transfers
             self._ledger = StagingLedger("trainer")
             if hasattr(self.buffer, "set_ledger"):
@@ -648,6 +694,33 @@ class Trainer:
                         f"({e}); resuming with an empty buffer (warmup "
                         "will be repaid)"
                     )
+            if self._replay_restored and self._dev_per is not None:
+                # Device-PER resume: mirror the restored rows NOW (setup,
+                # not loop — the tree_hook seeds every leaf at
+                # max_priority^α), then overwrite the seeds with the
+                # snapshotted priorities when the sidecar survived. A
+                # missing/torn sidecar degrades to the max-priority seeds —
+                # the same semantics a host PER buffer restores from a
+                # uniform snapshot with.
+                with annotate("host/device_per_restore"):
+                    self._ring = self._ring_sync.flush(self._ring)
+                dp_snap = self._device_per_snapshot_path()
+                if os.path.exists(dp_snap):
+                    try:
+                        with np.load(dp_snap) as z:
+                            self._dev_per.restore_host(
+                                z["priorities_alpha"],
+                                float(z["max_priority"]),
+                            )
+                        print("restored device-PER priorities")
+                    except (
+                        OSError, ValueError, KeyError, zipfile.BadZipFile
+                    ) as e:
+                        print(
+                            f"[checkpoint] device-PER snapshot {dp_snap} "
+                            f"unreadable ({e}); priorities re-seeded at "
+                            "max (they re-learn within a few dispatches)"
+                        )
 
         # Networked collection fleet (--fleet-listen, d4pg_tpu/fleet,
         # docs/fleet.md): an experience-ingest server in front of
@@ -1739,12 +1812,30 @@ class Trainer:
         cfg = self.config
         if self._placement == "device":
             with self._timers.stage("ingest_chunk"):
+                # The flush's tree_hook seeds newly mirrored rows into the
+                # device PER tree from the same staged slot arrays.
                 self._ring = self._ring_sync.flush(self._ring)
             with self._timers.stage("megastep_dispatch"):
                 with self._megastep_guard():
-                    self.state, self._megastep_key, metrics = self._megastep(
-                        self.state, self._ring, self._megastep_key
-                    )
+                    if self._dev_per is not None:
+                        # Device-resident PER: descent, IS weights, and
+                        # priority write-back all inside the jitted call —
+                        # nothing comes back for the host to write.
+                        (
+                            self.state,
+                            self._dev_per.tree,
+                            self._megastep_key,
+                            metrics,
+                        ) = self._megastep(
+                            self.state, self._ring, self._dev_per.tree,
+                            self._megastep_key,
+                        )
+                    else:
+                        self.state, self._megastep_key, metrics = (
+                            self._megastep(
+                                self.state, self._ring, self._megastep_key
+                            )
+                        )
             self._megastep_warm = True
             return None, metrics, None
         with self._timers.stage("sample"):
@@ -1808,7 +1899,13 @@ class Trainer:
             self._start_collector()
         else:
             self.warmup()
-        if cfg.async_priority_writeback and cfg.prioritized:
+        if (
+            cfg.async_priority_writeback
+            and cfg.prioritized
+            and self._placement != "device"
+        ):
+            # Device placement has no host priority write-backs to flush
+            # (the megastep updates the device tree in-kernel).
             self._start_writeback()
 
         t_start = time.monotonic()
@@ -1971,7 +2068,10 @@ class Trainer:
                     # still drop correctly.
                     with annotate("host/prefetch"):
                         staged = self._sample_staged(K)
-                if self.config.prioritized:
+                # Device-resident PER writes priorities back in-kernel:
+                # the dispatch returns no indices/priorities and there is
+                # nothing for the host to flush.
+                if self.config.prioritized and priorities is not None:
                     if self._wb_thread is not None:
                         self._queue_writeback(indices, priorities)
                     else:
@@ -2071,6 +2171,11 @@ class Trainer:
     def _replay_snapshot_path(self) -> str:
         return os.path.join(self.config.log_dir, "checkpoints", "replay.npz")
 
+    def _device_per_snapshot_path(self) -> str:
+        return os.path.join(
+            self.config.log_dir, "checkpoints", "device_per.npz"
+        )
+
     def _save_checkpoint(self) -> None:
         state = self.state
         if self._state_gather_fns is not None:
@@ -2110,6 +2215,18 @@ class Trainer:
             self._drain_writeback()
             with annotate("host/replay_snapshot"):
                 self.buffer.snapshot(self._replay_snapshot_path())
+            if self._dev_per is not None:
+                # Device-PER priority sidecar: the tree's α-exponentiated
+                # leaves in host slot order + the pre-α max (ONE cold-path
+                # D2H per checkpoint — never per step). Without it a
+                # --resume re-seeds every row at max priority, the same
+                # degradation a uniform-buffer snapshot restores to.
+                pa, mp = self._dev_per.snapshot_host()
+                dp_path = self._device_per_snapshot_path()
+                tmp = dp_path + ".tmp"
+                with open(tmp, "wb") as f:  # file object: savez appends no suffix
+                    np.savez(f, priorities_alpha=pa, max_priority=mp)
+                os.replace(tmp, dp_path)
         # Commit record LAST (write-ordering mirrors the best_eval
         # contract): the manifest digests everything this save produced, so
         # a kill -9 anywhere above leaves the step unattested and
@@ -2117,6 +2234,8 @@ class Trainer:
         side = [trainer_meta_path(self.config.log_dir)]
         if self.config.snapshot_replay:
             side.append(self._replay_snapshot_path())
+            if self._dev_per is not None:
+                side.append(self._device_per_snapshot_path())
         self.ckpt.write_manifest(self.grad_steps, side_files=side)
         if self._chaos is not None:
             e = self._chaos.tick("ckpt_truncate")

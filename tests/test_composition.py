@@ -60,10 +60,19 @@ def test_issue13_cells_are_open():
         assert by[(scen, "host")] == "pass", scen
 
 
-def test_device_per_is_negotiated_not_refused():
+def test_device_per_is_a_pass_and_hybrid_is_legacy():
+    """ISSUE 14: device placement composes with PER outright (the
+    priority structure is device-resident) — the old
+    per_downgraded_uniform action is gone — and hybrid re-verdicts as
+    the DECLARED legacy host-tree placement."""
     n = source.negotiate(source.RequestedCaps(placement="device"))
-    assert n.verdict == "negotiated"
-    assert "per_downgraded_uniform" in n.actions
+    assert n.verdict == "pass"
+    assert n.actions == ()
+    n_dp = source.negotiate(source.RequestedCaps(placement="device", dp=8))
+    assert n_dp.verdict == "pass"
+    n_hyb = source.negotiate(source.RequestedCaps(placement="hybrid"))
+    assert n_hyb.verdict == "negotiated"
+    assert "hybrid_legacy_host_tree" in n_hyb.actions
 
 
 def test_committed_artifact_is_fresh_and_schema_clean():

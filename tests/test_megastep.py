@@ -387,16 +387,19 @@ class TestTrainerPlacement:
                 with no_transfers():
                     np.asarray(metrics["critic_loss"])
 
-    def test_device_downgrades_prioritized_loudly(self, tmp_path, capsys):
-        """`--replay-placement device` with the default PER flag trains
-        uniformly (the in-kernel draw IS the sampler) and says so."""
+    def test_device_keeps_prioritized_on_device(self, tmp_path, capsys):
+        """ISSUE 14: `--replay-placement device` with the default PER
+        flag KEEPS prioritized replay — the priority structure is the
+        device-resident segment tree (tests/test_device_per.py has the
+        full contract), the host buffer a plain ring, no downgrade."""
         from d4pg_tpu.runtime.trainer import Trainer
 
         t = Trainer(_trainer_cfg("device", str(tmp_path / "d")))
         try:
-            assert t.config.prioritized is False
+            assert t.config.prioritized is True
             assert isinstance(t.buffer, ReplayBuffer)
             assert not isinstance(t.buffer, PrioritizedReplayBuffer)
+            assert t._dev_per is not None
         finally:
             t.close()
-        assert "disabling PER" in capsys.readouterr().out
+        assert "disabling PER" not in capsys.readouterr().out

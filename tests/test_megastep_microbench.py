@@ -35,12 +35,17 @@ def test_generator_runs_at_small_shape(tmp_path):
         out_path, batch=16, k=4, hidden=32, rows=1024, steps=3, repeats=1
     )
     assert os.path.exists(out_path)
-    for name in ("host_block_k32", "hybrid_k32", "device_k32"):
+    for name in ("host_block_k32", "hybrid_k32", "device_k32",
+                 "device_per_k32"):
         row = out[name]
         assert row["steps_per_sec"] > 0
         assert row["transfer_bytes_per_grad_step"] >= 0
     # the structural (not timing) halves of the claim hold at ANY shape:
     assert out["device_k32"]["transfer_bytes_per_grad_step"] == 0.0
+    # ISSUE 14: PER on, still zero per-grad-step traffic — the device
+    # tree keeps the whole descent/write-back loop on-chip
+    assert out["device_per_k32"]["transfer_bytes_per_grad_step"] == 0.0
+    assert out["device_per_k32"]["per"] is True
     assert (
         out["hybrid_k32"]["transfer_bytes_per_grad_step"]
         < out["host_block_k32"]["transfer_bytes_per_grad_step"]
@@ -57,7 +62,8 @@ def test_committed_artifact_schema_and_headline():
         doc = json.load(f)
     assert doc["metric"] == "megastep_microbench"
     assert "backend" in doc and "on_chip_recipe" in doc
-    for name in ("host_block_k32", "hybrid_k32", "device_k32"):
+    for name in ("host_block_k32", "hybrid_k32", "device_k32",
+                 "device_per_k32"):
         row = doc[name]
         assert row["steps_per_sec"] > 0
         assert "transfer_bytes_per_grad_step" in row
@@ -67,6 +73,7 @@ def test_committed_artifact_schema_and_headline():
     assert doc["device_k32_steps_ratio"] >= 1.0
     assert doc["hybrid_k32_steps_ratio"] >= 1.0
     assert doc["device_k32"]["transfer_bytes_per_grad_step"] == 0.0
+    assert doc["device_per_k32"]["transfer_bytes_per_grad_step"] == 0.0
     assert (
         doc["hybrid_k32"]["transfer_bytes_per_grad_step"]
         < doc["host_block_k32"]["transfer_bytes_per_grad_step"]

@@ -47,6 +47,11 @@ def test_generator_runs_at_small_shape(tmp_path):
         # sharded steady state stages/fetches NOTHING per grad step
         assert row["transfer_bytes_per_grad_step"] == 0.0
     assert out["megastep_dp4"]["dp"] == 4
+    # ISSUE 14: the zero-bytes contract covers PRIORITIZED replay too —
+    # shard-local device subtrees, nothing staged/fetched per grad step
+    per_row = out["megastep_per_dp4"]
+    assert per_row["per"] is True and per_row["dp"] == 4
+    assert per_row["transfer_bytes_per_grad_step"] == 0.0
     ens = out["ensemble_mog_wide"]
     assert ens["ensemble"] == 4 and ens["steps_per_sec"] > 0
     with open(out_path) as f:
@@ -72,6 +77,16 @@ def test_committed_artifact_schema_and_headline():
         assert row["steps_per_sec"] > 0
         assert row["steps_per_sec_repeats"]
         assert row["transfer_bytes_per_grad_step"] == 0.0
+    per_rows = {
+        k: v for k, v in doc.items()
+        if k.startswith("megastep_per_") and isinstance(v, dict)
+    }
+    assert per_rows, "committed artifact lost its device-PER rows"
+    assert any(v["dp"] > 1 for v in per_rows.values())
+    for row in per_rows.values():
+        assert row["per"] is True
+        assert row["transfer_bytes_per_grad_step"] == 0.0
+        assert row["steps_per_sec"] > 0
     ens = doc["ensemble_mog_wide"]
     assert ens["ensemble"] >= 4
     assert ens["hidden"] >= 512  # the WIDE shape, where sharding is load-bearing
@@ -102,3 +117,24 @@ def test_committed_mfu_sweep_has_sharded_rows():
     assert any(
         str(r.get("config", "")) == "megastep_mlp256" for r in rows
     ), "--sharded-only regen clobbered the megastep rows"
+
+
+def test_committed_mfu_sweep_has_device_per_rows():
+    """ISSUE 14: the sweep carries the device-PER family — the wide-shape
+    rows reachable by runs using the paper's actual sampling scheme —
+    with the zero-transfer column intact, and partial regens preserve
+    every other family (the --megastep-only precedent)."""
+    sweep = os.path.join(os.path.dirname(ARTIFACT), "mfu_sweep_results.json")
+    with open(sweep) as f:
+        rows = json.load(f)
+    per = [
+        r for r in rows
+        if str(r.get("config", "")).startswith("device_per_megastep")
+    ]
+    assert per, "mfu_sweep_results.json lost its device-PER rows"
+    for r in per:
+        assert r["bench"] == "mfu_sweep"
+        assert "backend" in r  # CPU placeholders must be distinguishable
+        assert r["transfer_bytes_per_grad_step"] == 0.0
+        assert r["steps_per_sec"] > 0
+    assert any(r["dp"] > 1 for r in per), "no mesh-spanning device-PER row"

@@ -141,8 +141,29 @@ MEGASTEP_FUNCTIONS = (
     "d4pg_tpu/runtime/megastep.py::megastep_hybrid_body",
     "d4pg_tpu/runtime/megastep.py::draw_uniform_indices",
     "d4pg_tpu/runtime/megastep.py::sharded_megastep_uniform_body",
+    # Device-resident PER (ISSUE 14): the body runs descent + IS weights
+    # + write-back inside the fused dispatch — a host coercion anywhere
+    # in it or in the tree primitives below re-tethers PER to the host.
+    "d4pg_tpu/runtime/megastep.py::megastep_device_per_body",
     "d4pg_tpu/replay/device_ring.py::ingest_body",
     "d4pg_tpu/replay/device_ring.py::sharded_ingest_body",
+    # The device priority tree's traced primitives (replay/device_per.py):
+    # every one is traced into the megastep or the per-flush tree seed.
+    "d4pg_tpu/replay/device_per.py::repair_ancestors",
+    "d4pg_tpu/replay/device_per.py::set_leaves",
+    "d4pg_tpu/replay/device_per.py::update_leaves_last_wins",
+    "d4pg_tpu/replay/device_per.py::stratified_prefixes",
+    "d4pg_tpu/replay/device_per.py::descend_prefix",
+    "d4pg_tpu/replay/device_per.py::lane_draw",
+    "d4pg_tpu/replay/device_per.py::lane_min_leaf",
+    "d4pg_tpu/replay/device_per.py::beta_at",
+    "d4pg_tpu/replay/device_per.py::importance_weights",
+    "d4pg_tpu/replay/device_per.py::write_back_lane",
+    "d4pg_tpu/replay/device_per.py::tree_ingest_lane_body",
+    # The Pallas descent kernel and its wrapper trace into the megastep
+    # when device_tree_backend="pallas".
+    "d4pg_tpu/ops/pallas_tree.py::_count_kernel",
+    "d4pg_tpu/ops/pallas_tree.py::find_prefix_pallas",
     # The sharded megastep's deterministic cross-shard combine: traced
     # into every sharded dispatch, so a host coercion here would smuggle
     # a sync into the zero-transfer loop exactly like the bodies above.

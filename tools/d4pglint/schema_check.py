@@ -205,6 +205,34 @@ def check_shard_microbench(path: str) -> list[str]:
             )
     if not any(v.get("dp", 1) > 1 for _, v in dp_rows):
         errs.append(f"{path}: no megastep row with dp > 1")
+    # ISSUE 14: the zero-bytes contract extends to PRIORITIZED replay —
+    # a device-PER megastep row must exist, span the mesh (dp > 1), and
+    # attest zero per-grad-step transfer bytes (the priority structure is
+    # on-chip; any traffic here means the tree leaked back to the host).
+    per_rows = [
+        (k, v) for k, v in doc.items()
+        if k.startswith("megastep_per_") and isinstance(v, dict)
+    ]
+    if not per_rows:
+        errs.append(
+            f"{path}: needs a device-PER megastep row (megastep_per_dp*) — "
+            "the ISSUE-14 zero-transfer-with-PER contract"
+        )
+    for name, row in per_rows:
+        for key in ("steps_per_sec", "transfer_bytes_per_grad_step", "dp",
+                    "per", "steps_per_sec_repeats"):
+            if key not in row:
+                errs.append(f"{path}: {name} missing {key!r}")
+        if row.get("per") is not True:
+            errs.append(f"{path}: {name}.per must be true")
+        if row.get("transfer_bytes_per_grad_step", 1) != 0:
+            errs.append(
+                f"{path}: {name}.transfer_bytes_per_grad_step is "
+                f"{row.get('transfer_bytes_per_grad_step')!r}, must be 0 — "
+                "device-resident PER's zero-transfer contract"
+            )
+    if per_rows and not any(v.get("dp", 1) > 1 for _, v in per_rows):
+        errs.append(f"{path}: no device-PER megastep row with dp > 1")
     ens = doc.get("ensemble_mog_wide")
     if not isinstance(ens, dict):
         errs.append(f"{path}: missing 'ensemble_mog_wide' capacity row")

@@ -169,10 +169,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="where sampled batches live: host = per-dispatch "
                         "H2D batch upload (the seeded oracle); device = "
                         "HBM-resident ring + fused megastep with in-kernel "
-                        "uniform draws and ZERO per-grad-step transfers; "
-                        "hybrid = PER indices/IS-weights from the host "
-                        "sum-tree ([K,B] int32 up, [K,B] priorities back), "
-                        "rows gathered on-device (docs/data_plane.md)")
+                        "draws — uniform AND prioritized (the PER segment "
+                        "tree is device-resident too) — and ZERO "
+                        "per-grad-step transfers; hybrid = LEGACY PER: "
+                        "indices/IS-weights from the host sum-tree ([K,B] "
+                        "int32 up, [K,B] priorities back), kept as the "
+                        "host-tree oracle (docs/data_plane.md)")
+    p.add_argument("--device-tree-backend", choices=["xla", "pallas"],
+                   default="xla",
+                   help="device-PER descent implementation: xla = jnp "
+                        "log-depth gather descent (reference + oracle); "
+                        "pallas = blocked prefix-scan kernel "
+                        "(ops/pallas_tree.py), interpreter-run off-TPU")
     p.add_argument("--prefetch", action="store_true",
                    help="double-buffered replay->device pipeline: batch N+1 "
                         "is host-sampled and its device_put started while "
@@ -356,6 +364,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         prioritized=args.prioritized,
         n_step=args.n_step,
         tree_backend=args.tree_backend,
+        device_tree_backend=args.device_tree_backend,
         transfer_dtype=args.transfer_dtype,
         ring_dtype=args.ring_dtype,
         eval_interval=args.eval_interval,
