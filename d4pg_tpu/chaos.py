@@ -47,6 +47,19 @@ Spec syntax (entries separated by ``;`` or ``,``)::
     her_actor_kill@50     fleet actor: SIGKILL itself on its 50th env
                           step, mid-episode (the buffered HER episode
                           dies with the process; nothing torn ships)
+    variant_kill@6        league controller: at its 6th control tick,
+                          SIGKILL a live variant learner's whole process
+                          group (deterministic victim; supervisor
+                          restarts it under --resume + seeded Backoff)
+    controller_kill@9     league controller: SIGKILL ITSELF at its 9th
+                          control tick (a rerun must resume the SAME
+                          generation from league.json and re-adopt the
+                          still-live learners)
+    clone_corrupt@1       league controller: truncate the newest step of
+                          its 1st checkpoint fork after the copy (the
+                          clone's verify-on-restore must fall back to
+                          the older forked step, never train on torn
+                          state)
 
 A ``:<arg>`` that does not parse as a number is kept as a string LABEL
 (``tenant_flood``'s tenant name); numeric args stay floats.
@@ -121,6 +134,24 @@ site                  tick location               recovery proven
                                                   in-flight frames drop
                                                   whole; supervisor
                                                   restart reconnects
+``variant_kill``      league controller, per      learner group SIGKILLed;
+                      control tick                supervisor restarts it
+                                                  under seeded Backoff
+                                                  (--resume), quarantines
+                                                  a crash-looper
+``controller_kill``   league controller, per      controller SIGKILLs
+                      control tick                ITSELF mid-generation;
+                                                  a rerun resumes the
+                                                  SAME generation from
+                                                  league.json, re-adopts
+                                                  live learners
+``clone_corrupt``     league controller, per      newest forked step
+                      checkpoint fork             truncated post-copy;
+                                                  the clone's verified
+                                                  restore falls back to
+                                                  the older copied step,
+                                                  logged — never trains
+                                                  on torn state
 ====================  ==========================  =========================
 """
 
@@ -175,6 +206,18 @@ KNOWN_SITES = WORKER_SITES + (
     "stale_stats",
     "pixel_truncate",
     "her_actor_kill",
+    # league sites (ISSUE 15, d4pg_tpu/league): variant_kill and
+    # controller_kill tick once per controller supervision tick —
+    # variant_kill SIGKILLs a deterministically-chosen live learner's
+    # whole process group (supervisor restart under Backoff proves it),
+    # controller_kill SIGKILLs the CONTROLLER itself (the journal-resume
+    # proof: a rerun re-adopts learners and resumes the same generation);
+    # clone_corrupt ticks per checkpoint fork and truncates the newest
+    # forked step AFTER its manifest landed (the clone's
+    # verify-on-restore must fall back, never train on torn state).
+    "variant_kill",
+    "controller_kill",
+    "clone_corrupt",
 )
 
 # Sites whose ``:<arg>`` is a string label, not a number (the flood's

@@ -136,6 +136,16 @@ class TrainConfig:
     # Bounded ingest admission queue (frames): past it the ingest answers
     # OVERLOADED(queue_full) — the serve batcher's explicit-shed contract.
     fleet_queue_limit: int = 64
+    # League identity (ISSUE 15, d4pg_tpu/league): which population member
+    # this learner IS and which league generation spawned/forked it. None
+    # = not a league run (no columns added). When set: stamped onto every
+    # metrics.jsonl row (numeric — the MetricsLogger contract), into
+    # trainer_meta.json (the controller's fork-resume ATTESTATION: a clone
+    # that checkpoints under its own variant_id proves it resumed and
+    # progressed, not restarted from scratch), and into the fleet HELLO
+    # capability vector (actors assigned to another variant are refused).
+    variant_id: Optional[int] = None
+    league_generation: int = 0
     # Where host-env collection/eval forwards run: "cpu" jits the actor on
     # the host CPU backend against published numpy params, "default" uses
     # the accelerator, "auto" picks cpu whenever the default backend is an

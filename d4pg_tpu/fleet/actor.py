@@ -431,6 +431,7 @@ class FleetActor:
         actor_id: Optional[str] = None,
         her: bool = False,
         her_k: int = 4,
+        variant: int = 0,
     ):
         host, _, port = connect.rpartition(":")
         if not host or not port.isdigit():
@@ -463,6 +464,12 @@ class FleetActor:
         self.actor_id = actor_id or f"{self.env_id}-actor"
         self.her = bool(her)
         self.her_k = int(her_k)
+        # League variant assignment (ISSUE 15): declared in the HELLO
+        # caps; the ingest refuses a mismatch (variant_mismatch), and for
+        # a non-default assignment the HELLO_OK echo is verified too — a
+        # mis-wired port (a pre-variant learner behind it) must fail
+        # loudly, not silently feed the wrong population member.
+        self.variant = int(variant)
         self._rng = np.random.default_rng(seed)
         self.spool = _Spool(spool_limit)
         self.spool.generation = self.policy.generation
@@ -606,6 +613,7 @@ class FleetActor:
                 obs_modes=obs_modes,
                 her=self.her,
                 obs_norm=self.policy.has_obs_norm,
+                variant=self.variant,
             ),
         )
 
@@ -617,13 +625,28 @@ class FleetActor:
             self.her
             or self.policy.has_obs_norm
             or self.policy.pixel_shape is not None
+            or self.variant != 0
         ):
             raise RuntimeError(
                 "ingest server does not speak capability negotiation "
                 "(pre-ISSUE-13 learner) but this actor needs it "
                 f"(her={self.her}, obs_norm={self.policy.has_obs_norm}, "
-                f"pixel={self.policy.pixel_shape is not None})"
+                f"pixel={self.policy.pixel_shape is not None}, "
+                f"variant={self.variant})"
             )
+        if self.variant != 0 and link.caps is not None:
+            echoed = int(link.caps.get("variant", 0))
+            if echoed != self.variant:
+                # Config skew, fatal and unretried: the port answers but a
+                # DIFFERENT league variant is behind it (a pre-variant
+                # learner echoes 0). Streaming on would contaminate that
+                # variant's replay with another policy's experience.
+                raise RuntimeError(
+                    f"ingest server is league variant {echoed}, this "
+                    f"actor is assigned variant {self.variant} — wrong "
+                    "port (the league controller re-points actors when "
+                    "a slot's variant is replaced)"
+                )
 
     def _ensure_link(self) -> bool:
         """Connected, or ONE non-blocking paced reconnect attempt under the
@@ -1024,6 +1047,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "(negotiated at HELLO)")
     p.add_argument("--her-k", type=int, default=4,
                    help="relabeled copies per episode (HER 'future' k)")
+    p.add_argument("--variant", type=int, default=0,
+                   help="league variant id this host is ASSIGNED to "
+                        "(d4pg_tpu/league): declared in the HELLO caps "
+                        "and exact-matched against the learner's — a "
+                        "mismatch (or a pre-variant learner behind the "
+                        "port, for a non-zero assignment) is refused. "
+                        "0 = default/pre-league variant")
     p.add_argument("--chaos", default=None, metavar="PLAN",
                    help="deterministic fault injection (d4pg_tpu/chaos.py): "
                         "actor-side sites reconnect_flap@N, stale_bundle@N, "
@@ -1055,6 +1085,7 @@ def main(argv=None) -> int:
         chaos=chaos,
         her=args.her,
         her_k=args.her_k,
+        variant=args.variant,
     )
     from d4pg_tpu.utils.signals import install_graceful_signals
 

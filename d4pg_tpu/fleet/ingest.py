@@ -137,12 +137,14 @@ class IngestServer:
         self.max_gen_lag = int(max_gen_lag)
         self.max_inflight = int(max_inflight)
         # What the learner's replay REQUIRES of actors (ISSUE 13): obs
-        # wire mode, actor-side HER, generation-tagged obs-norm stats.
-        # None = the pre-capability default (f32, no HER, no stats) —
-        # byte-identical v1 behavior.
+        # wire mode, actor-side HER, generation-tagged obs-norm stats —
+        # and (ISSUE 15) the league variant id this learner IS.
+        # None = the pre-capability default (f32, no HER, no stats,
+        # variant 0) — byte-identical v1 behavior.
         self.caps = dict(caps) if caps is not None else {
             "obs_mode": "f32", "her": False, "obs_norm": False,
         }
+        self.caps.setdefault("variant", 0)
         # The ingest writer is the single statistics writer in fleet-fed
         # obs-norm runs (the seam's obs_norm_fleet_single_writer gap
         # guarantees no local collector races this): stats fold once per

@@ -197,13 +197,17 @@ def encode_hello(
         "generation": int(generation),
     }
     if caps is not None:
-        # {wire, obs_modes, her, obs_norm} — absent for pre-ISSUE-13
-        # actors, which negotiate as LEGACY_ACTOR_CAPS server-side.
+        # {wire, obs_modes, her, obs_norm, variant} — absent for
+        # pre-ISSUE-13 actors, which negotiate as LEGACY_ACTOR_CAPS
+        # server-side. ``variant`` (ISSUE 15) is the league variant this
+        # host is ASSIGNED to; 0 = the default/pre-league variant, so
+        # pre-variant actors can only ever feed a default-variant learner.
         doc["caps"] = {
             "wire": int(caps.get("wire", 2)),
             "obs_modes": [str(m) for m in caps.get("obs_modes", ("f32",))],
             "her": bool(caps.get("her", False)),
             "obs_norm": bool(caps.get("obs_norm", False)),
+            "variant": int(caps.get("variant", 0)),
         }
     return json.dumps(doc).encode()
 
@@ -220,13 +224,16 @@ def decode_hello(payload: bytes) -> dict:
         doc["generation"] = int(doc.get("generation", 0))
         caps = doc.get("caps")
         if caps is not None:
-            # same single-coercion-point contract as the numerics above
+            # same single-coercion-point contract as the numerics above;
+            # variant defaults 0 so an ISSUE-13 actor (caps without the
+            # key) negotiates as the default variant
             doc["caps"] = {
                 "wire": int(caps.get("wire", 2)),
                 "obs_modes": [str(m) for m in (caps.get("obs_modes")
                                                or ["f32"])],
                 "her": bool(caps.get("her", False)),
                 "obs_norm": bool(caps.get("obs_norm", False)),
+                "variant": int(caps.get("variant", 0)),
             }
         return doc
     except (ValueError, KeyError, TypeError, AttributeError,
@@ -251,10 +258,13 @@ def encode_hello_ok(
         # Only present when the actor negotiated (sent caps): a caps-less
         # v1 HELLO gets this reply WITHOUT the keys below — byte-identical
         # to the pre-ISSUE-13 HELLO_OK (the compat regression pins it).
+        # ``variant`` echoes the learner's variant id so a league-assigned
+        # actor can refuse a mis-wired port (wrong learner behind it).
         doc["caps"] = {
             "obs_mode": str(caps.get("obs_mode", "f32")),
             "her": bool(caps.get("her", False)),
             "obs_norm": bool(caps.get("obs_norm", False)),
+            "variant": int(caps.get("variant", 0)),
         }
         doc["stats_generation"] = int(stats_generation or 0)
     return json.dumps(doc).encode()
@@ -271,6 +281,7 @@ def decode_hello_ok(payload: bytes) -> dict:
                 "obs_mode": str(caps.get("obs_mode", "f32")),
                 "her": bool(caps.get("her", False)),
                 "obs_norm": bool(caps.get("obs_norm", False)),
+                "variant": int(caps.get("variant", 0)),
             }
             doc["stats_generation"] = int(doc.get("stats_generation", 0))
         return doc

@@ -1,0 +1,26 @@
+"""League training (ISSUE 15): a crash-consistent PBT controller.
+
+``python -m d4pg_tpu.league`` supervises N variant learners — each its
+own run dir, fleet port, and hyperparameter genome — and runs seeded
+exploit/explore over them: kill the worst quartile (SIGTERM drain →
+bounded group SIGKILL, the exit-75 contract), clone the best via a
+manifest-verified checkpoint FORK, perturb the genome, restart under
+``--resume``, and gate the clone through an observe→promote|rollback
+window (the canary state-machine shape). The controller itself journals
+every durable decision to an atomically-written ``league.json`` so a
+kill -9 mid-generation restarts, re-adopts still-live learners, and
+never double-books a generation. Provably JAX-free (HOST_ONLY_MODULES).
+
+See docs/league.md.
+"""
+
+from d4pg_tpu._lazy import lazy_exports
+
+__getattr__, __dir__ = lazy_exports(
+    __name__,
+    {
+        "LeagueController": "d4pg_tpu.league.controller",
+        "LeagueConfig": "d4pg_tpu.league.controller",
+        "perturb_genome": "d4pg_tpu.league.controller",
+    },
+)

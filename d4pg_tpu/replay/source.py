@@ -112,6 +112,11 @@ class RequestedCaps:
     chaos: bool = False
     batch_size: int = 256
     replay_capacity: Optional[int] = None
+    # League variant id (ISSUE 15): which population member this learner
+    # IS. 0 = the default/pre-league variant; the fleet HELLO negotiates
+    # it so an actor host assigned to variant A can never stream into
+    # variant B's replay (silent cross-variant contamination).
+    variant: int = 0
     # None = not yet known (train.py validates before the env exists;
     # the Trainer re-validates after, with the env kind resolved).
     is_jax_env: Optional[bool] = None
@@ -142,6 +147,7 @@ def from_train_config(config, *, on_device: bool = False,
         chaos=bool(config.chaos),
         batch_size=int(config.batch_size),
         replay_capacity=config.replay_capacity,
+        variant=int(getattr(config, "variant_id", None) or 0),
         is_jax_env=is_jax_env,
     )
 
@@ -408,13 +414,17 @@ def validate_train_config(config, *, on_device: bool = False,
 
 # ------------------------------------------------------------ fleet HELLO
 # What a pre-ISSUE-13 actor implicitly declares: v1 wire, plain f32
-# windows, no actor-side HER, no stats tagging. A HELLO without a "caps"
-# key negotiates as this.
+# windows, no actor-side HER, no stats tagging — and (ISSUE 15) variant 0,
+# the default/pre-league variant, so a pre-variant actor negotiates
+# byte-compatibly against a default learner and is REFUSED by any league
+# variant learner (it cannot know which population member it feeds). A
+# HELLO without a "caps" key negotiates as this.
 LEGACY_ACTOR_CAPS = {
     "wire": 1,
     "obs_modes": ["f32"],
     "her": False,
     "obs_norm": False,
+    "variant": 0,
 }
 
 
@@ -431,6 +441,7 @@ def learner_fleet_caps(caps: RequestedCaps) -> dict:
         "obs_mode": obs_mode,
         "her": caps.her,
         "obs_norm": caps.obs_norm,
+        "variant": int(caps.variant),
     }
 
 
@@ -465,6 +476,19 @@ def negotiate_fleet(learner: dict, actor: dict
             "actor ships hindsight-relabeled windows but the learner "
             "did not ask for HER (drop the actor's --her)",
         ))
+    learner_variant = int(learner.get("variant", 0))
+    actor_variant = int(actor.get("variant", 0))
+    if learner_variant != actor_variant:
+        # League assignment is an exact-match capability: windows from a
+        # host assigned to another variant (or to none — pre-variant
+        # actors declare 0) would silently train the wrong population
+        # member on the wrong policy's experience.
+        gaps.append(CapabilityGap(
+            "variant_mismatch",
+            f"learner is league variant {learner_variant}, actor is "
+            f"assigned variant {actor_variant} (re-point the actor host "
+            "at its assigned variant's ingest port)",
+        ))
     actor_norm = bool(actor.get("obs_norm", False))
     if learner["obs_norm"] and not actor_norm:
         gaps.append(CapabilityGap(
@@ -486,6 +510,7 @@ def negotiate_fleet(learner: dict, actor: dict
             "obs_mode": want_mode,
             "her": learner["her"],
             "obs_norm": learner["obs_norm"],
+            "variant": learner_variant,
         },
         (),
     )

@@ -26,7 +26,6 @@ fatal — those files are atomically replaced and strictly newer.
 
 from __future__ import annotations
 
-import hashlib
 import json
 import os
 import shutil
@@ -36,38 +35,15 @@ import jax
 import orbax.checkpoint as ocp
 
 from d4pg_tpu.agent.state import TrainState
+from d4pg_tpu.runtime import manifest as _manifest
 
-
-# Side files (trainer_meta.json, replay.npz) above this size are recorded
-# size-only in the manifest: their mismatch is warn-only at restore, so a
-# full read-back of a multi-GB replay snapshot per checkpoint would buy a
-# log line at real learner-stall cost. Orbax step files (which GATE the
-# restore) are always content-hashed.
-SIDE_DIGEST_MAX_BYTES = 16 << 20
-
-
-def _sha256_file(path: str, chunk: int = 1 << 20) -> str:
-    h = hashlib.sha256()
-    with open(path, "rb") as f:
-        while True:
-            b = f.read(chunk)
-            if not b:
-                break
-            h.update(b)
-    return h.hexdigest()
-
-
-def _dir_digests(root: str) -> dict:
-    """``relpath -> {sha256, size}`` for every file under ``root``,
-    deterministic order."""
-    out: dict = {}
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames.sort()
-        for fn in sorted(filenames):
-            p = os.path.join(dirpath, fn)
-            rel = os.path.relpath(p, root).replace(os.sep, "/")
-            out[rel] = {"sha256": _sha256_file(p), "size": os.path.getsize(p)}
-    return out
+# Re-exported for callers that imported it from here (the pure manifest
+# machinery — hashing, build/verify, fork — lives JAX-free in
+# runtime/manifest.py since ISSUE 15 so the league controller and the
+# stub learners can speak the commit-record contract without Orbax).
+SIDE_DIGEST_MAX_BYTES = _manifest.SIDE_DIGEST_MAX_BYTES
+_sha256_file = _manifest.sha256_file
+_dir_digests = _manifest.dir_digests
 
 
 class CheckpointManager:
@@ -128,23 +104,13 @@ class CheckpointManager:
 
     # ----------------------------------------------------- crash consistency
     def manifest_path(self, step: int) -> str:
-        return os.path.join(self.directory, f"manifest_{step}.json")
+        return _manifest.manifest_path(self.directory, step)
 
     def step_dir(self, step: int) -> Optional[str]:
         """The Orbax step directory for ``step`` (the default layout is
         ``<directory>/<step>``; fall back to scanning for prefixed or
         zero-padded layouts)."""
-        d = os.path.join(self.directory, str(step))
-        if os.path.isdir(d):
-            return d
-        for name in sorted(os.listdir(self.directory)):
-            full = os.path.join(self.directory, name)
-            if not os.path.isdir(full):
-                continue
-            digits = "".join(ch for ch in name if ch.isdigit())
-            if digits and int(digits) == step:
-                return full
-        return None
+        return _manifest.default_step_dir(self.directory, step)
 
     def write_manifest(self, step: int, side_files: Optional[list] = None) -> str:
         """Write the commit record for ``step``: digests of the finalized
@@ -159,50 +125,21 @@ class CheckpointManager:
             raise FileNotFoundError(
                 f"no Orbax step directory for step {step} under {self.directory}"
             )
-        manifest = {
-            "step": step,
-            "files": _dir_digests(step_dir),
-            "side": {},
-        }
-        for p in side_files or []:
-            if os.path.exists(p):
-                size = os.path.getsize(p)
-                entry = {"size": size}
-                # Side mismatches are warn-only at restore (drift, not
-                # corruption), so a full read-back of a multi-GB replay
-                # snapshot per save buys nothing — hash only small side
-                # files (the meta), record size alone for the big ones.
-                if size <= SIDE_DIGEST_MAX_BYTES:
-                    entry["sha256"] = _sha256_file(p)
-                manifest["side"][os.path.basename(p)] = entry
-        path = self.manifest_path(step)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(manifest, f)
-        os.replace(tmp, path)
+        path = _manifest.write_manifest_file(
+            self.manifest_path(step),
+            _manifest.build_manifest(step, step_dir, side_files),
+        )
         live = set(self._mgr.all_steps())
-        for name in os.listdir(self.directory):
-            if name.startswith("manifest_") and name.endswith(".json"):
+        for s in _manifest.manifest_steps(self.directory):
+            if s not in live:
                 try:
-                    s = int(name[len("manifest_"):-len(".json")])
-                except ValueError:
-                    continue
-                if s not in live:
-                    try:
-                        os.remove(os.path.join(self.directory, name))
-                    except FileNotFoundError:
-                        pass
+                    os.remove(self.manifest_path(s))
+                except FileNotFoundError:
+                    pass
         return path
 
     def load_manifest(self, step: int) -> Optional[dict]:
-        try:
-            with open(self.manifest_path(step)) as f:
-                return json.load(f)
-        except FileNotFoundError:
-            return None
-        except (OSError, ValueError) as e:
-            print(f"[checkpoint] unreadable manifest for step {step}: {e}")
-            return None
+        return _manifest.load_manifest(self.directory, step)
 
     def verify_step(self, step: int) -> tuple:
         """``(ok, why, side_warnings)``: digest-check the step's Orbax files
@@ -210,46 +147,9 @@ class CheckpointManager:
         committed). Side-file mismatches come back as warnings, not
         failures — meta/replay are atomically replaced and may legitimately
         postdate the step by one crashed save."""
-        m = self.load_manifest(step)
-        if m is None:
-            return False, "no manifest (save did not commit)", []
-        step_dir = self.step_dir(step)
-        if step_dir is None:
-            return False, "manifest exists but step directory is gone", []
-        for rel, want in m.get("files", {}).items():
-            p = os.path.join(step_dir, rel)
-            if not os.path.exists(p):
-                return False, f"missing file {rel}", []
-            if os.path.getsize(p) != want["size"]:
-                return (
-                    False,
-                    f"{rel}: size {os.path.getsize(p)} != {want['size']} "
-                    "(truncated?)",
-                    [],
-                )
-            if _sha256_file(p) != want["sha256"]:
-                return False, f"{rel}: content digest mismatch", []
-        warnings = []
-        ckpt_parent = os.path.dirname(self.directory)
-        for base, want in m.get("side", {}).items():
-            for cand in (
-                os.path.join(self.directory, base),
-                os.path.join(ckpt_parent, base),
-            ):
-                if os.path.exists(cand):
-                    if os.path.getsize(cand) != want["size"] or (
-                        "sha256" in want
-                        and _sha256_file(cand) != want["sha256"]
-                    ):
-                        warnings.append(
-                            f"{base} differs from the step-{step} manifest "
-                            "(a newer save's side file; proceeding with the "
-                            "current one)"
-                        )
-                    break
-            else:
-                warnings.append(f"side file {base} is missing")
-        return True, "ok", warnings
+        return _manifest.verify_step_dir(
+            self.directory, step, self.step_dir(step)
+        )
 
     def restore_verified(self, template: TrainState) -> tuple:
         """Restore the newest INTACT step: ``(state, step, fallbacks)``.

@@ -33,8 +33,17 @@ def interval_crossed(prev_step: int, step: int, interval: int) -> bool:
 
 
 class MetricsLogger:
-    def __init__(self, log_dir: str, use_tensorboard: bool = True):
+    """``static`` (ISSUE 15): numeric identity columns stamped onto EVERY
+    row — e.g. the league's ``variant_id``/``league_generation`` (the
+    serve replica's ``replica_id`` precedent, centralized). Values must be
+    numeric (the rows-are-numeric contract ``schema_check`` enforces);
+    they ride the JSONL rows only, not TensorBoard (a constant per-step
+    scalar chart is noise)."""
+
+    def __init__(self, log_dir: str, use_tensorboard: bool = True,
+                 static: Mapping[str, float] = None):
         self.log_dir = log_dir
+        self._static = {k: float(v) for k, v in (static or {}).items()}
         os.makedirs(log_dir, exist_ok=True)
         self._jsonl = open(os.path.join(log_dir, "metrics.jsonl"), "a")
         self._tb = None
@@ -79,6 +88,7 @@ class MetricsLogger:
         if timers is not None:
             merged.update(timers.scalars())
         rec = {"step": int(step), "t": time.monotonic() - self._t0}
+        rec.update(self._static)
         rec.update(merged)
         with self._log_lock:
             self._jsonl.write(json.dumps(rec) + "\n")

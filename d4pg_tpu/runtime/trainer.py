@@ -577,7 +577,20 @@ class Trainer:
             if hasattr(self.buffer, "set_ledger"):
                 self.buffer.set_ledger(self._ledger)
 
-        self.metrics = MetricsLogger(config.log_dir)
+        # League identity columns (ISSUE 15): stamped onto EVERY row so a
+        # league run's metrics are attributable per variant per generation
+        # (numeric, the MetricsLogger contract; absent outside leagues).
+        self.metrics = MetricsLogger(
+            config.log_dir,
+            static=(
+                {
+                    "variant_id": float(config.variant_id),
+                    "league_generation": float(config.league_generation),
+                }
+                if config.variant_id is not None
+                else None
+            ),
+        )
         # Per-stage data-plane wall-time counters (env-step / replay-insert
         # / sample / H2D-stage / train-dispatch / priority-write-back),
         # shared by every thread and appended to each metrics.jsonl row —
@@ -2199,10 +2212,18 @@ class Trainer:
             extra["obs_norm"] = self.obs_norm.state_dict()
         if self._fleet is not None:
             # The bundle generation must survive --resume: restarting at 0
-            # would regress below generations connected actors already
-            # hold, disarming the stale-window drop until the counter
+            # would regress below generations actors already hold,
+            # disarming the stale-window drop until the counter
             # catches back up.
             extra["fleet_generation"] = self._fleet_gen
+        if self.config.variant_id is not None:
+            # The league controller's fork-resume ATTESTATION: a clone
+            # that checkpoints under its OWN variant id (with the parent's
+            # restored counters) proves the forked checkpoint restored and
+            # training progressed — trainer_meta still carrying the
+            # parent's id means the clone never committed a save.
+            extra["variant_id"] = int(self.config.variant_id)
+            extra["league_generation"] = int(self.config.league_generation)
         save_trainer_meta(
             self.config.log_dir,
             self.env_steps,

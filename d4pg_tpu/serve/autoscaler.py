@@ -57,6 +57,7 @@ from dataclasses import dataclass
 from typing import Callable, Optional
 
 from d4pg_tpu.analysis import lockwitness
+from d4pg_tpu.utils import procs
 
 
 @dataclass
@@ -420,12 +421,13 @@ class RouterReplicaPool:
             # report failure — the autoscaler's cooldown (recorded at the
             # attempt, success or not) paces a crash-looping spawn storm,
             # and the rmtree keeps it from growing disk per retry
-            try:
-                handle.proc.kill()
-                handle.proc.wait(timeout=10)
-            except Exception as e:
-                print(f"[autoscaler] failed-spawn reap error: {e}",
-                      flush=True)
+            import signal as _signal
+
+            procs.drain_or_kill(
+                handle.proc, pgid=getattr(handle, "pgid", 0),
+                sig=_signal.SIGKILL, drain_timeout_s=10.0,
+                label="failed-spawn replica",
+            )
             shutil.rmtree(bundle_dir, ignore_errors=True)
             return False
         idx = self._router.add_backend("127.0.0.1", port, bundle_dir)
@@ -450,26 +452,17 @@ class RouterReplicaPool:
         # so no NEW request can land on it and shed OVERLOADED(draining)
         # during the window before a probe would have noticed. Only then
         # SIGTERM — drain, don't kill: the replica still answers
-        # everything it had admitted and exits 0.
+        # everything it had admitted and exits 0. The bounded
+        # drain→group-kill escalation is procs.drain_or_kill, once for
+        # the whole repo (ISSUE 15 dedup).
         self._router.remove_backend(idx)
-        try:
-            handle.proc.send_signal(_signal.SIGTERM)
-            rc = handle.proc.wait(timeout=self._drain_timeout_s)
-        except Exception as e:  # timeout or already-dead: escalate below
-            print(f"[autoscaler] replica {idx} drain error: {e!r}",
-                  flush=True)
-            rc = None
-        if rc is None:
-            # drain wedged past the bound: escalate loudly (the one
-            # permitted kill — a wedged replica would leak forever)
-            print(f"[autoscaler] replica {idx} drain timed out; killing",
-                  flush=True)
-            try:
-                handle.proc.kill()
-                handle.proc.wait(timeout=10)
-            except Exception as e:
-                print(f"[autoscaler] kill-after-timeout error: {e}",
-                      flush=True)
+        rc = procs.drain_or_kill(
+            handle.proc, pgid=getattr(handle, "pgid", 0),
+            sig=_signal.SIGTERM, drain_timeout_s=self._drain_timeout_s,
+            label=f"replica {idx}",
+        )
+        if rc not in (0, None):
+            print(f"[autoscaler] replica {idx} drained rc={rc}", flush=True)
         return True
 
     def count(self) -> int:
@@ -527,16 +520,11 @@ class ActorHostPool:
             if not self._spawned:
                 return False
             handle = self._spawned.pop()
-        try:
-            handle.proc.send_signal(_signal.SIGTERM)
-            handle.proc.wait(timeout=self._drain_timeout_s)
-        except Exception:
-            print("[autoscaler] actor drain timed out; killing", flush=True)
-            try:
-                handle.proc.kill()
-                handle.proc.wait(timeout=10)
-            except Exception as e:
-                print(f"[autoscaler] actor kill error: {e}", flush=True)
+        procs.drain_or_kill(
+            handle.proc, pgid=getattr(handle, "pgid", 0),
+            sig=_signal.SIGTERM, drain_timeout_s=self._drain_timeout_s,
+            label="actor host",
+        )
         return True
 
     def count(self) -> int:

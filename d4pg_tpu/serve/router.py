@@ -2166,24 +2166,12 @@ def build_parser():
 def _load_spawnlib():
     """Import ``scripts/spawnlib.py`` (the shared CLI subprocess harness)
     from the repo checkout this package runs out of."""
-    import importlib.util
-    import os
+    from d4pg_tpu.utils.procs import load_spawnlib
 
-    path = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__)
-        ))),
-        "scripts", "spawnlib.py",
-    )
-    if not os.path.exists(path):
-        raise SystemExit(
-            f"--autoscale needs scripts/spawnlib.py (looked at {path}); "
-            "run from a repo checkout"
-        )
-    spec = importlib.util.spec_from_file_location("spawnlib", path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
+    try:
+        return load_spawnlib()
+    except RuntimeError as e:
+        raise SystemExit(f"--autoscale: {e}")
 
 
 def main(argv=None) -> None:

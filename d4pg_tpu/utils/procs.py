@@ -1,0 +1,202 @@
+"""Process-group lifecycle helpers: setsid spawn, bounded drain, orphan sweep.
+
+Every place this repo manages learner/replica/actor SUBPROCESSES needs the
+same three disciplines, and before ISSUE 15 each had grown its own copy
+(the autoscaler's two pools, the soak/smoke heredocs):
+
+- **own session per child** (``start_new_session=True``): a learner spawns
+  its own actor-pool workers; killing only the leader leaks the workers.
+  With the child as a session/group leader, ``killpg`` reaps the whole
+  tree — and the child survives *our* death (the league controller's
+  re-adopt-after-kill-9 contract depends on exactly that).
+- **bounded drain, then group-kill**: SIGTERM first (the repo-wide
+  graceful contract: checkpoint + exit 75, serve drain + exit 0), wait a
+  bounded time on ``time.monotonic``, then SIGKILL the *group* — never an
+  unbounded ``wait()``, never a leader-only kill.
+- **orphan sweep**: after any kill path, verify the group is actually
+  empty (``/proc`` scan) and SIGKILL stragglers. "Zero orphaned learner
+  processes" is an asserted contract, not a hope.
+
+Deliberately stdlib-only (no numpy/jax): imported by the league
+controller, the serve autoscaler, and ``scripts/spawnlib.py`` — all
+host-only modules.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import List, Optional
+
+
+def load_spawnlib():
+    """Import ``scripts/spawnlib.py`` (the shared CLI subprocess harness)
+    by file path — scripts/ is not a package, and the repo checkout is
+    the deployment unit for the process-spawning CLIs (the router's
+    autoscaler, the league controller). Raises with the looked-at path
+    when the checkout is incomplete."""
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+        "scripts", "spawnlib.py",
+    )
+    if not os.path.exists(path):
+        raise RuntimeError(
+            f"scripts/spawnlib.py not found (looked at {path}); process "
+            "spawning needs the full repo checkout"
+        )
+    spec = importlib.util.spec_from_file_location("spawnlib", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def pid_alive(pid: int) -> bool:
+    """True while ``pid`` exists (including as a zombie we cannot reap —
+    callers that own the child should poll()/wait() it as well)."""
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+def pid_cmdline(pid: int) -> str:
+    """The process's argv as one NUL→space string ('' when gone/unreadable).
+    Linux ``/proc`` — the league controller uses this to make re-adoption
+    of a journaled PID safe against PID reuse (the cmdline must still name
+    the variant's run dir)."""
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            return f.read().replace(b"\0", b" ").decode(errors="replace").strip()
+    except OSError:
+        return ""
+
+
+def group_pids(pgid: int) -> List[int]:
+    """Every live PID in process group ``pgid`` (/proc scan; [] off-Linux).
+
+    Cold-path only (kill escalation, orphan sweeps) — a full /proc walk
+    per call is fine there and keeps this dependency-free.
+    """
+    pids: List[int] = []
+    try:
+        entries = os.listdir("/proc")
+    except OSError:
+        return pids
+    for name in entries:
+        if not name.isdigit():
+            continue
+        pid = int(name)
+        try:
+            if os.getpgid(pid) == pgid:
+                pids.append(pid)
+        except (ProcessLookupError, PermissionError, OSError):
+            continue
+    return pids
+
+
+def kill_group(pgid: int, sig: int = signal.SIGKILL) -> bool:
+    """Signal the whole group; False when it is already gone."""
+    if pgid <= 0:
+        return False
+    try:
+        os.killpg(pgid, sig)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+def wait_pid_gone(pid: int, timeout_s: float, *, proc=None,
+                  poll_s: float = 0.05) -> bool:
+    """Wait (monotonic-bounded) until ``pid`` is gone. When ``proc`` (a
+    ``subprocess.Popen``) is given it is polled too, so our own children
+    are reaped instead of lingering as zombies that keep pid_alive true."""
+    deadline = time.monotonic() + max(0.0, timeout_s)
+    while True:
+        if proc is not None and proc.poll() is not None:
+            return True
+        if not pid_alive(pid):
+            return True
+        if time.monotonic() >= deadline:
+            return False
+        time.sleep(poll_s)
+
+
+def drain_or_kill(
+    proc,
+    *,
+    pgid: Optional[int] = None,
+    sig: int = signal.SIGTERM,
+    drain_timeout_s: float = 120.0,
+    kill_timeout_s: float = 10.0,
+    label: str = "process",
+) -> Optional[int]:
+    """THE bounded stop escalation, once: ``sig`` (graceful drain) →
+    bounded wait → SIGKILL the whole group (falling back to the leader
+    when no group is known) → bounded reap. Returns the exit code, or
+    ``None`` when even the kill wait expired (the caller should log and
+    sweep). Replaces the three copy-pasted variants the autoscaler pools
+    and the soak/smoke harnesses grew (ISSUE 15 satellite)."""
+    rc = proc.poll()
+    if rc is not None:
+        if pgid:
+            reap_orphans([pgid], label=label)
+        return rc
+    try:
+        proc.send_signal(sig)
+    except (ProcessLookupError, OSError):
+        pass
+    if wait_pid_gone(proc.pid, drain_timeout_s, proc=proc):
+        rc = proc.poll()
+        if pgid:
+            # the leader drained; sweep any children it failed to take down
+            reap_orphans([pgid], label=label)
+        return rc
+    print(f"[procs] {label} (pid {proc.pid}) ignored signal {sig} for "
+          f"{drain_timeout_s:.0f}s; killing the group", flush=True)
+    if pgid:
+        kill_group(pgid, signal.SIGKILL)
+    try:
+        proc.kill()
+    except (ProcessLookupError, OSError):
+        pass
+    if not wait_pid_gone(proc.pid, kill_timeout_s, proc=proc):
+        print(f"[procs] {label} (pid {proc.pid}) survived SIGKILL "
+              f"{kill_timeout_s:.0f}s (D-state?)", flush=True)
+        return None
+    if pgid:
+        reap_orphans([pgid], label=label)
+    return proc.poll()
+
+
+def reap_orphans(pgids, *, label: str = "group",
+                 kill_timeout_s: float = 5.0) -> List[int]:
+    """SIGKILL every surviving member of the given process groups and
+    return the PIDs that were still alive (the sweep's finding — callers
+    assert it empty where 'zero orphans' is a contract). Idempotent and
+    safe on long-gone groups."""
+    found: List[int] = []
+    for pgid in pgids:
+        if not pgid or pgid <= 0:
+            continue
+        survivors = group_pids(pgid)
+        if not survivors:
+            continue
+        found.extend(survivors)
+        print(f"[procs] orphan sweep: {label} pgid {pgid} still has "
+              f"{survivors}; SIGKILLing the group", flush=True)
+        kill_group(pgid, signal.SIGKILL)
+        deadline = time.monotonic() + kill_timeout_s
+        while group_pids(pgid) and time.monotonic() < deadline:
+            time.sleep(0.05)
+    return found

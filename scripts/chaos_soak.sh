@@ -37,7 +37,17 @@
 #      stale-stats windows out with an honest count), pixel_truncate
 #      (torn WINDOWS2 frame whole-drops), and her_actor_kill (SIGKILL
 #      mid-episode; the restart reconnects) — learner rc 0 with guards
-#      green and the at-most-once accounting identity exact.
+#      green and the at-most-once accounting identity exact;
+#   9. league training (ISSUE 15): a seeded 3-variant PBT league of real
+#      --debug-guards learners (fleet-only, one actor host per variant
+#      pinned by the HELLO variant id) under variant_kill (learner group
+#      SIGKILL → supervised restart), clone_corrupt (torn checkpoint
+#      fork → verified-restore fallback), and a controller kill -9 the
+#      moment a generation is in flight — the rerun must resume the SAME
+#      generation, promote the planted winner's bloodline, keep every
+#      accounting identity exact (process tenures, actor windows), end
+#      with lockwitness 0 contradictions and zero surviving processes,
+#      and emit the schema-gated league_soak.json artifact.
 #
 # Knobs (env vars): SOAK_DIR (default mktemp), SOAK_ENV (Pendulum-v1),
 # SOAK_STEPS (grad steps per leg, default 6), SOAK_HIDDEN (16,16),
@@ -386,12 +396,13 @@ assert h0["compile_count"] == 4 and h1["compile_count"] == 4, (h0, h1)
 assert h0["replica_id"] == 0 and h1["replica_id"] == 1
 
 # ---- graceful drains: rc 0 = sentinel bucket budgets + guards clean --------
-router.proc.send_signal(signal.SIGTERM)
-rc = router.proc.wait(timeout=120)
+# (spawnlib.Spawned.stop is the one bounded SIGTERM->group-SIGKILL
+# escalation — a drain-deaf process gets reaped instead of hanging the
+# soak in proc.wait)
+rc = router.stop(drain_timeout_s=120)
 assert rc == 0, f"router exit {rc}"
 for rid in (0, 1):
-    reps[rid].proc.send_signal(signal.SIGTERM)
-    rc = reps[rid].proc.wait(timeout=120)
+    rc = reps[rid].stop(drain_timeout_s=120)
     assert rc == 0, f"replica {rid} exit {rc} (guards/sentinel not clean?)"
 
 # metrics attribution: every surviving replica's rows carry ITS replica_id
@@ -626,12 +637,11 @@ for p in ports:
     assert rows["alt"]["params_reloads"] == 0, rows
 
 # graceful drains: rc 0 = sentinel per-policy bucket budgets + guards clean
-router.proc.send_signal(signal.SIGTERM)
-rc = router.proc.wait(timeout=180)
+# (the shared bounded escalation — see leg 6)
+rc = router.stop(drain_timeout_s=180)
 assert rc == 0, f"mt router exit {rc}"
 for rid in (0, 1):
-    reps[rid].proc.send_signal(signal.SIGTERM)
-    rc = reps[rid].proc.wait(timeout=120)
+    rc = reps[rid].stop(drain_timeout_s=120)
     assert rc == 0, f"mt replica {rid} exit {rc} (guards/sentinel not clean?)"
 
 print("CHAOS_SOAK_MT_OK", json.dumps({
@@ -756,5 +766,133 @@ print("CHAOS_SOAK_LEG8_OK", {
                                  "windows_dropped_reconnect")},
 })
 EOF
+
+# ---- leg 9: league training — PBT over REAL learners under chaos (ISSUE 15)
+# A seeded 3-variant league of real train.py learners (--debug-guards,
+# fleet-only: each variant its own ingest port + one actor host pinned to
+# its variant id through the HELLO capability vector). Fitness separation
+# is baked into the genomes (the 50-step-horizon variant deterministically
+# out-scores the 200-step ones on Pendulum's all-negative rewards).
+# Chaos: variant_kill (a learner's whole process group SIGKILLed —
+# supervisor restart under seeded Backoff, its actor reconnects),
+# clone_corrupt (the newest FORKED checkpoint step truncated — the
+# clone's verify-on-restore must fall back to the older copied step),
+# and a controller kill -9 MID-GENERATION (event-triggered from here the
+# moment the journal holds pending work — deterministic by construction).
+# Contracts: the rerun resumes the SAME generation (never double-books),
+# re-adopts/restarts learners, promotes the planted winner's bloodline,
+# every drained learner's lockwitness records 0 contradictions, the
+# per-variant process-tenure accounting identity is EXACT (schema-gated
+# summary artifact), every actor's at-most-once window identity is
+# EXACT, and zero learner/actor processes survive the league.
+LEAGUE9_PORT=$((23000 + RANDOM % 10000))
+league9_args=(--seed 7 --generations 1 --poll-interval 0.3
+              --gen-timeout 300 --drain-timeout 90
+              --attest-timeout 240 --observe-timeout 300
+              --fleet-base-port "$LEAGUE9_PORT" --actors-per-variant 1
+              --actor-args "--batch-windows 8 --poll-interval 0.3 --stats-interval 10"
+              --genome 'lr_actor=1e-4,max_episode_steps=50'
+              --genome 'lr_actor=1e-4,max_episode_steps=200'
+              --genome 'lr_actor=3e-3,max_episode_steps=200')
+league9_learner=(python train.py --env Pendulum-v1 --hidden-sizes "$HIDDEN"
+                 --warmup 24 --bsize 8 --rmsize 512
+                 --eval-interval 2 --eval-episodes 1
+                 --checkpoint-interval 4 --total-steps 100000
+                 --snapshot-replay --debug-guards)
+
+python -m d4pg_tpu.league --dir "$DIR/league" "${league9_args[@]}" \
+  --chaos "seed=5;variant_kill@40;clone_corrupt@1" \
+  -- "${league9_learner[@]}" > "$DIR/league9_run1.log" 2>&1 &
+L9CTL=$!
+# kill -9 the controller the moment a generation is IN FLIGHT (pending
+# work journaled): mid-generation by construction, not by tick roulette
+for _ in $(seq 1 3000); do
+  PENDING=$(python -c "
+import json,sys
+try: d=json.load(open('$DIR/league/league.json'))
+except Exception: sys.exit(0)
+print('yes' if d.get('pending') else '')" 2>/dev/null || true)
+  [ "$PENDING" = "yes" ] && break
+  kill -0 "$L9CTL" 2>/dev/null || { cat "$DIR/league9_run1.log"; echo "CHAOS_SOAK_FAIL: league controller died before planning a generation"; exit 1; }
+  sleep 0.2
+done
+[ "$PENDING" = "yes" ] || { cat "$DIR/league9_run1.log"; echo "CHAOS_SOAK_FAIL: no pending generation within the deadline"; exit 1; }
+sleep "0.$((RANDOM % 100))"   # a random instant INSIDE the apply window
+kill -9 "$L9CTL" || true
+wait "$L9CTL" 2>/dev/null || true
+GEN9=$(python -c "import json;print(json.load(open('$DIR/league/league.json'))['generation'])")
+echo "[chaos-soak] killed the league controller mid-generation (gen $GEN9)"
+
+# the rerun: same args (journal-checked), clone_corrupt re-armed so the
+# fork fires truncated whichever side of the crash it lands on
+python -m d4pg_tpu.league --dir "$DIR/league" "${league9_args[@]}" \
+  --chaos "seed=5;clone_corrupt@1" \
+  --summary-out "$DIR/league_soak.json" \
+  -- "${league9_learner[@]}" > "$DIR/league9_run2.log" 2>&1 \
+  || { tail -80 "$DIR/league9_run2.log"; echo "CHAOS_SOAK_FAIL: league rerun exited non-zero"; exit 1; }
+grep -q "journal_resumed" "$DIR/league9_run2.log" \
+  || { echo "CHAOS_SOAK_FAIL: league rerun did not resume the journal"; exit 1; }
+grep -hq "chaos.*variant_kill: SIGKILL" "$DIR/league9_run1.log" "$DIR/league9_run2.log" \
+  || { echo "CHAOS_SOAK_FAIL: variant_kill never fired"; exit 1; }
+grep -hq "truncated" "$DIR/league9_run1.log" "$DIR/league9_run2.log" \
+  || { echo "CHAOS_SOAK_FAIL: clone_corrupt never truncated a fork"; exit 1; }
+
+python - "$DIR" "$GEN9" <<'EOF'
+import ast, json, sys
+d, gen_at_crash = sys.argv[1], int(sys.argv[2])
+logs = open(f"{d}/league9_run1.log").read() + open(f"{d}/league9_run2.log").read()
+s = json.load(open(f"{d}/league_soak.json"))
+# the SAME generation the crash interrupted resumed and committed ONCE
+events = [json.loads(l) for l in open(f"{d}/league/league_events.jsonl")]
+done = [e["gen"] for e in events if e["event"] == "generation_done"]
+assert sorted(set(done)) == done, f"a generation committed twice: {done}"
+assert s["generations_completed"] == 1 and gen_at_crash == 0, (s, gen_at_crash)
+# the planted winner's bloodline won: every fork descends from uid 1
+def root(uid, variants):
+    while variants[str(uid)]["parent"] is not None:
+        uid = variants[str(uid)]["parent"]
+    return uid
+assert s["promotions"] >= 1, s
+assert s["lineage"] and all(
+    root(e["parent"], s["variants"]) == 1 for e in s["lineage"]
+), s["lineage"]
+# the torn fork was never trained on: the clone's verified restore
+# either fell back (fallback logged) or the fork pre-dated the crash
+assert "[checkpoint]" in logs
+# per-variant process-tenure accounting identity, via the schema gate
+sys.path.insert(0, ".")
+from tools.d4pglint.schema_check import check_league_soak
+errs = check_league_soak(f"{d}/league_soak.json")
+assert not errs, errs
+assert s["identity_ok"] is True and s["orphans_swept"] == 0, s
+# every drained learner's lock-order witness: 0 contradictions, and the
+# guards never tripped (non-zero learner exits other than kill/preempt
+# would have broken the identity above)
+assert logs.count("0 contradictions") >= 2, logs.count("0 contradictions")
+# every fleet actor's at-most-once accounting identity is EXACT
+drains = [l for l in logs.splitlines() if "drained:" in l]
+assert drains, "no actor drain accounting lines"
+for line in drains:
+    st = ast.literal_eval(line.split("drained:", 1)[1].strip())
+    acct = (st["windows_acked"] + st["windows_stale"] + st["windows_shed"]
+            + st["windows_dropped_reconnect"] + st["windows_dropped_spool"]
+            + st["spool_depth"])
+    assert acct == st["windows_emitted"], (acct, st)
+print("CHAOS_SOAK_LEAGUE_OK", {
+    "generations": s["generations_completed"],
+    "promotions": s["promotions"], "rollbacks": s["rollbacks"],
+    "restarts": sum(v["restarts"] for v in s["variants"].values()),
+    "chaos_injections": s["chaos_injections"],
+    "actors_drained": len(drains),
+})
+EOF
+
+# zero league processes survive (learners AND actor hosts)
+if pgrep -f "log-dir $DIR/league/v" > /dev/null 2>&1 \
+   || pgrep -f "d4pg_tpu.fleet.actor.*$LEAGUE9_PORT" > /dev/null 2>&1; then
+  echo "CHAOS_SOAK_FAIL: league processes survived the shutdown"
+  pgrep -af "$DIR/league" || true
+  exit 1
+fi
 
 echo "CHAOS_SOAK_OK"

@@ -1,0 +1,101 @@
+#!/usr/bin/env bash
+# League smoke: the PBT controller lifecycle end to end through the REAL
+# CLI (python -m d4pg_tpu.league) — seeded 3-variant league with fitness
+# separation baked into the genomes, one full exploit/explore generation
+# (cull worst → manifest-verified checkpoint fork → perturbed clone →
+# attest → promote), then a controller kill -9 MID-GENERATION (chaos
+# controller_kill) and a rerun that must resume the SAME generation,
+# re-adopt the surviving learners, and finish with zero orphans and the
+# accounting identity exact (league_summary.json is schema-gated).
+#
+# Learners are scripts/league_stub_learner.py — the deterministic
+# train.py stand-in that speaks the league surface (real manifests, real
+# exit-75 drains, real trainer_meta attestation) in milliseconds, which
+# is what keeps this inside the tier-1 60 s clock guard
+# (tests/test_league_smoke.py asserts the budget). The REAL-train.py
+# league runs in chaos_soak.sh leg 9.
+#
+# Knobs (env vars): LEAGUE_SMOKE_DIR (default mktemp).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DIR=${LEAGUE_SMOKE_DIR:-$(mktemp -d /tmp/league_smoke.XXXXXX)}
+mkdir -p "$DIR"
+echo "[league-smoke] dir: $DIR"
+
+league_args=(--seed 7 --poll-interval 0.1 --gen-timeout 60
+             --drain-timeout 20 --attest-timeout 20 --observe-timeout 20
+             --genome 'lr_actor=1e-4,max_episode_steps=50'
+             --genome 'lr_actor=1e-4,max_episode_steps=200'
+             --genome 'lr_actor=1e-3,max_episode_steps=250')
+stub=(python scripts/league_stub_learner.py
+      --checkpoint-interval 4 --eval-interval 2 --tick-seconds 0.03)
+
+# ---- leg 1: kill -9 the controller mid-generation (chaos site), rerun ------
+# controller_kill@6 lands inside the first generation's apply window
+# (plan ~tick 2-3, fork/observe span several ticks).
+set +e
+python -m d4pg_tpu.league --dir "$DIR/league" "${league_args[@]}" \
+  --generations 2 --chaos "seed=5;controller_kill@6" \
+  -- "${stub[@]}" | tee "$DIR/leg1.log"
+RC=${PIPESTATUS[0]}
+set -e
+grep -q "controller_kill: SIGKILL self" "$DIR/leg1.log" \
+  || { echo "LEAGUE_SMOKE_FAIL: controller_kill never fired"; exit 1; }
+[ "$RC" -ne 0 ] || { echo "LEAGUE_SMOKE_FAIL: SIGKILLed controller exited 0"; exit 1; }
+GEN_AT_CRASH=$(python -c "import json;print(json.load(open('$DIR/league/league.json'))['generation'])")
+echo "[league-smoke] controller killed at generation $GEN_AT_CRASH"
+
+# ---- leg 2: the rerun resumes the SAME generation and finishes -------------
+python -m d4pg_tpu.league --dir "$DIR/league" "${league_args[@]}" \
+  --generations 2 \
+  -- "${stub[@]}" | tee "$DIR/leg2.log"
+grep -q "journal_resumed" "$DIR/leg2.log" \
+  || { echo "LEAGUE_SMOKE_FAIL: rerun did not resume the journal"; exit 1; }
+
+# ---- asserts: promotion of the planted winner, identity, zero orphans ------
+python - "$DIR" "$GEN_AT_CRASH" <<'EOF'
+import json, os, sys
+d, gen_at_crash = sys.argv[1], int(sys.argv[2])
+s = json.load(open(f"{d}/league/league_summary.json"))
+assert s["generations_completed"] == 2, s["generations_completed"]
+assert s["promotions"] >= 1, s
+# every clone in the lineage descends from the planted winner (uid 1:
+# lr 1e-4 @ 50-step horizon — the deterministically-best genome)
+def root(uid, variants):
+    while variants[str(uid)]["parent"] is not None:
+        uid = variants[str(uid)]["parent"]
+    return uid
+clones = [e for e in s["lineage"] if e["reason"] == "clone"]
+assert clones and all(root(e["parent"], s["variants"]) == 1 for e in clones), \
+    s["lineage"]
+# the planted winner's bloodline holds the majority of final slots
+final = [root(uid, s["variants"]) for uid in s["members"].values()]
+assert final.count(1) >= 2, (final, s["members"])
+# crash consistency: the rerun resumed the generation the crash left
+# in flight (leg2's journal_resumed) and never double-booked it
+events = [json.loads(l) for l in open(f"{d}/league/league_events.jsonl")]
+done = [e for e in events if e["event"] == "generation_done"]
+gens = [e["gen"] for e in done]
+assert sorted(set(gens)) == gens, f"a generation committed twice: {gens}"
+# accounting identity + zero orphans, via the committed-artifact gate
+sys.path.insert(0, ".")
+from tools.d4pglint.schema_check import check_league_soak
+errs = check_league_soak(f"{d}/league/league_summary.json")
+assert not errs, errs
+assert s["identity_ok"] is True and s["orphans_swept"] == 0
+print("LEAGUE_SMOKE_ASSERTS_OK",
+      {"generations": s["generations_completed"],
+       "promotions": s["promotions"], "rollbacks": s["rollbacks"],
+       "crash_gen": gen_at_crash})
+EOF
+
+# zero orphaned learner processes (the /proc scan the controller also
+# performs at shutdown — belt and suspenders at the script level)
+if pgrep -f "league_stub_learner.*$DIR" > /dev/null 2>&1; then
+  echo "LEAGUE_SMOKE_FAIL: orphaned stub learners survived"
+  pgrep -af "league_stub_learner.*$DIR" || true
+  exit 1
+fi
+
+echo "LEAGUE_SMOKE_OK"

@@ -108,13 +108,13 @@ with PolicyClient("127.0.0.1", rport) as c:
     assert h["requests_total"] == h["answered_total"] == 24, h
     assert h["replies_error"] == 0, h
 
-router.proc.send_signal(signal.SIGTERM)
-rc = router.proc.wait(timeout=120)
+# graceful drains via the shared bounded SIGTERM->SIGKILL escalation
+# (spawnlib.Spawned.stop): a drain-deaf process gets reaped, not hung on
+rc = router.stop(drain_timeout_s=120)
 assert rc == 0, f"router exit code {rc}"
 assert any("drained" in l for l in router.lines), router.lines[-5:]
 
-replicas[1].proc.send_signal(signal.SIGTERM)
-rc = replicas[1].proc.wait(timeout=120)
+rc = replicas[1].stop(drain_timeout_s=120)
 assert rc == 0, f"surviving replica exit code {rc}"
 replicas[0].proc.wait(timeout=30)
 print("ROUTER_SMOKE_ROUNDTRIP_OK")

@@ -220,7 +220,7 @@ def test_capability_mismatch_refused_with_structured_reason():
         assert t == protocol.HELLO_OK
         ok = wire.decode_hello_ok(payload)
         assert ok["caps"] == {"obs_mode": "f32", "her": True,
-                              "obs_norm": False}
+                              "obs_norm": False, "variant": 0}
         s.close()
     finally:
         srv.close()

@@ -1,0 +1,149 @@
+"""Elastic-mesh resume (ISSUE 15 satellite): a checkpoint written on a
+dp=8 mesh must restore onto a dp=4 mesh (and vice versa).
+
+The contract is already implied by the save path — gather-whole via
+``make_shard_and_gather_fns`` means Orbax serializes WHOLE logical
+arrays, so the bytes are mesh-independent — and by ``--resume``
+re-sharding through ``shard_fns`` built for the CURRENT mesh. The
+device-PER priority sidecar makes the same claim via its HOST-SLOT-ORDER
+layout (``striped_perm`` depends on dp, so the sidecar stores priorities
+permuted back to host order and restore re-stripes them for whatever dp
+is live). These tests pin both.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from d4pg_tpu.agent.state import D4PGConfig
+from d4pg_tpu.config import TrainConfig, apply_env_preset
+from d4pg_tpu.models.critic import DistConfig
+
+
+def _cfg(log_dir: str, dp: int, **kw) -> TrainConfig:
+    agent = D4PGConfig(hidden_sizes=(16, 16), dist=DistConfig(num_atoms=11))
+    base = dict(
+        env="pendulum",
+        num_envs=2,
+        total_steps=4,
+        warmup_steps=48,
+        batch_size=16,          # divisible by 8 AND 4
+        steps_per_dispatch=2,
+        eval_interval=1000,
+        eval_episodes=1,
+        checkpoint_interval=4,
+        replay_capacity=512,    # divisible by 8 AND 4
+        prioritized=False,
+        tree_backend="numpy",
+        agent=agent,
+        log_dir=log_dir,
+        concurrent_eval=False,
+        seed=3,
+        replay_placement="device",
+        dp=dp,
+    )
+    base.update(kw)
+    return apply_env_preset(TrainConfig(**base))
+
+
+def _train_leg(cfg):
+    from d4pg_tpu.runtime.trainer import Trainer
+
+    t = Trainer(cfg)
+    try:
+        t.train()
+        return int(jax.device_get(t.state.step))
+    finally:
+        t.close()
+
+
+def test_dp8_checkpoint_resumes_on_dp4_mesh(tmp_path):
+    """Save on the full 8-way virtual mesh, resume on a 4-way mesh: the
+    gathered-whole checkpoint re-shards onto the smaller mesh and keeps
+    training with flat budgets."""
+    from d4pg_tpu.parallel.mesh import make_mesh  # noqa: F401 (mesh sanity)
+    from d4pg_tpu.runtime.trainer import Trainer
+
+    d = str(tmp_path / "run")
+    step1 = _train_leg(_cfg(d, dp=8))
+    t = Trainer(_cfg(d, dp=4, total_steps=8, resume=True,
+                     debug_guards=True))
+    try:
+        assert t.grad_steps == step1
+        leaf = jax.tree_util.tree_leaves(t.state.critic_params)[0]
+        assert len(leaf.sharding.mesh.devices.flat) == 4
+        t.train()
+        assert t.sentinel.counts()["megastep"] == 1
+        assert t._ledger.stats()["trips"] == 0
+    finally:
+        t.close()
+
+
+@pytest.mark.slow
+def test_dp4_checkpoint_resumes_on_dp8_mesh(tmp_path):
+    """The scale-UP direction (a pod growing back) must work too."""
+    from d4pg_tpu.runtime.trainer import Trainer
+
+    d = str(tmp_path / "run")
+    step1 = _train_leg(_cfg(d, dp=4))
+    t = Trainer(_cfg(d, dp=8, total_steps=8, resume=True))
+    try:
+        assert t.grad_steps == step1
+        leaf = jax.tree_util.tree_leaves(t.state.critic_params)[0]
+        assert len(leaf.sharding.mesh.devices.flat) == 8
+        t.train()
+    finally:
+        t.close()
+
+
+@pytest.mark.slow
+def test_dp8_checkpoint_resumes_single_device(tmp_path):
+    """The degenerate shrink — a whole pod gone, one device left: the
+    same gathered-whole bytes restore un-sharded."""
+    from d4pg_tpu.runtime.trainer import Trainer
+
+    d = str(tmp_path / "run")
+    step1 = _train_leg(_cfg(d, dp=8))
+    t = Trainer(_cfg(d, dp=None, total_steps=8, resume=True))
+    try:
+        assert t.grad_steps == step1
+        t.train()
+    finally:
+        t.close()
+
+
+def test_device_per_sidecar_resumes_across_dp(tmp_path):
+    """Device-resident PER across a mesh shrink: the priority sidecar is
+    stored in HOST slot order (striped_perm un-permutes the dp=8 lane
+    layout), so a dp=4 resume must re-stripe the SAME per-slot
+    priorities — pinned by comparing the restored tree's host-order
+    leaves against the dp=8 snapshot."""
+    from d4pg_tpu.runtime.trainer import Trainer
+
+    d = str(tmp_path / "run")
+    cfg8 = _cfg(d, dp=8, prioritized=True, snapshot_replay=True,
+                total_steps=6)
+    step1 = _train_leg(cfg8)
+    snap = os.path.join(d, "checkpoints", "device_per.npz")
+    assert os.path.exists(snap), "device-PER sidecar not written"
+    with np.load(snap) as z:
+        saved = z["priorities_alpha"].copy()
+        saved_max = float(z["max_priority"])
+    t = Trainer(_cfg(d, dp=4, prioritized=True, snapshot_replay=True,
+                     total_steps=10, resume=True))
+    try:
+        assert t.grad_steps == step1
+        pa, mp = t._dev_per.snapshot_host()
+        np.testing.assert_allclose(pa, saved, rtol=1e-6)
+        assert mp == pytest.approx(saved_max)
+        t.train()  # keeps training on the restored priorities
+    finally:
+        t.close()
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-q"]))

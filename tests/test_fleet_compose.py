@@ -8,6 +8,7 @@ slow-marked (chaos_soak.sh leg 8 drives them through the CLIs too)."""
 from __future__ import annotations
 
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -175,6 +176,15 @@ def test_fleet_pixel_u8_ingest_e2e(tmp_path):
     try:
         stats = actor.run()
         assert stats["windows_acked"] > 0
+        # acks are sent at ADMISSION; windows_ingested ticks after the
+        # writer thread's add_batch — bounded wait for the queue drain
+        # (under CI load the writer can lag the last acked frame)
+        deadline = time.monotonic() + 30
+        while (
+            srv.counters()["windows_ingested"] != stats["windows_acked"]
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.05)
         assert srv.counters()["windows_ingested"] == stats["windows_acked"]
         # stored rows are u8 and consistent with the wire quantizer:
         # decode(÷255) → re-quantize is identity, so every stored byte

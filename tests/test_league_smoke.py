@@ -1,0 +1,54 @@
+"""Tier-1 league smoke: the PBT controller lifecycle through the real
+CLI (``scripts/league_smoke.sh``) — planted-winner promotion, a
+controller kill -9 mid-generation with the SAME generation resuming, the
+accounting identity, and zero orphaned learners.
+
+This is THE end-to-end smoke for the league subsystem (the fleet_smoke
+convention); everything else league-related tests in-process
+(``tests/test_league.py``). Learners are the deterministic stub, which
+is what keeps the whole script inside the declared fast-tier budget —
+asserted here (the tier-1 clock-guard convention, ISSUE 15 satellite).
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+from conftest import clean_cpu_env
+
+# The stated fast-tier budget for this smoke. Measured ~5 s on the
+# 2-core CI box; 60 s is the convention's ceiling — a regression past it
+# means a real-learner leg or an unbounded wait crept in.
+FAST_BUDGET_S = 60.0
+
+
+def test_league_smoke_script(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = clean_cpu_env()
+    env["LEAGUE_SMOKE_DIR"] = str(tmp_path / "run")
+    t0 = time.monotonic()
+    p = subprocess.run(
+        ["bash", os.path.join(repo, "scripts", "league_smoke.sh")],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env=env,
+        cwd=repo,
+    )
+    elapsed = time.monotonic() - t0
+    out = p.stdout + p.stderr
+    assert p.returncode == 0, out[-4000:]
+    assert "LEAGUE_SMOKE_ASSERTS_OK" in p.stdout, out[-4000:]
+    assert "LEAGUE_SMOKE_OK" in p.stdout, out[-4000:]
+    # the journal + summary are real on-disk artifacts of the run
+    assert os.path.exists(str(tmp_path / "run" / "league" / "league.json"))
+    assert elapsed < FAST_BUDGET_S, (
+        f"league smoke took {elapsed:.1f}s, past its stated "
+        f"{FAST_BUDGET_S:.0f}s fast-tier budget; keep the tier-1 leg on "
+        "stub learners (real-learner league runs live in chaos_soak leg 9)"
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(0)

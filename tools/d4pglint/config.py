@@ -95,6 +95,20 @@ HOST_ONLY_MODULES = (
     "d4pg_tpu/utils/__init__.py",
     "d4pg_tpu/utils/signals.py",
     "d4pg_tpu/utils/retry.py",
+    # Process-group lifecycle (ISSUE 15): imported by the league
+    # controller, the autoscaler, and scripts/spawnlib.py — all processes
+    # that move PIDs and JSON, never tensors.
+    "d4pg_tpu/utils/procs.py",
+    # The checkpoint commit-record primitives, split JAX-free out of
+    # runtime/checkpoint.py so the league controller (and the stub
+    # learners) can verify/fork checkpoints without Orbax.
+    "d4pg_tpu/runtime/manifest.py",
+    # The league controller (ISSUE 15): supervises N learner processes —
+    # a JAX import here would pay seconds per restart-after-kill-9 and
+    # break the restart-in-milliseconds supervision contract.
+    "d4pg_tpu/league/__init__.py",
+    "d4pg_tpu/league/controller.py",
+    "d4pg_tpu/league/__main__.py",
     "d4pg_tpu/chaos.py",
     "d4pg_tpu/analysis/__init__.py",
     "d4pg_tpu/analysis/ledger.py",

@@ -393,6 +393,121 @@ def check_lock_order_graph(path: str, root: str | None = None) -> list[str]:
     return errs
 
 
+def check_league_soak(path: str) -> list[str]:
+    """Shape + invariants for ``benchmarks/league_soak.json`` — the
+    ISSUE-15 acceptance artifact (the league controller's end-of-run
+    summary from a real soak run):
+
+    - per-variant process ACCOUNTING IDENTITY, recomputed here, not
+      trusted: every process the controller ever started or adopted for a
+      variant is accounted as a graceful exit (0), a preemption drain
+      (75), a crash, a controller kill, or still-live — a committed
+      artifact can never attest a silently lost learner process;
+    - the promotion LINEAGE is a well-formed DAG: every clone edge names
+      existing variants, a child is born in the generation its edge
+      records, and no variant is its own ancestor;
+    - every fork has exactly one recorded outcome — a clone edge promotes
+      or rolls back, a rollback-refork edge promotes or gives the slot up
+      (``promotions + rollbacks == lineage edges``) — ``identity_ok`` is
+      attested true, and ``orphans_swept`` is 0 (the zero-orphaned-
+      learners contract).
+    """
+    errs = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: unreadable/invalid JSON ({e})"]
+    for key in ("backend", "schema", "seed", "slots",
+                "generations_completed", "promotions", "rollbacks",
+                "variants", "lineage", "identity_ok", "orphans_swept"):
+        if key not in doc:
+            errs.append(f"{path}: missing top-level key {key!r}")
+    if doc.get("schema") != "league-soak/v1":
+        errs.append(
+            f"{path}: unknown schema {doc.get('schema')!r} "
+            "(expected 'league-soak/v1')"
+        )
+    variants = doc.get("variants")
+    if not isinstance(variants, dict) or not variants:
+        return errs + [f"{path}: 'variants' must be a non-empty object"]
+    for uid, row in variants.items():
+        for key in ("slot", "parent", "born_gen", "genome", "spawned",
+                    "adopted", "exited_0", "exited_75", "exited_err",
+                    "killed", "live", "restarts", "quarantined"):
+            if key not in row:
+                errs.append(f"{path}: variants[{uid}] missing {key!r}")
+        started = row.get("spawned", 0) + row.get("adopted", 0)
+        accounted = (
+            row.get("exited_0", 0) + row.get("exited_75", 0)
+            + row.get("exited_err", 0) + row.get("killed", 0)
+            + row.get("live", 0)
+        )
+        if started != accounted:
+            errs.append(
+                f"{path}: variants[{uid}] process identity broken: "
+                f"spawned+adopted ({started}) != exits+kills+live "
+                f"({accounted}) — a learner process went unaccounted"
+            )
+    lineage = doc.get("lineage")
+    if not isinstance(lineage, list):
+        errs.append(f"{path}: 'lineage' must be a list")
+    else:
+        for i, e in enumerate(lineage):
+            child, parent = str(e.get("child")), str(e.get("parent"))
+            if child not in variants or parent not in variants:
+                errs.append(
+                    f"{path}: lineage[{i}] names unknown variant(s) "
+                    f"{e.get('child')}->{e.get('parent')}"
+                )
+                continue
+            if variants[child].get("born_gen") != e.get("gen"):
+                errs.append(
+                    f"{path}: lineage[{i}] child {child} born_gen "
+                    f"{variants[child].get('born_gen')} != edge gen "
+                    f"{e.get('gen')}"
+                )
+        # ancestry must terminate at a seed variant (parent null): a cycle
+        # in the committed lineage means the DAG claim is false
+        for uid in variants:
+            seen, cur = set(), uid
+            while variants.get(cur, {}).get("parent") is not None:
+                if cur in seen:
+                    errs.append(f"{path}: lineage cycle through {uid}")
+                    break
+                seen.add(cur)
+                cur = str(variants[cur]["parent"])
+        resolved = doc.get("promotions", 0) + doc.get("rollbacks", 0)
+        if resolved != len(lineage):
+            errs.append(
+                f"{path}: promotions+rollbacks ({resolved}) != lineage "
+                f"edges ({len(lineage)}) — every fork needs exactly one "
+                "recorded outcome"
+            )
+    if doc.get("identity_ok") is not True:
+        errs.append(
+            f"{path}: identity_ok is {doc.get('identity_ok')!r} — the "
+            "committed artifact must attest the accounting identity"
+        )
+    if doc.get("orphans_swept") != 0:
+        errs.append(
+            f"{path}: orphans_swept is {doc.get('orphans_swept')!r} — "
+            "zero orphaned learner processes is the contract"
+        )
+    if doc.get("promotions", 0) < 1:
+        errs.append(
+            f"{path}: no promotion recorded — the soak exists to prove "
+            "the planted better variant promotes"
+        )
+    return errs
+
+
+# League identity columns (ISSUE 15): when a row carries one it must
+# carry both, integer-valued and non-negative — the league controller
+# groups rows by (variant_id, league_generation).
+_LEAGUE_COLUMNS = ("variant_id", "league_generation")
+
+
 def check_metrics_jsonl(path: str, max_rows: int | None = None) -> list[str]:
     """Problems with one metrics.jsonl ([] = clean)."""
     errs = []
@@ -428,6 +543,21 @@ def check_metrics_jsonl(path: str, max_rows: int | None = None) -> list[str]:
                         "numeric-only by contract"
                     )
                     break
+            present = [k for k in _LEAGUE_COLUMNS if k in row]
+            if present and len(present) != len(_LEAGUE_COLUMNS):
+                errs.append(
+                    f"{path}:{lineno}: league columns are a pair — "
+                    f"row has {present} but not "
+                    f"{[k for k in _LEAGUE_COLUMNS if k not in row]}"
+                )
+            for k in present:
+                v = row[k]
+                if isinstance(v, bool) or not isinstance(v, (int, float)) \
+                        or v != int(v) or v < 0:
+                    errs.append(
+                        f"{path}:{lineno}: {k!r} must be a non-negative "
+                        f"integer value, got {v!r}"
+                    )
     return errs
 
 
@@ -449,6 +579,8 @@ def check_tree(root: str) -> list[str]:
             errs.extend(check_shard_microbench(path))
         if os.path.basename(path) == "composition_matrix.json":
             errs.extend(check_composition_matrix(path))
+        if os.path.basename(path) == "league_soak.json":
+            errs.extend(check_league_soak(path))
     for path in sorted(
         glob.glob(os.path.join(root, "runs", "**", "metrics.jsonl"),
                   recursive=True)

@@ -252,6 +252,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "rows: auto/float32 = byte-identical f32; bfloat16 "
                         "halves wire bytes with a declared bf16 round "
                         "(pixel envs always negotiate u8-quantized rows)")
+    # league membership (d4pg_tpu/league, docs/league.md): set by the
+    # controller when it spawns/forks this learner — never by hand
+    p.add_argument("--variant-id", type=int, default=None,
+                   help="league variant id this learner IS: stamped onto "
+                        "every metrics.jsonl row + trainer_meta.json (the "
+                        "league controller's fork-resume attestation) and "
+                        "negotiated in the fleet HELLO (actors assigned "
+                        "elsewhere are refused)")
+    p.add_argument("--league-generation", type=int, default=0,
+                   help="league generation that spawned/forked this "
+                        "learner (rides the metrics rows next to "
+                        "--variant-id)")
     p.add_argument("--chaos", default=None, metavar="PLAN",
                    help="deterministic fault injection (d4pg_tpu/chaos.py): "
                         "';'-separated site@count[:arg][#actor] entries, "
@@ -381,6 +393,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         fleet_publish_interval=args.fleet_publish_interval,
         fleet_max_gen_lag=args.fleet_max_gen_lag,
         fleet_wire_dtype=args.fleet_wire_dtype,
+        variant_id=args.variant_id,
+        league_generation=args.league_generation,
         debug_guards=args.debug_guards,
         chaos=args.chaos,
         pool_step_timeout_s=args.pool_step_timeout_s,
