@@ -27,10 +27,15 @@ claim checkable rather than asserted:
    ISSUE 14): the priority segment tree in HBM, so the wide-shape rows
    are finally reachable by runs using the sampling scheme the paper's
    D4PG actually uses (prioritized replay, Horgan et al. 2018) — with
-   ``transfer_bytes_per_grad_step`` still 0 by construction.
+   ``transfer_bytes_per_grad_step`` still 0 by construction;
+7. the LARGE-BATCH RECIPE shape (ISSUE 16): device-PER with the fused
+   descent-in-scan Pallas tier, bf16, at the exact B/K that
+   ``train.py --p-replay --batch-scale 8 --fused-descent`` dispatches —
+   the REAL prioritized training shape living at the MXU-filling point
+   the sweep proved out (see docs/data_plane.md "Large-batch recipe").
 
 Points 1-3 run through ``bench.bench_tpu`` (device-resident pool, fused
-K-step scan); points 4-5 through ``bench.bench_megastep`` (device ring +
+K-step scan); points 4-7 through ``bench.bench_megastep`` (device ring +
 in-kernel draw; ``dp=`` for the sharded rows) — the SAME pinned timing
 protocol (pipelined dispatches, donated state, value-transfer sync),
 parameterized rather than copied, so the rows can never drift apart.
@@ -42,11 +47,14 @@ CPU sharded rows:            JAX_PLATFORMS=cpu \
                              python benchmarks/mfu_sweep.py --sharded-only
 CPU device-PER rows:         JAX_PLATFORMS=cpu \
                              python benchmarks/mfu_sweep.py --device-per-only
-(--megastep-only / --sharded-only / --device-per-only keep the committed
-on-chip rows — the TPU tunnel has been down since round 5 — and replace
-only their own row family, each tagged with the backend that produced
-it; rerun WITHOUT the flags on the TPU VM to refresh everything on-chip.
-``--sharded`` / ``--device-per`` add their rows to a full refresh.)
+CPU large-batch row:         JAX_PLATFORMS=cpu \
+                             python benchmarks/mfu_sweep.py --large-batch-only
+(--megastep-only / --sharded-only / --device-per-only /
+--large-batch-only keep the committed on-chip rows — the TPU tunnel has
+been down since round 5 — and replace only their own row family, each
+tagged with the backend that produced it; rerun WITHOUT the flags on the
+TPU VM to refresh everything on-chip. ``--sharded`` / ``--device-per`` /
+``--large-batch`` add their rows to a full refresh.)
 
 Prints one JSON line per point and writes benchmarks/mfu_sweep_results.json.
 """
@@ -242,6 +250,109 @@ def device_per_rows() -> list[dict]:
     return rows
 
 
+def large_batch_point(all_rows: list[dict], *, scale: int = 8,
+                      steps: int = 3) -> dict:
+    """The ISSUE 16 flagship large-batch recipe row: the REAL
+    ``--p-replay`` training shape — device-resident PER with the FUSED
+    descent-in-scan tier (descent + loss as ONE Pallas program per scan
+    step), bf16 compute, at the ``--batch-scale`` recipe's B/K (B=256·S,
+    K=32/S, the exact shape ``train.py --batch-scale S`` dispatches).
+
+    Three claims ride on this row, split by what a CPU can measure:
+
+    * ``transfer_bytes_per_grad_step`` — 0 by construction, measured
+      here and chip-independent (schema_check refuses nonzero);
+    * the CPU-proxy ratios — this row vs the B=256 flagship recipe
+      baseline, SAME fused data plane, measured in this run:
+      ``transitions_per_sec_ratio`` is rows-consumed/s (steps/s ×
+      batch), the amortization the recipe exists for;
+    * ``mfu_onchip_proxy`` — the ≥2×-flagship-MFU claim, anchored to the
+      committed ON-CHIP mlp256 rows at the same (width, batch, dtype)
+      matmul shape (the model cost is the shared
+      ``bench.model_flops_per_step`` oracle, so the proxy and a real
+      on-chip rerun of this row cannot drift apart), plus ``recipe`` —
+      the ready-to-run command for the on-chip number.
+    """
+    import jax
+
+    base_batch, base_k = 256, 32
+    batch, k = base_batch * scale, max(1, base_k // scale)
+    fused = dict(
+        placement="device", per=True, compute_dtype="bfloat16",
+        projection_backend="pallas_fused", fused_descent=True,
+    )
+    base = bench_megastep(batch=base_batch, k=base_k, steps=steps, **fused)
+    out = bench_megastep(batch=batch, k=k, steps=steps, **fused)
+    row = {
+        "bench": "mfu_sweep",
+        "config": "large_batch_per_mlp256",
+        "batch": batch,
+        "batch_scale": scale,
+        "k": k,
+        "compute_dtype": "bfloat16",
+        "backend": jax.default_backend(),
+        "steps_per_sec": round(out["steps_per_sec"], 1),
+        "baseline_steps_per_sec": round(base["steps_per_sec"], 1),
+        "steps_per_sec_ratio": round(
+            out["steps_per_sec"] / base["steps_per_sec"], 4
+        ),
+        "transitions_per_sec_ratio": round(
+            out["steps_per_sec"] * batch
+            / (base["steps_per_sec"] * base_batch), 2
+        ),
+        "transfer_bytes_per_grad_step": out["transfer_bytes_per_grad_step"],
+    }
+    for key, nd in (
+        ("flops_per_grad_step", 0),
+        ("achieved_tflops", 3),
+        ("mfu", 5),
+    ):
+        if key in out:
+            row[key] = round(out[key], nd) if nd else round(out[key])
+
+    def _mlp256_mfu(b):
+        for r in all_rows:
+            if (r.get("config") == "mlp256" and r.get("batch") == b
+                    and r.get("mfu")):
+                return r["mfu"]
+        return None
+
+    flagship_mfu, shape_mfu = _mlp256_mfu(base_batch), _mlp256_mfu(batch)
+    if flagship_mfu and shape_mfu:
+        row["mfu_onchip_proxy"] = {
+            "flagship_mfu": flagship_mfu,
+            "shape_mfu": shape_mfu,
+            "ratio_vs_flagship": round(shape_mfu / flagship_mfu, 2),
+            "note": (
+                f"committed on-chip mlp256 rows at B={base_batch} vs "
+                f"B={batch}, bf16 — the same matmul shapes this recipe "
+                "dispatches, costed by the same single-step oracle"
+            ),
+        }
+    row["recipe"] = (
+        "python train.py --env pendulum --p-replay "
+        "--replay-placement device --device-tree-backend pallas "
+        "--projection pallas_fused --compute-dtype bfloat16 "
+        f"--steps-per-dispatch {base_k} --batch-scale {scale} "
+        "--fused-descent --ingest-prefetch"
+    )
+    if jax.default_backend() == "cpu":
+        row["note"] = (
+            "CPU-interpret placeholder steps/s (TPU tunnel down); the "
+            "ratios + zero-transfer column are measured here, the MFU "
+            "claim is the committed on-chip proxy — rerun "
+            "benchmarks/mfu_sweep.py --large-batch-only on-chip for the "
+            "direct number"
+        )
+    return row
+
+
+def large_batch_rows(all_rows: list[dict]) -> list[dict]:
+    rows = [large_batch_point(all_rows)]
+    print(json.dumps(rows[-1]), flush=True)
+    return rows
+
+
 def _replace_family(rows: list[dict], prefix: str, new_rows: list[dict]) -> list[dict]:
     """Drop rows whose config starts with ``prefix`` and append the fresh
     ones — the committed on-chip rows for every OTHER family survive a
@@ -255,6 +366,12 @@ def main(argv=None) -> None:
     if "--sharded-only" in argv:
         with open(RESULTS) as f:
             rows = _replace_family(json.load(f), "sharded_megastep", sharded_rows())
+    elif "--large-batch-only" in argv:
+        with open(RESULTS) as f:
+            committed = json.load(f)
+        rows = _replace_family(
+            committed, "large_batch", large_batch_rows(committed)
+        )
     elif "--device-per-only" in argv:
         with open(RESULTS) as f:
             rows = _replace_family(
@@ -298,6 +415,11 @@ def main(argv=None) -> None:
         #    row needs a multi-device backend)
         if "--device-per" in argv:
             rows.extend(device_per_rows())
+        # 7. the large-batch recipe's REAL --p-replay shape (ISSUE 16):
+        #    fused descent-in-scan tier, bf16, B=2048/K=4. Runs after the
+        #    mlp256 family so the on-chip MFU proxy cites THIS refresh.
+        if "--large-batch" in argv:
+            rows.extend(large_batch_rows(rows))
     with open(RESULTS, "w") as f:
         json.dump(rows, f, indent=1)
     print(f"[mfu_sweep] wrote {RESULTS}", file=sys.stderr)

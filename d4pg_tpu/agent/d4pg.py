@@ -262,7 +262,8 @@ def train_step(
     batch: Mapping[str, jax.Array],
     axis_name: str | None = None,
     sync_fn=None,
-) -> tuple[TrainState, Mapping[str, jax.Array], jax.Array]:
+    descent=None,
+):
     """One full D4PG SGD step (the reference §3.2 hot loop, fused).
 
     Args:
@@ -284,10 +285,33 @@ def train_step(
         whose bits a single-device vmap oracle can replay exactly —
         ``pmean``'s backend AllReduce cannot be (its accumulation order is
         the backend's choice). ``None`` keeps the pmean/axis_name path.
+      descent: ``(leaves [L], next_prefixes [B])`` — the fused-tier
+        pipelining seam (ISSUE 16, ``ops/pallas_fused_step.py``): the
+        step's fused-loss Pallas program ALSO descends the device-PER
+        segment tree for the NEXT scan step's stratified prefixes, so the
+        megastep's steady state runs one program per step instead of a
+        separate descent program per dispatch. Requires the categorical
+        head with ``projection_backend="pallas_fused"`` (raises
+        otherwise). When set, the return grows a fourth element:
+        ``next_idx [B] int32`` (unclamped-to-fill leaf indices; the
+        megastep body applies ``lane_draw``'s fill clamp). Under stacked
+        critics every member computes the identical descent; member 0's
+        is returned.
 
     Returns:
-      (new_state, metrics, priorities[B] — local shard under shard_map).
+      (new_state, metrics, priorities[B] — local shard under shard_map),
+      plus ``next_idx [B]`` when ``descent`` is given.
     """
+    if descent is not None and not (
+        config.dist.kind == "categorical"
+        and config.projection_backend == "pallas_fused"
+    ):
+        raise ValueError(
+            "descent= (the fused descent-in-scan tier) requires the "
+            "categorical head with projection_backend='pallas_fused' "
+            f"(got kind={config.dist.kind!r}, "
+            f"backend={config.projection_backend!r})"
+        )
 
     def _sync(tree):
         if sync_fn is not None:
@@ -403,19 +427,39 @@ def train_step(
 
             def critic_loss_fn(critic_params):
                 pred = critic.apply(critic_params, batch["obs"], batch["action"])
-                ce, overlap = fused_categorical_loss(
-                    support,
-                    pred,
-                    fused_target_probs,
-                    batch["reward"],
-                    batch["discount"],
-                    interpret,
-                )
+                if descent is not None:
+                    from d4pg_tpu.ops.pallas_fused_step import (
+                        fused_categorical_loss_descent,
+                    )
+
+                    leaves, next_prefixes = descent
+                    ce, overlap, next_idx = fused_categorical_loss_descent(
+                        support,
+                        pred,
+                        fused_target_probs,
+                        batch["reward"],
+                        batch["discount"],
+                        next_prefixes,
+                        leaves,
+                        interpret,
+                    )
+                else:
+                    next_idx = None
+                    ce, overlap = fused_categorical_loss(
+                        support,
+                        pred,
+                        fused_target_probs,
+                        batch["reward"],
+                        batch["discount"],
+                        interpret,
+                    )
                 # f32 weighted reduction on [B] vectors — byte-trivial.
                 loss = jnp.mean(weights * ce)
                 per_sample = (
                     overlap if config.priority_kind == "overlap" else ce
                 )
+                if descent is not None:
+                    return loss, (per_sample, next_idx)
                 return loss, per_sample
 
         elif config.projection_backend == "pallas":
@@ -499,11 +543,22 @@ def train_step(
 
         def critic_loss_fn(stacked_params):
             losses, per_sample = jax.vmap(_single_loss_fn)(stacked_params)
+            if descent is not None:
+                # Every member ran the identical descent (same leaves,
+                # same prefixes, exact int32) — member 0 IS the result.
+                per_sample, next_idx = per_sample
+                return jnp.sum(losses), (
+                    jnp.mean(per_sample, axis=0), next_idx[0]
+                )
             return jnp.sum(losses), jnp.mean(per_sample, axis=0)
 
-    (critic_loss, priorities), critic_grads = jax.value_and_grad(
+    (critic_loss, loss_aux), critic_grads = jax.value_and_grad(
         critic_loss_fn, has_aux=True
     )(state.critic_params)
+    if descent is not None:
+        priorities, descent_idx = loss_aux
+    else:
+        priorities = loss_aux
     critic_grads = _sync(critic_grads)
     critic_updates, critic_opt_state = critic_opt.update(
         critic_grads, state.critic_opt_state
@@ -594,6 +649,8 @@ def train_step(
             config.dist.v_max - config.dist.v_min
         )
     metrics = _sync(step_metrics)
+    if descent is not None:
+        return new_state, metrics, priorities, descent_idx
     return new_state, metrics, priorities
 
 

@@ -61,6 +61,31 @@ class TrainConfig:
     # class as steps_per_dispatch>1, and strictly less than async_collect's.
     # Default off so existing runs are batch-for-batch identical.
     prefetch: bool = False
+    # The large-batch flagship recipe (ISSUE 16): one knob S deriving the
+    # whole wide-shape configuration from the B=256 baseline via
+    # apply_batch_scale — batch ×S, linear-LR ×S (Goyal et al. 2017: S×
+    # the data per gradient supports S× the step), PER-β anneal ÷S in
+    # grad steps (each grad step now consumes S× the samples, so the
+    # anneal tracks DATA seen, not steps taken), warmup ×S (the first
+    # wide batch needs as many decorrelated rows as S baseline batches),
+    # steps-per-dispatch ÷S (a wide batch already amortizes dispatch
+    # latency — keep work per dispatch roughly constant). 1 = off,
+    # byte-identical to before.
+    batch_scale: int = 1
+    # Fused descent-in-scan Pallas tier (ISSUE 16): the device-PER
+    # megastep's scan body runs categorical loss + the NEXT step's tree
+    # descent as ONE Pallas program (ops/pallas_fused_step.py) instead of
+    # a separate whole-[K,B] descent up front. Byte-identical to the
+    # separate-programs tier by construction; requires device placement +
+    # PER + projection_backend=pallas_fused + categorical head, no dp
+    # (negotiation declares the gaps).
+    fused_descent: bool = False
+    # Double-buffered ingest (ISSUE 16): right after each megastep
+    # dispatch, pre-gather + device_put the next flush's first chunk
+    # (DeviceRingSync.stage) so the H2D transfer overlaps the in-flight
+    # compute instead of serializing before the next dispatch. Device
+    # placement only; ignored (declared) elsewhere.
+    ingest_prefetch: bool = False
     # Runtime invariant guards (d4pg_tpu/analysis): recompile sentinel on
     # every jitted entry point, transfer guard around steady-state
     # dispatch, staging ledger on every rotated host staging slot. Debug
@@ -325,4 +350,50 @@ def apply_env_preset(config: TrainConfig) -> TrainConfig:
     return dataclasses.replace(
         config, agent=agent, max_episode_steps=max_steps,
         replay_capacity=replay_capacity,
+    )
+
+
+def apply_batch_scale(config: TrainConfig) -> TrainConfig:
+    """Derive the large-batch recipe from the baseline config (ISSUE 16).
+
+    One multiplier ``S = config.batch_scale`` rewrites every knob the wide
+    shape moves, so a recipe is ``--batch-scale 8``, not five hand-tuned
+    flags that can drift apart:
+
+    ==================  =========================  ==========================
+    knob                rule                       why
+    ==================  =========================  ==========================
+    batch_size          × S                        the point
+    lr_actor/lr_critic  × S                        linear scaling: S× the
+                                                   data per gradient supports
+                                                   S× the step (Goyal 2017)
+    per_beta_steps      ÷ S (floor 1)              β anneal tracks DATA seen;
+                                                   each grad step now eats S×
+                                                   the samples
+    warmup_steps        × S                        the first wide batch needs
+                                                   as many decorrelated rows
+                                                   as S baseline batches
+    steps_per_dispatch  ÷ S (floor 1)              a wide batch already
+                                                   amortizes dispatch latency
+    ==================  =========================  ==========================
+
+    Applied AFTER :func:`apply_env_preset` (presets set baseline values;
+    the scale derives from them). ``S <= 1`` returns the config unchanged
+    — byte-for-byte, so every existing run is unaffected.
+    """
+    s = int(config.batch_scale)
+    if s <= 1:
+        return config
+    agent = dataclasses.replace(
+        config.agent,
+        lr_actor=config.agent.lr_actor * s,
+        lr_critic=config.agent.lr_critic * s,
+        per_beta_steps=max(1, config.agent.per_beta_steps // s),
+    )
+    return dataclasses.replace(
+        config,
+        agent=agent,
+        batch_size=config.batch_size * s,
+        warmup_steps=config.warmup_steps * s,
+        steps_per_dispatch=max(1, config.steps_per_dispatch // s),
     )

@@ -142,20 +142,31 @@ def _log_softmax_tile(logits):
     return shifted - lse
 
 
-def _fused_loss_kernel(
-    num_atoms, v_min, v_max, q_ref, p_ref, r_ref, d_ref, ce_ref, ov_ref
-):
-    """Forward: Φ + log-softmax CE + overlap surrogate, m never leaves VMEM.
+def loss_tile(num_atoms, v_min, v_max, q, p, r, d):
+    """Φ + log-softmax CE + overlap surrogate for one [TB, A] tile, m never
+    leaving VMEM — the loss body shared VERBATIM by the fused-loss kernel
+    and the fused loss+descent kernel (``ops/pallas_fused_step.py``), the
+    same no-drift discipline as ``_project_tile``.
 
-    Emits per-sample columns:
+    Returns per-sample columns:
       ce[b]  = −Σ_i m[b,i]·log_softmax(q)[b,i]   (loss term AND "ce" priority)
       ov[b]  = |−Σ_i m[b,i]·softmax(q)[b,i]|     ("overlap" priority surrogate,
                 reference ddpg.py:220-222)
     """
-    m = _project_tile(num_atoms, v_min, v_max, p_ref[:], r_ref[:], d_ref[:])
-    logp = _log_softmax_tile(q_ref[:])
-    ce_ref[:] = -jnp.sum(m * logp, axis=-1, keepdims=True)
-    ov_ref[:] = jnp.abs(-jnp.sum(m * jnp.exp(logp), axis=-1, keepdims=True))
+    m = _project_tile(num_atoms, v_min, v_max, p, r, d)
+    logp = _log_softmax_tile(q)
+    ce = -jnp.sum(m * logp, axis=-1, keepdims=True)
+    ov = jnp.abs(-jnp.sum(m * jnp.exp(logp), axis=-1, keepdims=True))
+    return ce, ov
+
+
+def _fused_loss_kernel(
+    num_atoms, v_min, v_max, q_ref, p_ref, r_ref, d_ref, ce_ref, ov_ref
+):
+    """Forward: see :func:`loss_tile`."""
+    ce_ref[:], ov_ref[:] = loss_tile(
+        num_atoms, v_min, v_max, q_ref[:], p_ref[:], r_ref[:], d_ref[:]
+    )
 
 
 def _fused_loss_grad_kernel(

@@ -42,11 +42,16 @@ _TILE_D = 128   # draws per grid step
 _BLOCK_L = 128  # leaf lanes swept per inner iteration
 
 
-def _count_kernel(n_blocks, leaves_ref, pref_ref, out_ref):
+def count_tile(n_blocks, leaves_ref, pref):
     """count[d] = #{ i : running + block_cumsum[i] <= prefix[d] } over all
-    leaf blocks. ``leaves_ref`` [1, L] f32, ``pref_ref`` [TILE_D, 1] f32,
-    ``out_ref`` [TILE_D, 1] i32."""
-    pref = pref_ref[:]                                   # [TD, 1]
+    leaf blocks — the descent body shared VERBATIM by the standalone
+    descent kernel and the fused loss+descent kernel
+    (``ops/pallas_fused_step.py``), so the two tiers can never drift:
+    identical accumulation order on identical leaves gives identical int32
+    counts, which is what makes the fused tier's byte-parity automatic.
+
+    ``leaves_ref`` [1, L] f32 VMEM ref, ``pref`` [TD, 1] f32 tile.
+    Returns [TD, 1] int32 counts (unclamped)."""
     row = jax.lax.broadcasted_iota(jnp.int32, (_BLOCK_L, _BLOCK_L), 0)
     col = jax.lax.broadcasted_iota(jnp.int32, (_BLOCK_L, _BLOCK_L), 1)
     # M[i, j] = 1 iff i <= j: leaves @ M is the block-inclusive cumsum.
@@ -66,9 +71,16 @@ def _count_kernel(n_blocks, leaves_ref, pref_ref, out_ref):
         0,
         n_blocks,
         body,
-        (jnp.zeros((), jnp.float32), jnp.zeros((_TILE_D, 1), jnp.int32)),
+        (jnp.zeros((), jnp.float32),
+         jnp.zeros((pref.shape[0], 1), jnp.int32)),
     )
-    out_ref[:] = count
+    return count
+
+
+def _count_kernel(n_blocks, leaves_ref, pref_ref, out_ref):
+    """Standalone descent kernel: ``leaves_ref`` [1, L] f32, ``pref_ref``
+    [TILE_D, 1] f32, ``out_ref`` [TILE_D, 1] i32."""
+    out_ref[:] = count_tile(n_blocks, leaves_ref, pref_ref[:])
 
 
 @functools.partial(jax.jit, static_argnums=(2,))

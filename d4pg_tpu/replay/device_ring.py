@@ -24,9 +24,20 @@ Three pieces:
   the last flush, in large infrequent chunks (the ``ingest_chunk`` stage),
   never per step and never per grad step.
 
+:meth:`DeviceRingSync.stage` adds the ISSUE-16 double buffer on top: the
+trainer calls it right after dispatching a megastep, so the NEXT flush's
+first chunk gathers and ships H2D while the device is busy computing —
+the transfer overlaps compute instead of serializing before the next
+dispatch. ``flush`` consumes the staged chunk first (iff its base write
+counter is still current), then ships the remainder in write order, so
+last-write-wins is preserved even when the collector overwrote staged
+rows in between.
+
 Deliberate non-goals: the chunk gather allocates fresh host arrays per
 flush (ingest is the infrequent cold path — reusing staging here would
-buy nothing and re-open the ledger-hold question the hot paths needed);
+buy nothing and re-open the ledger-hold question the hot paths needed;
+``stage`` preallocates only its index buffers, since it runs once per
+dispatch on the hot path);
 pixel (uint8-quantized) buffers are not mirrored (a 100k-row pixel ring
 is ~0.9 GB of HBM better spent on batch size — the trainer rejects the
 combination loudly).
@@ -34,11 +45,25 @@ combination loudly).
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+class _StagedChunk(NamedTuple):
+    """One pre-staged ingest chunk (``DeviceRingSync.stage``): the gather
+    + H2D of the next flush's FIRST chunk, done while the device runs the
+    megastep so the transfer overlaps compute instead of serializing
+    before the next dispatch (ISSUE 16's double-buffer leg)."""
+
+    synced_at: int        # self._synced when staged (consume iff unchanged)
+    covers: int           # global write index this chunk syncs through
+    dev_chunk: dict       # device-resident row fields
+    slots_dev: jax.Array  # device-resident [chunk_cap] slot indices
+    new_size_dev: jax.Array  # ring fill count consistent at `covers`
+    nbytes: int
 
 
 class DeviceRing(NamedTuple):
@@ -167,6 +192,64 @@ class DeviceRingSync:
         # priority tree seeds the same rows the ring just mirrored — zero
         # extra H2D, and ring row vs priority leaf can never desync.
         self.tree_hook = None
+        # Double-buffer staging (stage()): the next flush's first chunk,
+        # pre-gathered + device_put while the device runs the megastep.
+        # Slot/gather index buffers are preallocated so the hot-path
+        # stage() call allocates no fresh host staging per dispatch
+        # (device_put copies out of them before returning).
+        self._staged: Optional[_StagedChunk] = None
+        self._stage_slots = np.full(self.chunk_cap, self.capacity, np.int32)
+        self._stage_gidx = np.zeros(self.chunk_cap, np.int64)
+
+    def stage(self) -> bool:
+        """Pre-stage the next flush's FIRST chunk: gather ≤ ``chunk_cap``
+        pending rows and ``device_put`` them NOW, so the H2D transfer
+        overlaps the in-flight megastep's compute instead of serializing
+        in front of the next dispatch (the ``ingest_stage`` timer stage).
+
+        Safe to call at any time: a no-op if a chunk is already staged or
+        nothing is pending, and :meth:`flush` consumes the staged chunk
+        only while its base write counter still matches — rows the
+        collector overwrites AFTER staging are re-shipped by the flush's
+        remainder loop, which runs after the staged scatter, so host write
+        order (last-write-wins) is preserved end to end.
+
+        Returns True iff a chunk is staged on exit."""
+        if self._staged is not None:
+            return True
+        buf = self._buffer
+        total = buf.total_added
+        n_pending = min(total - self._synced, self.capacity)
+        if n_pending <= 0:
+            return False
+        first = total - n_pending
+        n = min(n_pending, self.chunk_cap)
+        slots = self._stage_slots
+        slots.fill(self.capacity)
+        slots[:n] = (first + np.arange(n)) % self.capacity
+        gidx = self._stage_gidx
+        gidx.fill(0)
+        gidx[:n] = slots[:n]
+        chunk = dict(buf.gather(gidx))  # locked: never a torn row
+        covers = first + n
+        # Fill count consistent at `covers` writes — the remainder loop
+        # (or a later flush) advances it to the final value.
+        new_size = np.int32(min(covers, self.capacity))
+        dev_chunk = jax.device_put(chunk)  # explicit staging (exempt)
+        slots_dev = jax.device_put(slots)
+        nbytes = (
+            sum(v.nbytes for v in chunk.values())
+            + slots.nbytes + new_size.nbytes
+        )
+        self._staged = _StagedChunk(
+            synced_at=self._synced,
+            covers=covers,
+            dev_chunk=dev_chunk,
+            slots_dev=slots_dev,
+            new_size_dev=jax.device_put(new_size),
+            nbytes=nbytes,
+        )
+        return True
 
     @property
     def ingest_fn(self):
@@ -180,6 +263,22 @@ class DeviceRingSync:
         """Mirror all pending host writes into ``ring``; returns the
         updated ring (the argument is consumed — donated)."""
         buf = self._buffer
+        staged, self._staged = self._staged, None
+        if staged is not None and staged.synced_at == self._synced:
+            # Consume the pre-staged chunk: its transfer already happened
+            # under the previous dispatch. Rows written (or overwritten)
+            # since staging fall into [covers, total) and ship below, in
+            # write order, so the staged scatter can never shadow a newer
+            # row.
+            ring = self._ingest(
+                ring, staged.dev_chunk, staged.slots_dev,
+                staged.new_size_dev,
+            )
+            if self.tree_hook is not None:
+                self.tree_hook(staged.slots_dev)
+            self.bytes_ingested += staged.nbytes
+            self.chunks_ingested += 1
+            self._synced = staged.covers
         total = buf.total_added
         n_pending = min(total - self._synced, self.capacity)
         if n_pending <= 0:

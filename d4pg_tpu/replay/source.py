@@ -109,6 +109,17 @@ class RequestedCaps:
     steps_per_dispatch: int = 1
     transfer_dtype: str = "float32"
     prefetch: bool = False
+    # ISSUE 16 — the large-batch/fused-kernel tier's capability flags:
+    # fused_descent asks for the descent-in-scan Pallas program (device-
+    # PER only, single device, pallas_fused projection, categorical
+    # head); ingest_prefetch asks for the double-buffered ring staging
+    # (device placement; a declared no-op elsewhere). projection /
+    # dist_kind ride along so the fused-descent preconditions are
+    # negotiable facts, not trainer-side asserts.
+    fused_descent: bool = False
+    ingest_prefetch: bool = False
+    projection: str = "xla"         # xla | pallas | pallas_fused
+    dist_kind: str = "categorical"  # categorical | quantile | iqn
     chaos: bool = False
     batch_size: int = 256
     replay_capacity: Optional[int] = None
@@ -144,6 +155,10 @@ def from_train_config(config, *, on_device: bool = False,
         steps_per_dispatch=int(config.steps_per_dispatch),
         transfer_dtype=config.transfer_dtype,
         prefetch=bool(config.prefetch),
+        fused_descent=bool(getattr(config, "fused_descent", False)),
+        ingest_prefetch=bool(getattr(config, "ingest_prefetch", False)),
+        projection=config.agent.projection_backend,
+        dist_kind=config.agent.dist.kind,
         chaos=bool(config.chaos),
         batch_size=int(config.batch_size),
         replay_capacity=config.replay_capacity,
@@ -257,6 +272,52 @@ def negotiate(caps: RequestedCaps) -> Negotiation:
             # composes with ingest through the same host-buffer mirror
             # local collection uses, so nothing refuses here.
             pass
+
+    # ISSUE 16 — fused descent-in-scan tier. Every precondition is a
+    # declared gap, not a trainer assert: the fused kernel pipelines the
+    # NEXT step's tree descent into the loss program, which only exists
+    # where loss and descent are both Pallas programs over a device-
+    # resident tree.
+    if caps.fused_descent:
+        if caps.placement != "device":
+            gap(
+                "fused_descent_device_only",
+                "--fused-descent fuses the device-PER tree descent into "
+                "the megastep's loss kernel; it requires "
+                "--replay-placement device",
+            )
+        elif not caps.prioritized:
+            gap(
+                "fused_descent_requires_per",
+                "--fused-descent pipelines the PRIORITY-tree descent; "
+                "uniform replay has no descent to fuse (drop the flag)",
+            )
+        if caps.dp:
+            gap(
+                "fused_descent_single_device",
+                "--fused-descent is single-device: the sharded megastep "
+                "keeps separate per-shard descent programs (drop the "
+                "flag or --dp)",
+            )
+        if caps.projection != "pallas_fused":
+            gap(
+                "fused_descent_requires_pallas_fused",
+                "--fused-descent extends the pallas_fused loss kernel "
+                "with the descent tile; use --projection pallas_fused",
+            )
+        if caps.dist_kind != "categorical":
+            gap(
+                "fused_descent_categorical_only",
+                "--fused-descent fuses into the CATEGORICAL projection "
+                "kernel; quantile/IQN heads keep the separate-programs "
+                "tier",
+            )
+
+    # Double-buffered ingest staging: meaningful only where a DeviceRing
+    # flush exists on the dispatch path AND is unsharded (the sharded
+    # sync stages per-shard inside its own flush rounds).
+    if caps.ingest_prefetch and (caps.placement != "device" or caps.dp):
+        actions.append("ingest_prefetch_ignored")
 
     if caps.dp_hogwild:
         if not caps.dp:
@@ -545,6 +606,13 @@ SCENARIOS: Tuple[Tuple[str, dict], ...] = (
                              num_envs=0, fleet_wire="bfloat16")),
     ("fleet_mixed_obs_norm", dict(fleet=True, num_envs=2, obs_norm=True,
                                   is_jax_env=False)),
+    # ISSUE 16: the large-batch flagship recipe's full capability ask —
+    # fused descent-in-scan + double-buffered ingest at a wide batch.
+    # device = pass; host/hybrid = declared gaps (the fused tier only
+    # exists where the tree is device-resident).
+    ("large_batch_fused", dict(fused_descent=True, ingest_prefetch=True,
+                               projection="pallas_fused",
+                               batch_size=2048)),
 )
 
 PLACEMENTS = ("host", "device", "hybrid")

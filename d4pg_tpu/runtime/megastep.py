@@ -42,7 +42,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from d4pg_tpu.agent.d4pg import fused_train_scan, gather_batches
+from d4pg_tpu.agent.d4pg import fused_train_scan, gather_batches, train_step
 from d4pg_tpu.agent.state import D4PGConfig, TrainState
 from d4pg_tpu.replay.device_ring import DeviceRing
 
@@ -320,6 +320,92 @@ def megastep_device_per_body(
     )
 
 
+def megastep_device_per_fused_body(
+    config: D4PGConfig, k: int, batch: int, interpret: bool,
+    state: TrainState, ring: DeviceRing, sums_lane: jax.Array,
+    max_priority: jax.Array, key: jax.Array,
+):
+    """The FUSED-TIER device-PER megastep (ISSUE 16): descent + loss as
+    ONE Pallas program per scan step, software-pipelined.
+
+    :func:`megastep_device_per_body` with ``tree_backend="pallas"`` runs
+    the whole [K, B] descent as its own Pallas program before the scan,
+    then K fused-loss programs inside it. The tree is CONSTANT during the
+    scan (priorities write back after it, last-wins), so every step's
+    prefixes are computable up front and the descents commute — which
+    legalizes the pipeline: scan step ``t``'s fused program
+    (``ops/pallas_fused_step.py``) computes loss(t) AND the descent for
+    step ``t+1``'s prefixes; one small prologue descent
+    (``find_prefix_pallas`` on ``pre[0]``) primes step 0. Steady state is
+    one Pallas program per grad step.
+
+    Byte-parity with the separate-programs oracle is structural, not
+    approximate (tests/test_fused_descent.py pins whole-TrainState + tree
+    equality): same PRNG stream (split → fold_in(0) → stratified
+    prefixes), the descent tile is the standalone kernel's ``count_tile``
+    verbatim on the same leaves (exact int32), the IS weights are the
+    same elementwise formula on the same dispatch-start scalars
+    (total/min_ratio/β), and the loss/backward tiles are the fused-loss
+    kernel's own.
+
+    Single-device only (the dp mesh keeps the separate-programs tier —
+    ``replay/source.py`` negotiates the refusal). Returns
+    ``(state, sums_lane', max_priority', key', metrics)``, the
+    :func:`megastep_device_per_body` contract.
+    """
+    from d4pg_tpu.ops.pallas_tree import find_prefix_pallas
+    from d4pg_tpu.replay import device_per as dper
+
+    key, k_draw = jax.random.split(key)
+    local_filled = ring.size  # n_shards == 1: the global fill count
+    half = sums_lane.shape[0] // 2
+    leaves = sums_lane[half:]
+    total = sums_lane[1]
+    # The oracle's exact draw stream: lane_draw(fold_in(k_draw, 0), ...).
+    pre = dper.stratified_prefixes(
+        jax.random.fold_in(k_draw, jnp.int32(0)), k, batch, total
+    )
+    idx0 = jnp.clip(
+        find_prefix_pallas(leaves, pre[0], interpret=interpret),
+        0, jnp.maximum(local_filled - 1, 0),
+    )
+    # Dispatch-start scalars, shared by every step's IS weights — exactly
+    # the separate-programs body's (one β per dispatch, state.step before
+    # the scan).
+    min_ratio = dper.lane_min_leaf(sums_lane) / (jnp.float32(1) * total)
+    beta = dper.beta_at(state.step, config.per_beta0, config.per_beta_steps)
+
+    def body(carry, pre_next):
+        st, idx_t = carry
+        weights = dper.importance_weights(
+            p_leaf=leaves[idx_t], total_local=total,
+            min_ratio_global=min_ratio, n_global=ring.size, n_shards=1,
+            beta=beta,
+        )
+        batches = gather_batches(ring, idx_t)
+        batches["weights"] = weights
+        st, metrics, priorities, idx_raw = train_step(
+            config, st, batches, descent=(leaves, pre_next)
+        )
+        idx_next = jnp.clip(idx_raw, 0, jnp.maximum(local_filled - 1, 0))
+        return (st, idx_next), (metrics, priorities, idx_t)
+
+    # xs[t] = pre[t+1]: step t descends the NEXT step's prefixes. The last
+    # step's descent output (of the rolled-around pre[0]) is discarded.
+    (state, _), (metrics, priorities, idx_all) = jax.lax.scan(
+        body, (state, idx0), jnp.roll(pre, -1, axis=0)
+    )
+    sums_lane, mp_local = dper.write_back_lane(
+        sums_lane, idx_all, priorities, config.per_alpha, config.per_eps,
+        local_capacity=ring.obs.shape[0],
+    )
+    max_priority = jnp.maximum(max_priority, mp_local)
+    return (
+        state, sums_lane, max_priority, key,
+        jax.tree.map(lambda x: x.mean(), metrics),
+    )
+
+
 def _pallas_interpret() -> bool:
     """Pallas kernels run the interpreter off-TPU (the CPU-test mode the
     projection kernels use; d4pg.py:build sets the same switch)."""
@@ -338,6 +424,27 @@ def make_megastep_device_per(
         _device_per_lane_fn(config, k, batch, 1, tree_backend),
         donate_argnums=(0, 2),
     )
+
+
+def make_megastep_device_per_fused(config: D4PGConfig, k: int, batch: int):
+    """Jitted donated-buffer FUSED-TIER device-PER megastep, single
+    device: ``(state, ring, tree, key) -> (state, tree', key', metrics)``
+    — the :func:`make_megastep_device_per` signature, drop-in at the
+    trainer's maker selection, same sentinel budget (one compile per
+    (K, B))."""
+    from d4pg_tpu.replay.device_per import DevicePerTree
+
+    body = partial(
+        megastep_device_per_fused_body, config, k, batch, _pallas_interpret()
+    )
+
+    def lane(state, ring, tree, key):
+        state, sums, mp, key, metrics = body(
+            state, ring, tree.sums[0], tree.max_priority, key
+        )
+        return state, DevicePerTree(sums[None], mp), key, metrics
+
+    return jax.jit(lane, donate_argnums=(0, 2))
 
 
 def _device_per_lane_fn(config, k, b_local, n_shards, tree_backend):

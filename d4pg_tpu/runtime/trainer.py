@@ -410,6 +410,7 @@ class Trainer:
         # has the data-plane contract).
         self._ring = None
         self._ring_sync = None
+        self._ingest_prefetch = False
         self._megastep = None
         self._megastep_warm = False  # first dispatch compiled (guards)
         self._mega_mesh = None
@@ -426,6 +427,7 @@ class Trainer:
             )
             from d4pg_tpu.runtime.megastep import (
                 make_megastep_device_per,
+                make_megastep_device_per_fused,
                 make_megastep_device_per_sharded,
                 make_megastep_hybrid,
                 make_megastep_uniform,
@@ -446,6 +448,13 @@ class Trainer:
                 )
             else:
                 self._ring_sync = DeviceRingSync(self.buffer)
+            # Double-buffered ingest (ISSUE 16): stage the next flush's
+            # first chunk while the megastep runs. Negotiation has already
+            # declared the dp case ignored (ShardedDeviceRingSync has no
+            # stage()), so the hasattr gate is belt-and-braces.
+            self._ingest_prefetch = bool(
+                getattr(config, "ingest_prefetch", False)
+            ) and hasattr(self._ring_sync, "stage")
             if self._placement == "device":
                 K = max(1, config.steps_per_dispatch)
                 if config.prioritized:
@@ -499,6 +508,16 @@ class Trainer:
                         self._megastep = make_megastep_uniform_sharded(
                             agent_cfg, K, config.batch_size, self._mega_mesh
                         )
+                elif config.prioritized and getattr(
+                    config, "fused_descent", False
+                ):
+                    # The ISSUE-16 fused tier: descent + loss as ONE
+                    # Pallas program per scan step (negotiation has
+                    # already proven the combination legal: single
+                    # device, PER, categorical, pallas_fused).
+                    self._megastep = make_megastep_device_per_fused(
+                        agent_cfg, K, config.batch_size
+                    )
                 elif config.prioritized:
                     self._megastep = make_megastep_device_per(
                         agent_cfg, K, config.batch_size,
@@ -606,6 +625,7 @@ class Trainer:
             if self._placement == "device":
                 self._timers.ensure("sample")
                 self._timers.ensure("h2d_stage")
+                self._timers.ensure("ingest_stage")
         self.ckpt = CheckpointManager(f"{config.log_dir}/checkpoints")
         self.grad_steps = 0
         self.env_steps = 0
@@ -1850,6 +1870,15 @@ class Trainer:
                             )
                         )
             self._megastep_warm = True
+            if self._ingest_prefetch:
+                # Double-buffer (ISSUE 16): the dispatch above is async —
+                # the device is still computing — so gather + H2D the next
+                # flush's first chunk NOW and the transfer overlaps the
+                # megastep instead of serializing in front of the next
+                # dispatch. Outside the dispatch guard on purpose: this is
+                # explicit staging, the exempt kind.
+                with self._timers.stage("ingest_stage"):
+                    self._ring_sync.stage()
             return None, metrics, None
         with self._timers.stage("sample"):
             with self._buffer_lock:

@@ -139,6 +139,11 @@ HOT_PATH_FUNCTIONS = (
     # dispatch: one lock hop, token-bucket float math, zero numpy
     # allocation (ISSUE-12 satellite)
     "d4pg_tpu/serve/router.py::Router._admit_tenant",
+    # the ingest double buffer's staging step (ISSUE 16): runs once per
+    # dispatch overlapped with device compute — index buffers are
+    # preallocated in __init__, only the locked gather + the explicit
+    # device_put staging copies remain
+    "d4pg_tpu/replay/device_ring.py::DeviceRingSync.stage",
 )
 
 # The jit-traced bodies of the device-resident data plane (the megastep
@@ -159,6 +164,11 @@ MEGASTEP_FUNCTIONS = (
     # + write-back inside the fused dispatch — a host coercion anywhere
     # in it or in the tree primitives below re-tethers PER to the host.
     "d4pg_tpu/runtime/megastep.py::megastep_device_per_body",
+    # The fused descent-in-scan tier (ISSUE 16): descent + loss as ONE
+    # Pallas program per scan step — the body and the fused kernel's
+    # wrapper both trace into the large-batch megastep dispatch.
+    "d4pg_tpu/runtime/megastep.py::megastep_device_per_fused_body",
+    "d4pg_tpu/ops/pallas_fused_step.py::fused_categorical_loss_descent",
     "d4pg_tpu/replay/device_ring.py::ingest_body",
     "d4pg_tpu/replay/device_ring.py::sharded_ingest_body",
     # The device priority tree's traced primitives (replay/device_per.py):

@@ -245,6 +245,73 @@ def check_shard_microbench(path: str) -> list[str]:
     return errs
 
 
+def check_mfu_sweep(path: str) -> list[str]:
+    """Shape check for ``benchmarks/mfu_sweep_results.json`` beyond the
+    generic benchmark rule: the ISSUE-16 acceptance parses the
+    large-batch recipe row — the REAL ``--p-replay`` training shape must
+    be committed at the MXU-filling batch, at ZERO per-grad-step transfer
+    bytes, with the on-chip ≥2×-flagship-MFU proxy and the ready-to-run
+    recipe command. An artifact regenerated without ``--large-batch`` /
+    ``--large-batch-only`` (dropping the row), or one attesting the fused
+    tier paying per-step traffic, must fail lint."""
+    errs = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: unreadable/invalid JSON ({e})"]
+    if not isinstance(doc, list):
+        return [f"{path}: must be a list of sweep rows"]
+    lb = [
+        r for r in doc
+        if isinstance(r, dict)
+        and str(r.get("config", "")).startswith("large_batch")
+    ]
+    if not lb:
+        return [
+            f"{path}: missing the large-batch recipe row "
+            "(config 'large_batch_*') — regenerate with "
+            "`python benchmarks/mfu_sweep.py --large-batch-only`"
+        ]
+    for row in lb:
+        name = row.get("config")
+        for key in ("batch", "batch_scale", "compute_dtype", "backend",
+                    "steps_per_sec", "transfer_bytes_per_grad_step",
+                    "recipe", "mfu_onchip_proxy"):
+            if key not in row:
+                errs.append(f"{path}: {name} missing {key!r}")
+        if row.get("transfer_bytes_per_grad_step", 1) != 0:
+            errs.append(
+                f"{path}: {name}.transfer_bytes_per_grad_step is "
+                f"{row.get('transfer_bytes_per_grad_step')!r}, must be 0 — "
+                "the fused large-batch tier keeps device placement's "
+                "zero-transfer contract"
+            )
+        if row.get("batch", 0) < 2048:
+            errs.append(
+                f"{path}: {name}.batch is {row.get('batch')!r} — the "
+                "recipe row exists to commit an MXU-filling shape "
+                "(B >= 2048)"
+            )
+        proxy = row.get("mfu_onchip_proxy")
+        if isinstance(proxy, dict):
+            ratio = proxy.get("ratio_vs_flagship")
+            if not (isinstance(ratio, (int, float)) and ratio >= 2.0):
+                errs.append(
+                    f"{path}: {name}.mfu_onchip_proxy.ratio_vs_flagship "
+                    f"is {ratio!r} — the committed shape must sit at "
+                    ">= 2x the flagship MFU"
+                )
+        elif "mfu_onchip_proxy" in row:
+            errs.append(f"{path}: {name}.mfu_onchip_proxy must be an object")
+        if "--fused-descent" not in str(row.get("recipe", "")):
+            errs.append(
+                f"{path}: {name}.recipe must be the ready-to-run "
+                "fused-tier train.py command (expected '--fused-descent')"
+            )
+    return errs
+
+
 def check_composition_matrix(path: str) -> list[str]:
     """Shape + invariants for ``benchmarks/composition_matrix.json`` —
     the ISSUE-13 acceptance artifact:
@@ -577,6 +644,8 @@ def check_tree(root: str) -> list[str]:
             errs.extend(check_multitenant_microbench(path))
         if os.path.basename(path) == "shard_microbench.json":
             errs.extend(check_shard_microbench(path))
+        if os.path.basename(path) == "mfu_sweep_results.json":
+            errs.extend(check_mfu_sweep(path))
         if os.path.basename(path) == "composition_matrix.json":
             errs.extend(check_composition_matrix(path))
         if os.path.basename(path) == "league_soak.json":

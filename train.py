@@ -188,6 +188,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "leave the critical path (one dispatch of priority/"
                         "freshness staleness, same class as "
                         "--steps-per-dispatch)")
+    p.add_argument("--batch-scale", type=int, default=1, metavar="S",
+                   help="the large-batch recipe in one knob: batch x S, "
+                        "lr x S (linear scaling), PER-beta anneal / S "
+                        "(tracks data seen), warmup x S, "
+                        "steps-per-dispatch / S — derived from the B=256 "
+                        "baseline after env presets (docs/data_plane.md "
+                        "'Large-batch recipe')")
+    p.add_argument("--fused-descent", action="store_true",
+                   help="fuse the device-PER tree descent INTO the scan "
+                        "body's loss kernel: one Pallas program per grad "
+                        "step computes loss(t) + the step-(t+1) descent "
+                        "(software pipelining; byte-identical to the "
+                        "separate-programs tier). Requires "
+                        "--replay-placement device --per --projection "
+                        "pallas_fused, single device")
+    p.add_argument("--ingest-prefetch", action="store_true",
+                   help="double-buffer the ring ingest: gather + H2D the "
+                        "next flush's first chunk right after each "
+                        "megastep dispatch, overlapping the transfer with "
+                        "the in-flight compute (device placement; ignored "
+                        "— declared — elsewhere)")
     p.add_argument("--eval-interval", type=int, default=2_000)
     p.add_argument("--eval-episodes", type=int, default=10)
     p.add_argument("--concurrent-eval", dest="concurrent_eval",
@@ -367,6 +388,9 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         batch_size=args.batch_size,
         steps_per_dispatch=args.steps_per_dispatch,
         prefetch=args.prefetch,
+        batch_scale=args.batch_scale,
+        fused_descent=args.fused_descent,
+        ingest_prefetch=args.ingest_prefetch,
         replay_placement=args.replay_placement,
         env_steps_per_train_step=args.env_steps_per_train_step,
         pool_start_method=args.pool_start_method,
@@ -408,9 +432,11 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
     # Env preset always applies (dims, v-range, pixel wiring, pixel-sized
     # replay cap); explicit --v-min/--v-max then beat it. Explicit --rmsize
     # beats the preset cap inside apply_env_preset (non-default wins).
-    from d4pg_tpu.config import apply_env_preset
+    # Batch-scale then derives the large-batch recipe from the preset-
+    # resolved baseline (preset first so the rules scale FINAL values).
+    from d4pg_tpu.config import apply_batch_scale, apply_env_preset
 
-    cfg = apply_env_preset(cfg)
+    cfg = apply_batch_scale(apply_env_preset(cfg))
     if args.v_min is not None or args.v_max is not None:
         dist = dataclasses.replace(
             cfg.agent.dist,
