@@ -60,6 +60,14 @@ Spec syntax (entries separated by ``;`` or ``,``)::
                           clone's verify-on-restore must fall back to
                           the older forked step, never train on torn
                           state)
+    host_kill@8:1         multi-host learner: SIGKILL process 1 of the
+                          process-spanning mesh at its 8th megastep
+                          dispatch (dispatch counts are deterministic
+                          and identical across processes, so every
+                          process agrees on WHEN; survivors block on
+                          the next collective until the supervisor
+                          reaps them and relaunches the full mesh with
+                          --resume — scripts/multihost_smoke.sh)
 
 A ``:<arg>`` that does not parse as a number is kept as a string LABEL
 (``tenant_flood``'s tenant name); numeric args stay floats.
@@ -152,6 +160,13 @@ site                  tick location               recovery proven
                                                   the older copied step,
                                                   logged — never trains
                                                   on torn state
+``host_kill``         trainer, per megastep       victim process dies
+                      dispatch                    mid-mesh; survivors
+                                                  reaped by supervisor,
+                                                  full-mesh relaunch
+                                                  --resumes from the
+                                                  last committed
+                                                  coordinated checkpoint
 ====================  ==========================  =========================
 """
 
@@ -218,6 +233,11 @@ KNOWN_SITES = WORKER_SITES + (
     "variant_kill",
     "controller_kill",
     "clone_corrupt",
+    # multi-host site (docs/multihost.md): ticks in the trainer once per
+    # megastep dispatch — deterministic and identical on every process of
+    # the spanning mesh — and SIGKILLs the process whose index matches
+    # the ``:<arg>`` victim (default 0).
+    "host_kill",
 )
 
 # Sites whose ``:<arg>`` is a string label, not a number (the flood's

@@ -271,6 +271,20 @@ class TrainConfig:
 
     # distribution
     dp: Optional[int] = None           # None → single device
+    # Multi-host (ISSUE 17, docs/multihost.md): how many jax.distributed
+    # processes share the mesh. 1 = single-controller (every existing
+    # path, unchanged). Set by train.py from the bring-up result — the
+    # capability negotiation (replay/source.py) uses it to declare the
+    # multihost composition rules, and the trainer uses it to size the
+    # process-LOCAL replay shard (replay_capacity / num_processes) and
+    # select the per-host flusher.
+    num_processes: int = 1
+    # Canonical run directory for SHARED artifacts (checkpoints, replay
+    # snapshot, trainer_meta) on a multi-host run: secondary processes log
+    # under log_dir/workerN but must checkpoint-restore from the SAME
+    # directory process 0 saves into. None = log_dir (single-host, and
+    # process 0 of a multi-host run).
+    run_root: Optional[str] = None
     # Hogwild-staleness DP (SURVEY §2.2): each replica runs the K
     # steps_per_dispatch window on its own diverging param copy (no
     # per-step gradient sync), then one param/optimizer pmean resyncs —

@@ -23,7 +23,13 @@ from d4pg_tpu.parallel.partition import (
     shard_train_state,
     stack_axes_for,
 )
-from d4pg_tpu.parallel.distributed import initialize_distributed
+from d4pg_tpu.parallel.distributed import (
+    gather_global,
+    host_allgather_i64,
+    initialize_distributed,
+    local_shard_span,
+    stage_global,
+)
 
 __all__ = [
     "make_mesh",
@@ -41,4 +47,8 @@ __all__ = [
     "shard_train_state",
     "stack_axes_for",
     "initialize_distributed",
+    "gather_global",
+    "host_allgather_i64",
+    "local_shard_span",
+    "stage_global",
 ]

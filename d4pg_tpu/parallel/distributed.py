@@ -5,11 +5,59 @@ The reference's multi-"node" story is forked processes on one box
 every TPU-VM host runs the same program, ``jax.devices()`` spans the whole
 slice, and the collectives emitted by the jitted train step ride ICI within
 a slice and DCN across slices — no NCCL/MPI/process groups to manage.
+
+This module is also the CPU-virtual-mesh story (the dryrun discipline): a
+2-process × 4-CPU-device mesh on one box is the same multi-controller
+program as a pod, provided the CPU backend's cross-process collectives are
+switched on (gloo) BEFORE ``jax.distributed.initialize`` — the default CPU
+collective implementation refuses process-spanning computations outright.
+
+Beyond bring-up it carries the primitives every multi-host data-plane
+path reuses:
+
+- :func:`stage_global` — place a host value onto a process-spanning mesh
+  sharding with no collective (each process fills only its addressable
+  shards). The mandatory placement path multi-host: ``jax.device_put``'s
+  per-leaf agreement broadcasts deadlock against in-flight transfer
+  programs under gloo.
+- :func:`gather_global` — fetch a process-spanning array whole. A plain
+  ``jax.device_get`` raises on arrays that span non-addressable devices;
+  the portable gather is one jitted identity with a replicated
+  ``out_sharding`` (an all-gather over the mesh) followed by the local
+  fetch. Fully-addressable arrays skip the collective entirely, so
+  single-process behavior is byte- and cost-identical to before.
+- :func:`local_shard_span` — the contiguous [lo, hi) range of global mesh
+  shards this process owns along an axis. Process-contiguity is the layout
+  invariant the striped replay dealing relies on; it is asserted, not
+  assumed.
+- :func:`host_allgather_i64` — exact int64 cross-host agreement on small
+  host integers (replay cursors, flush rounds), split into uint32 halves so
+  the x64-disabled JAX default cannot truncate a long run's window counts.
 """
 
 from __future__ import annotations
 
+import os
+
 import jax
+import numpy as np
+
+
+def _enable_cpu_collectives() -> None:
+    """Switch the CPU backend's cross-process collectives on (gloo).
+
+    Must happen before ``jax.distributed.initialize``: the default CPU
+    collective implementation raises "Multiprocess computations aren't
+    implemented" at the first process-spanning dispatch. Gated to
+    CPU-platform runs so TPU pods keep their native ICI/DCN path.
+    """
+    platforms = jax.config.jax_platforms or os.environ.get("JAX_PLATFORMS", "")
+    if "cpu" in (platforms or "").lower():
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception as e:  # pragma: no cover - jaxlib without gloo
+            print(f"[distributed] gloo CPU collectives unavailable: {e}",
+                  flush=True)
 
 
 def initialize_distributed(
@@ -35,12 +83,14 @@ def initialize_distributed(
                 f"--num-processes {num_processes} needs a coordinator: pass "
                 "--coordinator HOST:PORT or set D4PG_COORDINATOR"
             )
+        _enable_cpu_collectives()
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
         )
     elif autodetect:
+        _enable_cpu_collectives()
         jax.distributed.initialize()
     return {
         "process_index": jax.process_index(),
@@ -48,3 +98,118 @@ def initialize_distributed(
         "local_device_count": jax.local_device_count(),
         "global_device_count": jax.device_count(),
     }
+
+
+# One jitted identity-with-replicated-output per mesh: the portable
+# "assemble whole" program. Keyed by Mesh (hashable); input shapes vary
+# freely under the one callable.
+_GATHER_PROGRAMS: dict = {}
+
+
+def gather_global(x):
+    """Fetch a jax.Array fully assembled to host numpy, mesh-layout- and
+    process-count-independent.
+
+    Fully-addressable arrays (every single-process array, and replicated
+    arrays on any topology) take the direct ``device_get`` — no collective,
+    no compile. Arrays spanning non-addressable devices are first
+    all-gathered by a jitted identity with replicated ``out_shardings``
+    (every process participates — CALL THIS FROM ALL PROCESSES), then
+    fetched locally.
+    """
+    if not isinstance(x, jax.Array) or x.is_fully_addressable:
+        return np.asarray(jax.device_get(x))
+    mesh = getattr(x.sharding, "mesh", None)
+    if mesh is None:
+        raise TypeError(
+            f"gather_global: non-addressable array with non-named sharding "
+            f"{x.sharding!r} — cannot derive a mesh to gather over"
+        )
+    fn = _GATHER_PROGRAMS.get(mesh)
+    if fn is None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        fn = jax.jit(
+            lambda a: a, out_shardings=NamedSharding(mesh, PartitionSpec())
+        )
+        _GATHER_PROGRAMS[mesh] = fn
+    return np.asarray(jax.device_get(fn(x)))
+
+
+def stage_global(mesh, spec, value):
+    """Place a host value onto a (possibly process-spanning) mesh sharding
+    with NO cross-process coordination: every process materializes only
+    the shards it can address, sliced out of its local copy of ``value``
+    (``make_array_from_callback``).
+
+    This is the mandatory placement path on a multi-host mesh, not a
+    fast path. ``jax.device_put`` of a host value onto a non-addressable
+    sharding verifies value agreement with a per-leaf broadcast
+    collective (``multihost_utils.assert_equal``), and under the gloo
+    CPU backend those per-leaf broadcasts interleave with the deferred
+    transfer programs of *earlier* leaves — a cross-process rendezvous
+    ordering that deadlocks a many-leaf placement (a TrainState) with
+    processes stuck on different collectives. The callback form issues
+    no collective at all; the caller guarantees SPMD agreement on
+    ``value`` where the spec replicates (identical seeds / identical
+    restored bytes — docs/multihost.md).
+    """
+    from jax.sharding import NamedSharding
+
+    arr = np.asarray(value)
+    return jax.make_array_from_callback(
+        arr.shape, NamedSharding(mesh, spec), lambda idx: arr[idx]
+    )
+
+
+def local_shard_span(mesh, axis: str = "dp") -> tuple[int, int]:
+    """The contiguous ``[lo, hi)`` range of global ``axis`` shards whose
+    devices this process owns.
+
+    The striped replay layout deals global shard ``d`` to the process
+    owning device ``d`` along the axis, and the per-host snapshot math
+    assumes process ``p`` owns shards ``[p*L, (p+1)*L)`` — true for
+    ``jax.devices()`` order (process-major) and asserted here so a future
+    exotic mesh layout fails loudly instead of corrupting the deal.
+    """
+    axis_idx = list(mesh.axis_names).index(axis)
+    devs = np.moveaxis(mesh.devices, axis_idx, 0)
+    pid = jax.process_index()
+    local = [
+        k for k in range(devs.shape[0])
+        if all(d.process_index == pid for d in devs[k].ravel())
+    ]
+    if not local:
+        raise ValueError(
+            f"process {pid} owns no complete shard along mesh axis {axis!r}"
+        )
+    lo, hi = local[0], local[-1] + 1
+    if local != list(range(lo, hi)):
+        raise ValueError(
+            f"process {pid}'s shards along {axis!r} are not contiguous: "
+            f"{local} — the striped per-host deal requires process-major "
+            "device order"
+        )
+    return lo, hi
+
+
+def host_allgather_i64(values) -> np.ndarray:
+    """Exact all-gather of a small int64 vector across processes:
+    ``[n] -> [process_count, n]``, row ``p`` = process ``p``'s values.
+
+    Split into uint32 halves before riding ``process_allgather`` so the
+    x64-disabled JAX default cannot silently truncate counts past 2**31
+    (a week of 100k-windows/s ingest overflows int32). Single-process
+    returns ``values[None]`` with no device round-trip.
+    """
+    vals = np.asarray(values, dtype=np.int64).reshape(-1)
+    if jax.process_count() == 1:
+        return vals[None]
+    from jax.experimental import multihost_utils
+
+    lo = (vals & 0xFFFFFFFF).astype(np.uint32)
+    hi = ((vals >> 32) & 0xFFFFFFFF).astype(np.uint32)
+    g = np.asarray(
+        multihost_utils.process_allgather(np.stack([lo, hi], axis=1))
+    ).astype(np.int64)
+    return (g[..., 1] << 32) | g[..., 0]

@@ -276,15 +276,34 @@ def make_shard_and_gather_fns(specs, mesh: Mesh):
     array fully assembled to host numpy — the checkpoint-save path, so
     Orbax always serializes whole logical arrays regardless of mesh
     layout and a checkpoint written on one mesh restores onto any other.
+    On a process-spanning mesh the fetch routes through
+    :func:`~d4pg_tpu.parallel.distributed.gather_global` (a bare
+    ``device_get`` raises on arrays spanning non-addressable devices), so
+    gathering is a COLLECTIVE there: every process must apply the same
+    gather_fns in the same order.
     """
+    from d4pg_tpu.parallel.distributed import gather_global, stage_global
+
     is_spec = lambda x: isinstance(x, P)  # noqa: E731 - tree_map leaf test
-    shard_fns = jax.tree_util.tree_map(
-        lambda s: partial(jax.device_put, device=NamedSharding(mesh, s)),
-        specs,
-        is_leaf=is_spec,
-    )
+    if jax.process_count() > 1:
+        # Multi-host placement MUST go through the collective-free
+        # callback path: device_put onto a non-addressable sharding
+        # verifies SPMD agreement with a per-leaf broadcast, and those
+        # broadcasts deadlock against the deferred transfer programs of
+        # earlier leaves under gloo (distributed.stage_global).
+        shard_fns = jax.tree_util.tree_map(
+            lambda s: partial(stage_global, mesh, s),
+            specs,
+            is_leaf=is_spec,
+        )
+    else:
+        shard_fns = jax.tree_util.tree_map(
+            lambda s: partial(jax.device_put, device=NamedSharding(mesh, s)),
+            specs,
+            is_leaf=is_spec,
+        )
     gather_fns = jax.tree_util.tree_map(
-        lambda s: lambda x: np.asarray(jax.device_get(x)),
+        lambda s: lambda x: gather_global(x),
         specs,
         is_leaf=is_spec,
     )

@@ -117,6 +117,17 @@ def _place_tree(tree: DevicePerTree, mesh) -> DevicePerTree:
     from d4pg_tpu.parallel.partition import tree_partition_specs
 
     specs = tree_partition_specs(tree)
+    if jax.process_count() > 1:
+        # Collective-free multi-host placement (the host-built tree is
+        # SPMD-identical on every process — same sidecar bytes / same
+        # seeds): device_put onto non-addressable shardings fires a
+        # per-leaf agreement broadcast that deadlocks against in-flight
+        # transfer programs under gloo (distributed.stage_global).
+        from d4pg_tpu.parallel.distributed import stage_global
+
+        return DevicePerTree(
+            *(stage_global(mesh, spec, leaf) for leaf, spec in zip(tree, specs))
+        )
     return DevicePerTree(
         *(
             jax.device_put(leaf, NamedSharding(mesh, spec))
@@ -417,8 +428,13 @@ class DevicePerSync:
         """Fetch the α-exponentiated leaf priorities in HOST slot order
         (``[capacity]`` f32) plus the pre-α max priority — the replay
         snapshot's priority sidecar (cold path: one D2H per checkpoint,
-        never per step)."""
-        sums = np.asarray(jax.device_get(self.tree.sums))
+        never per step). On a process-spanning mesh the fetch routes
+        through ``gather_global`` (a bare ``device_get`` raises on arrays
+        spanning non-addressable devices), making this a COLLECTIVE there:
+        every process must call it at the same point."""
+        from d4pg_tpu.parallel.distributed import gather_global
+
+        sums = gather_global(self.tree.sums)
         half = sums.shape[1] // 2
         lanes = sums[:, half: half + self.local_capacity]  # [S, local_cap]
         out = np.zeros(self.capacity, np.float32)

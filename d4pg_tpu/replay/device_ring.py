@@ -116,6 +116,16 @@ def device_ring_init(
             f"{n_shards}"
         )
     specs = ring_partition_specs(ring)
+    if jax.process_count() > 1:
+        # Collective-free placement (parallel/distributed.stage_global):
+        # device_put onto non-addressable shardings fires a per-leaf
+        # agreement broadcast that deadlocks against in-flight transfer
+        # programs under the gloo CPU backend.
+        from d4pg_tpu.parallel.distributed import stage_global
+
+        return DeviceRing(
+            *(stage_global(mesh, spec, leaf) for leaf, spec in zip(ring, specs))
+        )
     return DeviceRing(
         *(
             jax.device_put(leaf, NamedSharding(mesh, spec))
@@ -546,3 +556,253 @@ class ShardedDeviceRingSync:
             self.chunks_ingested += 1
         self._synced = total
         return ring
+
+
+# ------------------------------------------------------ multi-host variant
+class MultihostRingSync:
+    """Per-host flusher for a PROCESS-SPANNING sharded ring (ISSUE 17).
+
+    Same striped layout and the same compiled ingest program as
+    :class:`ShardedDeviceRingSync`, but the mesh's ``dp`` shards live on
+    ``P = jax.process_count()`` processes and each process owns a
+    process-LOCAL host :class:`~d4pg_tpu.replay.uniform.ReplayBuffer` of
+    capacity ``C/P`` — its own ingest servers/collectors feed it, nothing
+    crosses hosts on the write path. The layout algebra that makes this
+    exact: with process-major device order, process ``p`` owns global
+    shards ``[p*L, (p+1)*L)`` (``L`` local devices, ``D = P*L`` total), so
+    a LOCAL buffer striped over ``L`` lanes is precisely the global striped
+    ring restricted to ``p``'s shards — local slot ``m`` IS global slot
+    ``(m//L)*D + p*L + (m%L)``, and host ``p``'s ``k``-th local write is
+    global write ``(k//L)*D + p*L + (k%L)`` of the interleaved stream.
+
+    Every flush is a COLLECTIVE: the ingest program scatters into all
+    ``D`` shards, so all processes must dispatch it the same number of
+    times with the same fill count. Cross-host cursor agreement does that
+    with one small host all-gather per flush — each process contributes
+    ``(local total_added, local rounds needed)``; everyone runs
+    ``max(rounds)`` rounds (processes with nothing pending ship all-pad
+    chunks, dropped by the scatter) and commits the agreed global fill
+    count, the largest gapless prefix of the interleaved global write
+    stream derivable from the gathered cursors. Chunk staging uses
+    ``jax.make_array_from_callback``: the callback runs only for this
+    process's ADDRESSABLE shards, so each host stages exactly its local
+    sub-chunks — per-host ingest H2D, no cross-host replay bytes ever.
+    """
+
+    def __init__(self, buffer, mesh, chunk_cap: int = 4096):
+        from d4pg_tpu.parallel.distributed import local_shard_span
+
+        self._buffer = buffer
+        self._mesh = mesh
+        self.n_shards = int(mesh.shape["dp"])            # D (global)
+        self.n_processes = int(jax.process_count())      # P
+        lo, hi = local_shard_span(mesh, "dp")
+        self.shard_lo = lo
+        self.local_shards = hi - lo                      # L
+        self.host_capacity = int(buffer.capacity)        # C/P (local buffer)
+        self.capacity = self.host_capacity * self.n_processes  # C (global)
+        if self.capacity % self.n_shards:
+            raise ValueError(
+                f"multihost ring: global capacity {self.capacity} not "
+                f"divisible by dp={self.n_shards}"
+            )
+        if self.host_capacity % max(self.local_shards, 1):
+            raise ValueError(
+                f"multihost ring: local capacity {self.host_capacity} not "
+                f"divisible by local shard count {self.local_shards}"
+            )
+        self.local_capacity = self.capacity // self.n_shards  # rows/shard
+        self.chunk_local = int(
+            min(max(1, chunk_cap // self.n_shards), self.local_capacity)
+        )
+        self._synced = 0
+        obs_dim = buffer.obs.shape[1]
+        act_dim = buffer.action.shape[1]
+        self._ingest = make_sharded_ingest(
+            mesh, self.chunk_local, obs_dim, act_dim
+        )
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self._chunk_sharding = {
+            k: NamedSharding(mesh, s) for k, s in sharded_chunk_specs().items()
+        }
+        self._slots_sharding = NamedSharding(mesh, P("dp", None))
+        self._scalar_sharding = NamedSharding(mesh, P())
+        # Per-HOST H2D accounting: only the bytes this process staged for
+        # its local shards (the bench sums hosts for the aggregate).
+        self.bytes_ingested = 0
+        self.chunks_ingested = 0
+        self.tree_hook = None
+
+    @property
+    def ingest_fn(self):
+        """The jitted ingest entry point (recompile-sentinel tracking)."""
+        return self._ingest
+
+    def pending(self) -> int:
+        return min(self._buffer.total_added - self._synced, self.host_capacity)
+
+    def _stage(self, local_rows: np.ndarray, sharding):
+        """Stage ``[L, chunk_local, ...]`` local lane rows as the global
+        ``[D, chunk_local, ...]`` chunk array: the callback materializes
+        only this process's addressable shard slices."""
+        base = self.shard_lo
+        shape = (self.n_shards,) + local_rows.shape[1:]
+
+        def cb(idx):
+            d = idx[0].start if idx[0].start is not None else 0
+            return local_rows[d - base:d - base + 1]
+
+        return jax.make_array_from_callback(shape, sharding, cb)
+
+    def _stage_scalar(self, value):
+        """Replicated scalar via the same collective-free callback path —
+        ``device_put`` onto a process-spanning replicated sharding fires
+        an agreement broadcast per call (see distributed.stage_global)."""
+        arr = np.asarray(value)
+        return jax.make_array_from_callback(
+            arr.shape, self._scalar_sharding, lambda idx: arr[idx]
+        )
+
+    def _gapless_total(self, totals: np.ndarray) -> int:
+        """The largest global write count ``T`` such that every one of the
+        first ``T`` interleaved global writes has landed, given each host's
+        local ``total_added``: host ``p``'s cursor ``t_p`` means its shard
+        ``p*L + s`` has received ``ceil((t_p - s)/L)`` writes, and the
+        gapless prefix ends at the first shard still short —
+        ``min_d(writes_d * D + d)``. Exact under the lock-step deal (equals
+        the true total); conservative under skewed per-host feeds (never
+        counts a row some host has not written)."""
+        D, L = self.n_shards, self.local_shards
+        best = None
+        for p in range(self.n_processes):
+            t = int(totals[p])
+            for s in range(L):
+                d = p * L + s
+                writes = max(-(-(t - s) // L), 0)
+                cand = writes * D + d
+                if best is None or cand < best:
+                    best = cand
+        return int(best)
+
+    def flush(self, ring: DeviceRing) -> DeviceRing:
+        """Mirror pending LOCAL host writes into this process's shards of
+        the global ``ring`` (consumed — donated). Collective: every
+        process of the mesh must call this at the same point; the embedded
+        cursor all-gather agrees on rounds and fill count."""
+        from d4pg_tpu.parallel.distributed import host_allgather_i64
+
+        buf = self._buffer
+        L, cl = self.local_shards, self.chunk_local
+        total = buf.total_added
+        n_pending = min(total - self._synced, self.host_capacity)
+        first = total - n_pending
+        pend = (first + np.arange(n_pending)) % self.host_capacity
+        by_lane = [pend[pend % L == s] // L for s in range(L)]
+        my_rounds = (
+            -(-max(len(b) for b in by_lane) // cl) if n_pending > 0 else 0
+        )
+        agreed = host_allgather_i64([total, my_rounds])   # [P, 2]
+        rounds = int(agreed[:, 1].max())
+        if rounds == 0:
+            return ring
+        new_size = np.int32(
+            min(self._gapless_total(agreed[:, 0]), self.capacity)
+        )
+        for r in range(rounds):
+            slots = np.full((L, cl), self.local_capacity, np.int32)
+            gidx = np.zeros((L, cl), np.int64)
+            for s in range(L):
+                part = by_lane[s][r * cl:(r + 1) * cl]
+                # LOCAL lane rows are GLOBAL shard rows: lane s row i is
+                # local slot i*L + s = global slot i*D + (base + s), i.e.
+                # shard (base+s) local row i — identical row index, so the
+                # local deal needs no re-mapping.
+                slots[s, : len(part)] = part
+                gidx[s, : len(part)] = part * L + s
+            chunk = {
+                k: np.asarray(v).reshape((L, cl) + v.shape[1:])
+                for k, v in dict(buf.gather(gidx.ravel())).items()
+            }
+            dev_chunk = {
+                k: self._stage(v, self._chunk_sharding[k])
+                for k, v in chunk.items()
+            }
+            slots_dev = self._stage(slots, self._slots_sharding)
+            ring = self._ingest(
+                ring,
+                dev_chunk,
+                slots_dev,
+                self._stage_scalar(new_size),
+            )
+            if self.tree_hook is not None:
+                self.tree_hook(slots_dev)
+            self.bytes_ingested += sum(v.nbytes for v in chunk.values())
+            self.bytes_ingested += slots.nbytes + new_size.nbytes
+            self.chunks_ingested += 1
+        self._synced = total
+        return ring
+
+    # ---------------------------------------------------------- snapshots
+    def gather_snapshot(self, ring: DeviceRing) -> dict:
+        """Assemble the GLOBAL ring into the exact
+        :meth:`~d4pg_tpu.replay.uniform.ReplayBuffer.snapshot` npz layout
+        (rows ``[0, size)`` in global slot order + ``pos``/``size``), so a
+        multi-host checkpoint restores onto ANY topology — single-process
+        ``ReplayBuffer.restore`` included. Collective (the per-field
+        gathers all-gather across processes): every process must call it;
+        process 0 writes the file. Call after :meth:`flush` so unmirrored
+        local rows are not silently dropped from the snapshot."""
+        from d4pg_tpu.parallel.distributed import (
+            gather_global,
+            host_allgather_i64,
+        )
+
+        totals = host_allgather_i64([self._buffer.total_added])[:, 0]
+        T = self._gapless_total(totals)
+        size = int(min(T, self.capacity))
+        pos = int(T % self.capacity)
+        D = self.n_shards
+        perm = striped_perm(self.capacity, D).reshape(-1)
+        out = {"pos": np.asarray(pos), "size": np.asarray(size)}
+        for name in ("obs", "action", "reward", "next_obs", "discount"):
+            lanes = gather_global(getattr(ring, name))
+            host = np.empty_like(lanes)
+            host[perm] = lanes
+            out[name] = host[:size]
+        return out
+
+    def deal_snapshot(self, data) -> int:
+        """Restore this process's share of a GLOBAL replay snapshot (the
+        :meth:`gather_snapshot` / single-process ``ReplayBuffer.snapshot``
+        layout) into the LOCAL host buffer; returns the local row count.
+        Inverse of the striped deal: global total ``T`` puts
+        ``t_p = (T//D)*L + clip(T%D - p*L, 0, L)`` writes on host ``p``,
+        and local slot ``m`` reads global slot ``(m//L)*D + p*L + (m%L)``.
+        Host-local (no collective); resets ``_synced`` so the next flush
+        re-mirrors the restored rows."""
+        size = int(np.asarray(data["size"]).item())
+        pos = int(np.asarray(data["pos"]).item())
+        # Same lifetime-counter reconstruction rule as ReplayBuffer.restore.
+        T = pos + self.capacity if size == self.capacity else size
+        D, L, base = self.n_shards, self.local_shards, self.shard_lo
+        t_p = (T // D) * L + int(np.clip(T % D - base, 0, L))
+        n_local = min(t_p, self.host_capacity)
+        m = np.arange(n_local)
+        j = (m // L) * D + base + (m % L)
+        local = {
+            name: np.asarray(data[name])[j]
+            for name in ("obs", "action", "reward", "next_obs", "discount")
+        }
+        local["pos"] = np.asarray(t_p % self.host_capacity)
+        local["size"] = np.asarray(n_local)
+        buf = self._buffer
+        with buf._lock:
+            buf._restore_arrays(local)
+            # _restore_arrays reconstructs the lifetime counter as
+            # pos+capacity on a full local ring — pin the exact cursor we
+            # derived instead, so the next cursor agreement sees the same
+            # T on every host.
+            buf._total_added = t_p
+        self._synced = 0
+        return n_local

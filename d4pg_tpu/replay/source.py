@@ -123,6 +123,11 @@ class RequestedCaps:
     chaos: bool = False
     batch_size: int = 256
     replay_capacity: Optional[int] = None
+    # Multi-host (ISSUE 17): how many jax.distributed processes share the
+    # mesh. 1 = single-controller. >1 requires the dp-sharded device data
+    # plane (the striped layout is what makes per-host replay shards
+    # exact), with dp and capacity dealt evenly across processes.
+    processes: int = 1
     # League variant id (ISSUE 15): which population member this learner
     # IS. 0 = the default/pre-league variant; the fleet HELLO negotiates
     # it so an actor host assigned to variant A can never stream into
@@ -162,6 +167,7 @@ def from_train_config(config, *, on_device: bool = False,
         chaos=bool(config.chaos),
         batch_size=int(config.batch_size),
         replay_capacity=config.replay_capacity,
+        processes=int(getattr(config, "num_processes", 1) or 1),
         variant=int(getattr(config, "variant_id", None) or 0),
         is_jax_env=is_jax_env,
     )
@@ -272,6 +278,39 @@ def negotiate(caps: RequestedCaps) -> Negotiation:
             # composes with ingest through the same host-buffer mirror
             # local collection uses, so nothing refuses here.
             pass
+
+    # ISSUE 17 — the process-spanning mesh. Every structural requirement
+    # is a declared gap: multihost exists only where the dp-sharded device
+    # data plane's striped layout makes per-host replay shards exact.
+    if caps.processes > 1:
+        if caps.placement != "device":
+            gap(
+                "multihost_device_placement_only",
+                "--num-processes > 1 requires --replay-placement device: "
+                "per-host replay shards ride the sharded ring's striped "
+                "layout (host/hybrid keep a single global host buffer "
+                "no process owns)",
+            )
+        if not caps.dp:
+            gap(
+                "multihost_requires_dp",
+                "--num-processes > 1 requires --dp: the multi-host mesh "
+                "IS the dp-sharded megastep mesh spanning processes",
+            )
+        elif caps.dp % caps.processes:
+            gap(
+                "multihost_dp_not_divisible",
+                f"--dp {caps.dp} must be divisible by --num-processes "
+                f"{caps.processes} (each process owns dp/num_processes "
+                "contiguous mesh shards)",
+            )
+        if caps.replay_capacity and caps.replay_capacity % caps.processes:
+            gap(
+                "multihost_capacity_not_divisible",
+                f"replay capacity {caps.replay_capacity} must be "
+                f"divisible by --num-processes {caps.processes} (each "
+                "process owns a capacity/num_processes local shard)",
+            )
 
     # ISSUE 16 — fused descent-in-scan tier. Every precondition is a
     # declared gap, not a trainer assert: the fused kernel pipelines the

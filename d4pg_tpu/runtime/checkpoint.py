@@ -50,9 +50,34 @@ class CheckpointManager:
     def __init__(self, directory: str, max_to_keep: int = 3):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
+        kwargs = {}
+        if jax.process_count() > 1:
+            # Multi-host (docs/multihost.md): the trainer gathers every
+            # leaf whole and gates all writes to process 0, so Orbax must
+            # NOT run its own cross-process save barriers — a proc-0-only
+            # save would block forever waiting for processes that never
+            # call it. Each process gets a SINGLETON coordination domain
+            # (itself): saves are proc-0-only by the trainer's gating,
+            # restores are plain reads every process performs
+            # independently on the shared directory.
+            from orbax.checkpoint import options as _ocp_options
+
+            pid = jax.process_index()
+            kwargs["multiprocessing_options"] = (
+                _ocp_options.MultiprocessingOptions(
+                    primary_host=pid,
+                    active_processes={pid},
+                    barrier_sync_key_prefix=f"proc{pid}",
+                )
+            )
+            # create=True is unsupported with active_processes; the
+            # makedirs above already guarantees the directory.
+            kwargs["create"] = False
         self._mgr = ocp.CheckpointManager(
             self.directory,
-            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, **kwargs
+            ),
         )
 
     def save(self, step: int, state: TrainState) -> None:

@@ -264,6 +264,28 @@ class Trainer:
         # host RAM; [0,1] floats round-trip through ×255)
         obs_dim, act_dim = agent_cfg.obs_dim, agent_cfg.action_dim
         obs_dtype = np.uint8 if agent_cfg.pixel_shape else np.float32
+        # Multi-host topology (docs/multihost.md): under a process-spanning
+        # mesh each process owns the 1/P of everything that lives on its
+        # local devices — the host replay buffer shrinks to capacity/P rows
+        # (its striped local layout tiles the global ring restricted to
+        # this process's contiguous dp shards), while shared artifacts
+        # (checkpoints, trainer meta, replay snapshot, PER sidecar) read
+        # and write through the canonical run_root with process 0 as the
+        # only writer. Single-process: all of this collapses to the
+        # existing behavior bit-for-bit.
+        self._procs = jax.process_count()
+        self._proc_idx = jax.process_index()
+        self._shared_dir = config.run_root or config.log_dir
+        host_replay_capacity = config.replay_capacity
+        if self._procs > 1:
+            if config.replay_capacity % self._procs:
+                # negotiation's multihost_capacity_not_divisible gap already
+                # refused this; belt-and-braces for direct Trainer use
+                raise ValueError(
+                    f"replay_capacity {config.replay_capacity} not "
+                    f"divisible by {self._procs} processes"
+                )
+            host_replay_capacity = config.replay_capacity // self._procs
         # Envs declare their pixel convention once; only [0,1] floats
         # (obs_scale 255.0) are accepted — byte-image envs must normalize at
         # the env boundary (ReplayBuffer raises otherwise).
@@ -282,7 +304,7 @@ class Trainer:
             # trees to maintain — the descent, IS weights, and write-back
             # never touch the host.
             self.buffer = ReplayBuffer(
-                config.replay_capacity,
+                host_replay_capacity,
                 obs_dim,
                 act_dim,
                 obs_dtype=obs_dtype,
@@ -291,7 +313,7 @@ class Trainer:
             )
         elif config.prioritized:
             self.buffer = PrioritizedReplayBuffer(
-                config.replay_capacity,
+                host_replay_capacity,
                 obs_dim,
                 act_dim,
                 alpha=agent_cfg.per_alpha,
@@ -305,7 +327,7 @@ class Trainer:
             )
         else:
             self.buffer = ReplayBuffer(
-                config.replay_capacity,
+                host_replay_capacity,
                 obs_dim,
                 act_dim,
                 obs_dtype=obs_dtype,
@@ -442,7 +464,19 @@ class Trainer:
                 config.replay_capacity, obs_dim, act_dim,
                 mesh=self._mega_mesh,
             )
-            if self._mega_mesh is not None:
+            if self._mega_mesh is not None and self._procs > 1:
+                # Multi-host: each process's host buffer feeds only its
+                # LOCAL dp shards through make_array_from_callback staging;
+                # flush agrees on per-host cursors via a host allgather so
+                # the ingest dispatch count stays SPMD-collective even
+                # when collection rates skew (replay/device_ring.py:
+                # MultihostRingSync).
+                from d4pg_tpu.replay.device_ring import MultihostRingSync
+
+                self._ring_sync = MultihostRingSync(
+                    self.buffer, self._mega_mesh
+                )
+            elif self._mega_mesh is not None:
                 self._ring_sync = ShardedDeviceRingSync(
                     self.buffer, self._mega_mesh
                 )
@@ -531,7 +565,18 @@ class Trainer:
                 # split inside the jitted call — steady state has no host
                 # operand at all (this one device_put is setup, not loop).
                 self.key, mk = jax.random.split(self.key)
-                if self._mega_mesh is not None:
+                if self._mega_mesh is not None and self._procs > 1:
+                    # Replicated placement without the device_put
+                    # agreement broadcast (identical seeds guarantee the
+                    # SPMD value; see distributed.stage_global).
+                    from jax.sharding import PartitionSpec
+
+                    from d4pg_tpu.parallel.distributed import stage_global
+
+                    self._megastep_key = stage_global(
+                        self._mega_mesh, PartitionSpec(), mk
+                    )
+                elif self._mega_mesh is not None:
                     from jax.sharding import NamedSharding, PartitionSpec
 
                     self._megastep_key = jax.device_put(
@@ -626,7 +671,7 @@ class Trainer:
                 self._timers.ensure("sample")
                 self._timers.ensure("h2d_stage")
                 self._timers.ensure("ingest_stage")
-        self.ckpt = CheckpointManager(f"{config.log_dir}/checkpoints")
+        self.ckpt = CheckpointManager(f"{self._shared_dir}/checkpoints")
         self.grad_steps = 0
         self.env_steps = 0
         self.ewma_return: Optional[float] = None
@@ -681,7 +726,7 @@ class Trainer:
                 print(f"[checkpoint] fallback: {fb}")
             print(f"[checkpoint] resumed from step {restored_step}")
             self.grad_steps = int(jax.device_get(self.state.step))
-            m = self._restored_meta = load_trainer_meta(config.log_dir)
+            m = self._restored_meta = load_trainer_meta(self._shared_dir)
             # env_steps drives the noise-decay schedule; without it a
             # resumed run would re-explore at full scale
             self.env_steps = int(m.get("env_steps", 0))
@@ -715,7 +760,21 @@ class Trainer:
             snap = self._replay_snapshot_path()
             if config.snapshot_replay and os.path.exists(snap):
                 try:
-                    n = self.buffer.restore(snap)
+                    if self._procs > 1 and hasattr(
+                        self._ring_sync, "deal_snapshot"
+                    ):
+                        # Multi-host resume: the canonical snapshot holds
+                        # the GLOBAL ring in global slot order; every
+                        # process deals out only the rows its local dp
+                        # shards own — the same striped assignment a
+                        # fresh run would have produced write-by-write,
+                        # so the topology can change between runs
+                        # (2 hosts → 1 → 2) and the mirrored ring stays
+                        # byte-identical.
+                        with np.load(snap) as z:
+                            n = self._ring_sync.deal_snapshot(z)
+                    else:
+                        n = self.buffer.restore(snap)
                     self._replay_restored = True
                     print(f"restored replay snapshot: {n} transitions")
                 except (OSError, ValueError, KeyError, zipfile.BadZipFile) as e:
@@ -796,7 +855,14 @@ class Trainer:
                 n_step=config.n_step,
                 gamma=agent_cfg.gamma,
                 host=config.fleet_host,
-                port=config.fleet_listen,
+                # Per-host ingest scale-out: each process runs its OWN
+                # server feeding its local shards, on base_port + index
+                # (an explicit port 0 stays 0 — ephemeral on every host).
+                port=(
+                    config.fleet_listen + self._proc_idx
+                    if config.fleet_listen
+                    else config.fleet_listen
+                ),
                 queue_limit=config.fleet_queue_limit,
                 max_gen_lag=config.fleet_max_gen_lag,
                 caps=learner_fleet_caps(
@@ -812,7 +878,13 @@ class Trainer:
             if config.fleet_bundle:
                 self._fleet_publish()
 
-        self._rng = np.random.default_rng(config.seed)
+        # Host-side exploration rng folds in the process index so hosts
+        # collect decorrelated trajectories; the DEVICE side (state init,
+        # megastep key) stays seeded identically everywhere — SPMD needs
+        # bit-identical replicated operands. Salt is zero single-process.
+        self._rng = np.random.default_rng(
+            config.seed + 1_000_003 * self._proc_idx
+        )
         self._noise_init, self._noise_sample, self._noise_reset = make_noise(agent_cfg)
 
         # Host-env acting backend (config.actor_device). On a remote/tunneled
@@ -1843,6 +1915,24 @@ class Trainer:
         priority write-back.
         """
         cfg = self.config
+        if self._chaos is not None:
+            # host_kill@N[:victim] (docs/fault_tolerance.md): SIGKILL this
+            # process at its Nth megastep dispatch when it is the victim.
+            # The dispatch count is deterministic and identical across the
+            # mesh's processes, so every process agrees on WHEN; only the
+            # victim dies — survivors block on the flush allgather until
+            # the supervisor reaps them and relaunches the full mesh
+            # (scripts/multihost_smoke.sh proves checkpoint → resume).
+            e = self._chaos.tick("host_kill")
+            if e is not None and self._proc_idx == int(e.arg or 0):
+                import signal as _sig
+
+                print(
+                    f"[chaos] host_kill: SIGKILL process {self._proc_idx} "
+                    f"at grad step {self.grad_steps}",
+                    flush=True,
+                )
+                os.kill(os.getpid(), _sig.SIGKILL)
         if self._placement == "device":
             with self._timers.stage("ingest_chunk"):
                 # The flush's tree_hook seeds newly mirrored rows into the
@@ -2211,11 +2301,11 @@ class Trainer:
         return last
 
     def _replay_snapshot_path(self) -> str:
-        return os.path.join(self.config.log_dir, "checkpoints", "replay.npz")
+        return os.path.join(self._shared_dir, "checkpoints", "replay.npz")
 
     def _device_per_snapshot_path(self) -> str:
         return os.path.join(
-            self.config.log_dir, "checkpoints", "device_per.npz"
+            self._shared_dir, "checkpoints", "device_per.npz"
         )
 
     def _save_checkpoint(self) -> None:
@@ -2228,6 +2318,22 @@ class Trainer:
             from d4pg_tpu.parallel import apply_fns
 
             state = apply_fns(self._state_gather_fns, state)
+        # Multi-host save discipline: every COLLECTIVE the save needs runs
+        # FIRST, on all processes in the same order (the state gather
+        # above, then ring flush + global ring gather + PER-tree gather
+        # below); then every process except 0 returns before a single byte
+        # is written — run_root has exactly one writer, and a straggler
+        # can never observe a half-written manifest it helped produce.
+        ring_snap = per_snap = None
+        if self._procs > 1:
+            if self.config.snapshot_replay:
+                with annotate("host/replay_snapshot"):
+                    self._ring = self._ring_sync.flush(self._ring)
+                    ring_snap = self._ring_sync.gather_snapshot(self._ring)
+                if self._dev_per is not None:
+                    per_snap = self._dev_per.snapshot_host()
+            if self._proc_idx != 0:
+                return
         self.ckpt.save(self.grad_steps, state)
         # Finalize the (async) Orbax write before the side files: a crash
         # between them must never leave meta/replay newer than the newest
@@ -2254,7 +2360,7 @@ class Trainer:
             extra["variant_id"] = int(self.config.variant_id)
             extra["league_generation"] = int(self.config.league_generation)
         save_trainer_meta(
-            self.config.log_dir,
+            self._shared_dir,
             self.env_steps,
             self.ewma_return,
             extra=extra or None,
@@ -2263,15 +2369,31 @@ class Trainer:
             # Apply in-flight async priority updates first, else the snapshot
             # freezes priorities the flusher was about to overwrite.
             self._drain_writeback()
-            with annotate("host/replay_snapshot"):
-                self.buffer.snapshot(self._replay_snapshot_path())
+            if ring_snap is not None:
+                # Multi-host: the gathered GLOBAL ring, already in the
+                # exact npz layout ReplayBuffer.snapshot writes (global
+                # slot order + pos + size) — a later resume can deal it
+                # back out onto ANY topology, or restore it directly
+                # single-process.
+                path = self._replay_snapshot_path()
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    np.savez(f, **ring_snap)
+                os.replace(tmp, path)
+            else:
+                with annotate("host/replay_snapshot"):
+                    self.buffer.snapshot(self._replay_snapshot_path())
             if self._dev_per is not None:
                 # Device-PER priority sidecar: the tree's α-exponentiated
                 # leaves in host slot order + the pre-α max (ONE cold-path
                 # D2H per checkpoint — never per step). Without it a
                 # --resume re-seeds every row at max priority, the same
                 # degradation a uniform-buffer snapshot restores to.
-                pa, mp = self._dev_per.snapshot_host()
+                pa, mp = (
+                    per_snap
+                    if per_snap is not None
+                    else self._dev_per.snapshot_host()
+                )
                 dp_path = self._device_per_snapshot_path()
                 tmp = dp_path + ".tmp"
                 with open(tmp, "wb") as f:  # file object: savez appends no suffix

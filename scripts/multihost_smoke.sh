@@ -1,0 +1,121 @@
+#!/usr/bin/env bash
+# Multi-host smoke (ISSUE 17): the 2-process × 4-device virtual mesh end
+# to end through the REAL CLI — bring-up (jax.distributed over gloo,
+# per-host ingest, --debug-guards on so a guard trip or leaked hold is a
+# hard failure), then the host_kill chaos site: SIGKILL one process of
+# the mesh MID-TRAINING, reap the blocked survivor (the supervisor's
+# move — a half-dead mesh cannot make progress past its next
+# collective), and prove the full-mesh relaunch resumes from the last
+# COMMITTED coordinated checkpoint (manifest-attested step 12, not the
+# in-flight work the kill destroyed) with the replay snapshot and
+# device-PER sidecar restored and bit-identical done-lines on both
+# processes.
+#
+# Every leg spawns real train.py processes with a cold compile, so the
+# whole script is slow-tier: tests/test_multihost_smoke.py wraps it
+# @pytest.mark.slow per the tier-1 clock-guard convention (long legs are
+# slow-marked; nothing from this smoke runs inside the 60 s fast tier).
+#
+# Knobs (env vars): MULTIHOST_SMOKE_DIR (default mktemp).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DIR=${MULTIHOST_SMOKE_DIR:-$(mktemp -d /tmp/multihost_smoke.XXXXXX)}
+mkdir -p "$DIR"
+echo "[multihost-smoke] dir: $DIR"
+
+PORT=$(python - <<'EOF'
+import socket
+s = socket.socket(); s.bind(("127.0.0.1", 0)); print(s.getsockname()[1]); s.close()
+EOF
+)
+
+RUN="$DIR/run"
+common=(--env pendulum --hidden-sizes 16,16 --n-atoms 11
+        --warmup 24 --bsize 8 --rmsize 256
+        --dp 8 --replay-placement device --num-envs 2
+        --eval-interval 100000 --eval-episodes 1
+        --checkpoint-interval 12 --snapshot-replay --no-concurrent-eval
+        --debug-guards --log-dir "$RUN" --seed 3
+        --coordinator "localhost:$PORT" --num-processes 2)
+
+# launch <rank> [args...]: spawns a mesh process in THIS shell (no
+# command substitution — the pid must stay wait-able) and reports it in
+# LAST_PID.
+launch() {
+  local rank=$1; shift
+  env JAX_PLATFORMS=cpu \
+      XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+      python train.py "${common[@]}" --process-id "$rank" "$@" \
+      > "$DIR/leg${LEG}_p${rank}.log" 2>&1 &
+  LAST_PID=$!
+}
+
+# ---- leg 1: host_kill@18:1 — SIGKILL process 1 at megastep dispatch 18 -----
+# Checkpoint 12 commits first (its gather is a collective, both processes
+# alive), so the kill lands strictly between the committed step and the
+# next one — the work it destroys must NOT be resumed into.
+LEG=1
+launch 0 --total-steps 48 --chaos "host_kill@18:1"; P0=$LAST_PID
+launch 1 --total-steps 48 --chaos "host_kill@18:1"; P1=$LAST_PID
+set +e
+wait "$P1"; RC1=$?
+set -e
+grep -q "host_kill: SIGKILL process 1" "$DIR/leg1_p1.log" \
+  || { echo "MULTIHOST_SMOKE_FAIL: host_kill never fired"; tail -20 "$DIR/leg1_p1.log"; exit 1; }
+[ "$RC1" -ne 0 ] || { echo "MULTIHOST_SMOKE_FAIL: SIGKILLed process exited 0"; exit 1; }
+echo "[multihost-smoke] victim (process 1) died rc=$RC1"
+# The survivor is wedged on its next cross-process collective — a mesh
+# with a dead member cannot make progress. Reap it, as a supervisor
+# (or the pod scheduler) would, then relaunch the FULL mesh.
+kill -9 "$P0" 2>/dev/null || true
+set +e; wait "$P0" 2>/dev/null; set -e
+echo "[multihost-smoke] survivor (process 0) reaped"
+ls "$RUN/checkpoints/" | grep -q "manifest_12.json" \
+  || { echo "MULTIHOST_SMOKE_FAIL: no committed checkpoint before the kill"; exit 1; }
+
+# ---- leg 2: full-mesh relaunch --resume -----------------------------------
+LEG=2
+launch 0 --total-steps 24 --resume; P0=$LAST_PID
+launch 1 --total-steps 24 --resume; P1=$LAST_PID
+wait "$P0" "$P1"
+for rank in 0 1; do
+  L="$DIR/leg2_p$rank.log"
+  grep -q "resumed from step 12" "$L" \
+    || { echo "MULTIHOST_SMOKE_FAIL: p$rank did not resume from the committed step"; tail -20 "$L"; exit 1; }
+  grep -q "restored replay snapshot" "$L" \
+    || { echo "MULTIHOST_SMOKE_FAIL: p$rank did not restore the replay snapshot"; exit 1; }
+  grep -q "restored device-PER priorities" "$L" \
+    || { echo "MULTIHOST_SMOKE_FAIL: p$rank did not restore the PER sidecar"; exit 1; }
+  grep -q "^done:" "$L" \
+    || { echo "MULTIHOST_SMOKE_FAIL: p$rank did not complete"; tail -20 "$L"; exit 1; }
+done
+# One SPMD program, one answer: every MODEL metric in the two processes'
+# done-lines must be bit-identical (the *_per_sec rates are per-process
+# wall clock and legitimately differ). --debug-guards was on for every
+# leg, so completion also attests zero guard trips and zero leaked holds.
+python - "$DIR/leg2_p0.log" "$DIR/leg2_p1.log" <<'EOF'
+import ast, sys
+dicts = []
+for path in sys.argv[1:]:
+    line = next(l for l in reversed(open(path).read().splitlines())
+                if l.startswith("done:"))
+    dicts.append(ast.literal_eval(line[len("done:"):].strip()))
+model = [{k: v for k, v in d.items() if not k.endswith("_per_sec")}
+         for d in dicts]
+assert model[0] == model[1], ("done-lines differ across the mesh",
+                              model[0], model[1])
+print("MULTIHOST_SMOKE_ASSERTS_OK",
+      {"resumed_from": 12,
+       "final_critic_loss": model[0]["critic_loss"],
+       "final_grad_steps": 36})
+EOF
+
+# zero orphaned mesh processes
+if pgrep -f "train.py.*$RUN" > /dev/null 2>&1; then
+  echo "MULTIHOST_SMOKE_FAIL: orphaned mesh processes survived"
+  pgrep -af "train.py.*$RUN" || true
+  exit 1
+fi
+
+echo "MULTIHOST_SMOKE_OK"

@@ -27,6 +27,7 @@ def test_microbench_runs_and_records(tmp_path):
         repeats=1,
         shed_rates=(20, 300),
         shed_duration_s=0.4,
+        writers=2,
     )
     with open(out_path) as f:
         on_disk = json.load(f)
@@ -47,6 +48,14 @@ def test_microbench_runs_and_records(tmp_path):
         assert 0.0 <= lv["shed_rate"] <= 1.0
         assert lv["windows_offered"] >= lv["windows_accepted"]
     assert levels[0]["shed_rate"] == 0.0  # far below capacity: no shed
+    # multi-writer scale-out row: disjoint stacks, aggregate = sum
+    mw = out["multi_writer"]
+    assert mw["writers"] == 2
+    assert mw["writers_1_windows_per_sec"] > 0
+    assert mw["writers_2_aggregate_windows_per_sec"] == sum(
+        mw["per_writer_windows_per_sec"]
+    )
+    assert "isolated-stack-sum" in mw["methodology"]
 
 
 def test_committed_artifact_schema():
@@ -70,3 +79,9 @@ def test_committed_artifact_schema():
     # the committed sweep crosses saturation: clean low end, engaged high
     assert rates[0] == 0.0 and rates[-1] > 0.0
     assert shed["shed_engagement_windows_per_sec"] is not None
+    # the committed 2-writer aggregate row scales out (> 1x of one writer)
+    mw = doc["multi_writer"]
+    assert mw["writers"] == 2
+    assert mw["scaling_x"] > 1.0
+    assert mw["writers_2_aggregate_windows_per_sec"] > \
+        mw["writers_1_windows_per_sec"]

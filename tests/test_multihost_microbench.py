@@ -1,0 +1,99 @@
+"""Tier-1-safe multihost microbench surface.
+
+The slow half of the ISSUE-17 acceptance (two real 4-device child
+processes per topology) lives in ``tests/test_multihost.py``; what runs
+every fast pass here is the ingest-scaling half of
+``benchmarks/multihost_microbench.run_microbench`` (host-CPU socket
+work, ``skip_exact=True``), the committed artifact's schema/attestation
+pin, and the refusal behavior of
+``tools.d4pglint.schema_check.check_multihost_microbench`` — the gate
+that keeps a broken bit-exactness attestation, a nonzero per-grad-step
+transfer row, or non-scaling ingest out of the tree.
+"""
+
+import copy
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "benchmarks"))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from multihost_microbench import run_microbench  # noqa: E402
+from tools.d4pglint.schema_check import check_multihost_microbench  # noqa: E402
+
+ARTIFACT = os.path.join(
+    os.path.dirname(__file__), "..", "benchmarks", "multihost_microbench.json"
+)
+
+
+def test_microbench_ingest_half_runs_and_records(tmp_path):
+    out_path = str(tmp_path / "multihost_microbench.json")
+    out = run_microbench(
+        out_path, skip_exact=True, frame_windows=16, duration_s=0.3
+    )
+    with open(out_path) as f:
+        on_disk = json.load(f)
+    assert on_disk["metric"] == "multihost_microbench"
+    sc = out["ingest_scaling"]
+    assert sc["writers"] == 2
+    assert sc["writers_1_windows_per_sec"] > 0
+    # disjoint stacks: the aggregate is exactly the per-writer sum
+    assert sc["writers_2_aggregate_windows_per_sec"] == sum(
+        sc["per_writer_windows_per_sec"]
+    )
+    assert "isolated-stack-sum" in sc["methodology"]
+    # skip_exact leaves the exactness attestation out entirely — it may
+    # only ever be written by the real two-topology run
+    assert "bit_exact" not in on_disk
+
+
+def test_committed_artifact_attests_the_issue_claims():
+    assert check_multihost_microbench(ARTIFACT) == []
+    with open(ARTIFACT) as f:
+        doc = json.load(f)
+    be = doc["bit_exact"]
+    for key in ("train_state", "adam_moments", "ring", "per_tree",
+                "det_pmean", "fold_in_draws"):
+        assert be[key] is True
+    assert be["mismatches"] == []
+    assert be["dispatches"] >= 2
+    assert be["state_leaves"] >= 1
+    assert be["keys_compared"] > be["state_leaves"]  # ring/PER/draws too
+    assert doc["transfer_bytes_per_grad_step"]["procs_1"] == 0
+    assert doc["transfer_bytes_per_grad_step"]["procs_2"] == 0
+    # the headline scale-out claim: >= 1.8x aggregate with 2 writers
+    assert doc["ingest_scaling"]["scaling_x"] >= 1.8
+
+
+def test_schema_check_refuses_broken_attestations(tmp_path):
+    with open(ARTIFACT) as f:
+        good = json.load(f)
+
+    def errs_for(mutate):
+        doc = copy.deepcopy(good)
+        mutate(doc)
+        p = str(tmp_path / "doc.json")
+        with open(p, "w") as f:
+            json.dump(doc, f)
+        return check_multihost_microbench(p)
+
+    def set_broken_exactness(d):
+        d["bit_exact"]["adam_moments"] = False
+
+    def set_mismatches(d):
+        d["bit_exact"]["mismatches"] = ["state_0"]
+
+    def set_transfer_bytes(d):
+        d["transfer_bytes_per_grad_step"]["procs_2"] = 4096
+
+    def set_flat_scaling(d):
+        d["ingest_scaling"]["scaling_x"] = 0.97
+
+    def set_hand_edited_headline(d):
+        d["ingest_scaling"]["scaling_x"] = 7.0  # != aggregate/single
+
+    for mutate in (set_broken_exactness, set_mismatches, set_transfer_bytes,
+                   set_flat_scaling, set_hand_edited_headline):
+        assert errs_for(mutate), mutate.__name__
+    assert errs_for(lambda d: None) == []  # round-trips clean unmutated

@@ -1,0 +1,55 @@
+"""Multi-host smoke wrapper: ``scripts/multihost_smoke.sh`` end to end —
+2-process × 4-device mesh bring-up under ``--debug-guards``, the
+``host_kill`` chaos site (SIGKILL one mesh process mid-training), the
+survivor reap, and the full-mesh ``--resume`` from the last committed
+coordinated checkpoint with bit-identical done-lines.
+
+Wired into the test tree per the tier-1 clock-guard convention: every
+leg spawns real train.py processes with a cold compile, so the whole
+script is a slow-marked long leg — nothing from this smoke runs inside
+the 60 s fast tier (the fast-tier multihost coverage is the in-process
+half of ``tests/test_multihost.py``).
+"""
+
+import os
+import subprocess
+import time
+
+import pytest
+
+from conftest import clean_cpu_env
+
+# Slow-tier ceiling for the whole script (two 2-process legs, each with
+# a cold XLA compile on the 1-core CI box). A regression past it means a
+# leg hung on a dead collective instead of being reaped.
+SLOW_BUDGET_S = 540.0
+
+
+@pytest.mark.slow
+def test_multihost_smoke_script(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = clean_cpu_env()
+    env["MULTIHOST_SMOKE_DIR"] = str(tmp_path / "run")
+    t0 = time.monotonic()
+    p = subprocess.run(
+        ["bash", os.path.join(repo, "scripts", "multihost_smoke.sh")],
+        capture_output=True,
+        text=True,
+        timeout=SLOW_BUDGET_S + 60,
+        env=env,
+        cwd=repo,
+    )
+    elapsed = time.monotonic() - t0
+    out = p.stdout + p.stderr
+    assert p.returncode == 0, out[-4000:]
+    assert "MULTIHOST_SMOKE_ASSERTS_OK" in p.stdout, out[-4000:]
+    assert "MULTIHOST_SMOKE_OK" in p.stdout, out[-4000:]
+    # the committed checkpoint the resume proved is a real on-disk artifact
+    ckpt = str(tmp_path / "run" / "run" / "checkpoints")
+    assert os.path.isdir(ckpt), out[-2000:]
+    assert any(n.startswith("manifest_") for n in os.listdir(ckpt))
+    assert elapsed < SLOW_BUDGET_S, (
+        f"multihost smoke took {elapsed:.1f}s, past its stated "
+        f"{SLOW_BUDGET_S:.0f}s slow-tier budget; a leg likely sat on a "
+        "dead cross-process collective instead of being reaped"
+    )

@@ -460,6 +460,97 @@ def check_lock_order_graph(path: str, root: str | None = None) -> list[str]:
     return errs
 
 
+def check_multihost_microbench(path: str) -> list[str]:
+    """Shape + invariants for ``benchmarks/multihost_microbench.json`` —
+    the ISSUE-17 acceptance artifact. Three refusals beyond the generic
+    rule: a BROKEN bit-exactness attestation (any flag not literally
+    true, or recorded mismatches), a NONZERO per-grad-step transfer
+    byte row (the zero-transfer steady state is the contract, per
+    topology), and writer scaling ≤ 1 (per-host ingest that does not
+    scale out is not per-host ingest)."""
+    errs = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: unreadable/invalid JSON ({e})"]
+    for key in ("backend", "topologies", "bit_exact",
+                "transfer_bytes_per_grad_step", "ingest_scaling"):
+        if key not in doc:
+            errs.append(f"{path}: missing top-level key {key!r}")
+    be = doc.get("bit_exact")
+    if not isinstance(be, dict):
+        errs.append(f"{path}: 'bit_exact' must be an object")
+    else:
+        for key in ("train_state", "adam_moments", "ring", "per_tree",
+                    "det_pmean", "fold_in_draws"):
+            if be.get(key) is not True:
+                errs.append(
+                    f"{path}: bit_exact.{key} is not true — the committed "
+                    "artifact must never attest a mesh that diverges from "
+                    "the single-process oracle"
+                )
+        if be.get("mismatches"):
+            errs.append(
+                f"{path}: bit_exact.mismatches is non-empty: "
+                f"{be['mismatches']!r}"
+            )
+        if not isinstance(be.get("dispatches"), int) or be["dispatches"] < 2:
+            errs.append(
+                f"{path}: bit_exact.dispatches must be an int >= 2 (one "
+                "dispatch cannot show drift ACCUMULATING)"
+            )
+    tb = doc.get("transfer_bytes_per_grad_step")
+    if not isinstance(tb, dict):
+        errs.append(
+            f"{path}: 'transfer_bytes_per_grad_step' must be an object"
+        )
+    else:
+        rows = {k: v for k, v in tb.items() if k.startswith("procs_")}
+        if not rows:
+            errs.append(
+                f"{path}: transfer_bytes_per_grad_step has no per-topology "
+                "'procs_*' rows"
+            )
+        for k, v in rows.items():
+            if v != 0:
+                errs.append(
+                    f"{path}: transfer_bytes_per_grad_step.{k} = {v!r} — "
+                    "the steady-state dispatch budget is exactly zero"
+                )
+    sc = doc.get("ingest_scaling")
+    if not isinstance(sc, dict):
+        errs.append(f"{path}: 'ingest_scaling' must be an object")
+    else:
+        for key in ("writers", "writers_1_windows_per_sec",
+                    "writers_2_aggregate_windows_per_sec", "scaling_x",
+                    "methodology", "bench_host_cores"):
+            if key not in sc:
+                errs.append(f"{path}: ingest_scaling missing {key!r}")
+        one = sc.get("writers_1_windows_per_sec")
+        agg = sc.get("writers_2_aggregate_windows_per_sec")
+        if not (isinstance(one, (int, float)) and one > 0):
+            errs.append(
+                f"{path}: ingest_scaling.writers_1_windows_per_sec must be "
+                "> 0"
+            )
+        scaling = sc.get("scaling_x")
+        if not isinstance(scaling, (int, float)) or scaling <= 1.0:
+            errs.append(
+                f"{path}: ingest_scaling.scaling_x = {scaling!r} — writer "
+                "scaling <= 1 means per-host ingest did not scale out; "
+                "refuse the artifact"
+            )
+        elif (isinstance(one, (int, float)) and one > 0
+              and isinstance(agg, (int, float))
+              and abs(scaling - agg / one) > 1e-6 * max(scaling, 1.0)):
+            errs.append(
+                f"{path}: ingest_scaling.scaling_x {scaling!r} does not "
+                "equal aggregate/single — a hand-edited headline"
+            )
+    return errs
+
+
 def check_league_soak(path: str) -> list[str]:
     """Shape + invariants for ``benchmarks/league_soak.json`` — the
     ISSUE-15 acceptance artifact (the league controller's end-of-run
@@ -650,6 +741,8 @@ def check_tree(root: str) -> list[str]:
             errs.extend(check_composition_matrix(path))
         if os.path.basename(path) == "league_soak.json":
             errs.extend(check_league_soak(path))
+        if os.path.basename(path) == "multihost_microbench.json":
+            errs.extend(check_multihost_microbench(path))
     for path in sorted(
         glob.glob(os.path.join(root, "runs", "**", "metrics.jsonl"),
                   recursive=True)

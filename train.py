@@ -609,12 +609,25 @@ def main(argv=None) -> None:
     if args.export_bundle:
         export_bundle_from_run(cfg, args.export_bundle)
         return
+    if info is not None:
+        # Surface the actual bring-up topology to the config: negotiation
+        # validates the multi-host combination (device placement + dp
+        # divisibility), and the Trainer sizes per-host buffers from it.
+        cfg = dataclasses.replace(
+            cfg, num_processes=int(info["process_count"])
+        )
     if info is not None and info["process_index"] != 0:
         # Every process runs the same command line; secondary hosts write
-        # metrics/checkpoints to their own subdir so a shared filesystem
-        # sees no clobbering (process 0 owns the canonical run dir).
+        # metrics to their own subdir so a shared filesystem sees no
+        # clobbering, but SHARED artifacts (checkpoints, trainer meta,
+        # replay snapshot) resolve through run_root — the canonical run
+        # dir process 0 owns and is the only writer of.
         cfg = dataclasses.replace(
-            cfg, log_dir=os.path.join(cfg.log_dir, f"worker{info['process_index']}")
+            cfg,
+            run_root=cfg.log_dir,
+            log_dir=os.path.join(
+                cfg.log_dir, f"worker{info['process_index']}"
+            ),
         )
     print(f"config: {cfg}")
     # THE CLI validation call site (replay/source.py): one negotiation
