@@ -68,6 +68,14 @@ Spec syntax (entries separated by ``;`` or ``,``)::
                           the next collective until the supervisor
                           reaps them and relaunches the full mesh with
                           --resume — scripts/multihost_smoke.sh)
+    mirror_drop@3         mirror tap: silently lose its 3rd built window
+                          on the data path, before BOTH sinks (the
+                          explicit windows_dropped_chaos counter must
+                          keep the tap's accounting identity exact)
+    gate_stall@1:30       router: the off-policy gate worker sleeps 30 s
+                          inside its 1st evaluation (the rollout must
+                          roll back at the observe deadline, never
+                          promote on a missing verdict)
 
 A ``:<arg>`` that does not parse as a number is kept as a string LABEL
 (``tenant_flood``'s tenant name); numeric args stay floats.
@@ -167,6 +175,21 @@ site                  tick location               recovery proven
                                                   --resumes from the
                                                   last committed
                                                   coordinated checkpoint
+``mirror_drop``       mirror tap sender, per      window lost before BOTH
+                      built window                sinks; windows_dropped_
+                                                  chaos keeps the tap
+                                                  identity exact — the
+                                                  learner just sees less
+                                                  mirrored data, serving
+                                                  is untouched
+``gate_stall``        router gate worker, per     control thread rolls the
+                      gate evaluation             rollout back at the
+                                                  observe deadline
+                                                  (gate_stalls counter);
+                                                  later rollouts gate
+                                                  normally — the stalled
+                                                  worker's late verdict
+                                                  is token-fenced out
 ====================  ==========================  =========================
 """
 
@@ -238,6 +261,17 @@ KNOWN_SITES = WORKER_SITES + (
     # the spanning mesh — and SIGKILLs the process whose index matches
     # the ``:<arg>`` victim (default 0).
     "host_kill",
+    # flywheel sites (ISSUE 18, d4pg_tpu/flywheel): mirror_drop ticks in
+    # the tap's sender once per built window, BEFORE either sink — the
+    # window is lost on the data path but windows_dropped_chaos keeps
+    # the tap's accounting identity exact (a drop the books can't see is
+    # the one bug class the mirror plane must never have). gate_stall
+    # ticks inside the router's off-policy gate worker and sleeps
+    # ``:<arg>`` seconds (default: past any deadline) — the rollout must
+    # roll back at the observe deadline, never promote on a missing
+    # verdict or wedge the control loop.
+    "mirror_drop",
+    "gate_stall",
 )
 
 # Sites whose ``:<arg>`` is a string label, not a number (the flood's

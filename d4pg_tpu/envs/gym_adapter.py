@@ -134,6 +134,15 @@ class GymAdapter:
             terminated = bool(info.get("is_success", False))
         return self._flatten(obs), float(reward), bool(terminated), bool(truncated), info
 
+    def to_canonical_action(self, action: np.ndarray) -> np.ndarray:
+        """Env-scale → canonical (−1, 1): the inverse of the map ``step``
+        applies. The flywheel sim client needs it because the SERVE wire
+        speaks env-scale (the bundle's action bounds) while the env
+        adapter, the replay buffer, and the NumPy bundle policy all
+        speak canonical — feedback must log the action in the space the
+        learner trains on."""
+        return self._normalize.to_canonical(np.asarray(action))
+
     def compute_reward(self, achieved_goal, desired_goal) -> float:
         return float(
             self.env.unwrapped.compute_reward(achieved_goal, desired_goal, {})

@@ -66,6 +66,12 @@ from d4pg_tpu.analysis import lockwitness
 # counter keys, in the order they appear in metrics rows / healthz
 COUNTER_KEYS = (
     "windows_ingested",
+    # ISSUE 18: the per-source split of windows_ingested — "actor"
+    # connections (collection fleet) vs "mirror" connections (flywheel
+    # serving tap), chosen by the HELLO ``source`` cap. Identity:
+    # windows_from_actors + windows_from_mirror == windows_ingested.
+    "windows_from_actors",
+    "windows_from_mirror",
     "windows_dropped_stale_gen",
     # ISSUE 13: windows produced under obs-norm statistics older than the
     # allowed lag — counted and discarded exactly like stale-generation
@@ -344,15 +350,16 @@ class IngestServer:
                 daemon=True,
             ).start()
 
-    def _handshake(self, conn, rfile) -> bool:
+    def _handshake(self, conn, rfile) -> Optional[dict]:
         """First non-HEALTHZ frame must be a valid HELLO; reply HELLO_OK
-        or ERROR. Returns True when the connection may stream windows.
-        HEALTHZ is answered pre-handshake so monitoring probes work the
-        same way they do against the serve port (docs/fleet.md)."""
+        or ERROR. Returns the negotiated capability set when the
+        connection may stream windows, None otherwise. HEALTHZ is
+        answered pre-handshake so monitoring probes work the same way
+        they do against the serve port (docs/fleet.md)."""
         while True:
             frame = protocol.read_frame(rfile)
             if frame is None:
-                return False
+                return None
             msg_type, req_id, payload = frame
             if msg_type != protocol.HEALTHZ:
                 break
@@ -402,7 +409,7 @@ class IngestServer:
                     gaps,
                 ),
             )
-            return False
+            return None
         protocol.write_frame(
             conn,
             protocol.HELLO_OK,
@@ -417,13 +424,15 @@ class IngestServer:
                 stats_generation=self.stats_generation,
             ),
         )
-        return True
+        return chosen
 
     def _serve_conn(self, conn: socket.socket) -> None:
         rfile = conn.makefile("rb")
         try:
-            if not self._handshake(conn, rfile):
+            negotiated = self._handshake(conn, rfile)
+            if negotiated is None:
                 return
+            src = str(negotiated.get("source", "actor"))
             while True:
                 frame = protocol.read_frame(rfile)
                 if frame is None:
@@ -476,6 +485,10 @@ class IngestServer:
                             f"connection negotiated "
                             f"{self.caps['obs_mode']!r}"
                         )
+                    # The flywheel mirror's behavior-log-prob column is a
+                    # GATE input (read from the mirror spool), not replay
+                    # content — the ring stores Transition columns only.
+                    cols.pop("logprob", None)
                 else:
                     raise ProtocolError(f"unexpected message type {msg_type}")
                 self._inc("frames_total")
@@ -521,7 +534,7 @@ class IngestServer:
                 with self._cond:
                     full = len(self._queue) >= self.queue_limit
                     if not full:
-                        self._queue.append((cols, fold))
+                        self._queue.append((cols, fold, src))
                         self._cond.notify()
                 if full:
                     # Explicit shed at the bounded queue (the batcher's
@@ -588,9 +601,9 @@ class IngestServer:
             raise
 
     def _write_frames(self, frames: list) -> None:
-        """``frames`` is a list of ``(cols, fold)`` pairs popped from the
-        admission queue."""
-        total = sum(len(f["reward"]) for f, _fold in frames)
+        """``frames`` is a list of ``(cols, fold, src)`` triples popped
+        from the admission queue."""
+        total = sum(len(f["reward"]) for f, _fold, _src in frames)
         if total == 0:
             return
         if self._obs_norm is not None:
@@ -598,7 +611,7 @@ class IngestServer:
             # updater — the seam refuses configs with a second one),
             # BEFORE add_batch so a sampled batch never sees rows its
             # stats have not absorbed. Original windows only.
-            for f, fold in frames:
+            for f, fold, _src in frames:
                 if fold:
                     self._obs_norm.update(f["obs"])
         flip = self._staging_flip
@@ -611,7 +624,7 @@ class IngestServer:
         # unstaged write below rather than overrunning the slot
         if total <= self._staging_cap:
             pos = 0
-            for f, _fold in frames:
+            for f, _fold, _src in frames:
                 n = len(f["reward"])
                 for k in ("obs", "action", "reward", "next_obs", "discount"):
                     staging[k][pos : pos + n] = f[k]
@@ -619,8 +632,8 @@ class IngestServer:
             cols = {k: staging[k][:total] for k in staging}
         else:
             cols = {
-                k: np.concatenate([f[k] for f, _fold in frames])
-                for k in frames[0][0]
+                k: np.concatenate([f[k] for f, _fold, _src in frames])
+                for k in ("obs", "action", "reward", "next_obs", "discount")
             }
         hold = self._ledger.hold(
             self._staging_group, flip, holder="fleet-ingest-add_batch"
@@ -639,4 +652,11 @@ class IngestServer:
             # add_batch copies synchronously under the buffer lock; the
             # staging slot is free the moment it returns.
             hold.release()
+        mirror = sum(
+            len(f["reward"]) for f, _fold, s in frames if s == "mirror"
+        )
         self._inc("windows_ingested", total)
+        if mirror:
+            self._inc("windows_from_mirror", mirror)
+        if total - mirror:
+            self._inc("windows_from_actors", total - mirror)

@@ -58,6 +58,14 @@ what goes INSIDE the fleet frames:
     obs-norm statistics fold (the local path folds each observed step
     once, with its ORIGINAL goal — relabels would multi-count it).
 
+    ``flags`` bit 1 (``FLAG_LOGPROB``, ISSUE 18) declares one extra f32
+    column block appended after the discount block: the behavior-policy
+    log-prob of each window's FIRST action — the logged propensity the
+    flywheel's off-policy promotion gate weights by. A frame without the
+    bit is byte-identical to the pre-flywheel WINDOWS2 wire; the ingest
+    server strips the column before ``add_batch`` (the replay ring
+    stores the Transition columns only) and the mirror spool keeps it.
+
 ``WINDOWS_OK`` (struct)
     ``u32 accepted, u32 dropped_stale`` — the per-frame account
     (``dropped_stale`` covers bundle-generation AND stats-generation
@@ -93,6 +101,7 @@ OBS_MODE_NAMES = {v: k for k, v in OBS_MODE_IDS.items()}
 OBS_MODE_BYTES = {"f32": 4, "u8": 1, "bf16": 2}
 
 FLAG_RELABELED = 1  # WINDOWS2 flags bit 0: hindsight-relabeled window
+FLAG_LOGPROB = 2    # WINDOWS2 flags bit 1: behavior log-prob column present
 
 
 def _bf16_dtype():
@@ -197,17 +206,22 @@ def encode_hello(
         "generation": int(generation),
     }
     if caps is not None:
-        # {wire, obs_modes, her, obs_norm, variant} — absent for
+        # {wire, obs_modes, her, obs_norm, variant, source} — absent for
         # pre-ISSUE-13 actors, which negotiate as LEGACY_ACTOR_CAPS
         # server-side. ``variant`` (ISSUE 15) is the league variant this
         # host is ASSIGNED to; 0 = the default/pre-league variant, so
         # pre-variant actors can only ever feed a default-variant learner.
+        # ``source`` (ISSUE 18) names the experience stream this
+        # connection feeds — "actor" (collection fleet) or "mirror"
+        # (flywheel tap) — so the ingest server can keep per-source
+        # counters; it never gates admission.
         doc["caps"] = {
             "wire": int(caps.get("wire", 2)),
             "obs_modes": [str(m) for m in caps.get("obs_modes", ("f32",))],
             "her": bool(caps.get("her", False)),
             "obs_norm": bool(caps.get("obs_norm", False)),
             "variant": int(caps.get("variant", 0)),
+            "source": str(caps.get("source", "actor")),
         }
     return json.dumps(doc).encode()
 
@@ -234,6 +248,7 @@ def decode_hello(payload: bytes) -> dict:
                 "her": bool(caps.get("her", False)),
                 "obs_norm": bool(caps.get("obs_norm", False)),
                 "variant": int(caps.get("variant", 0)),
+                "source": str(caps.get("source", "actor")),
             }
         return doc
     except (ValueError, KeyError, TypeError, AttributeError,
@@ -406,11 +421,14 @@ def encode_windows2(
     reward: np.ndarray,
     next_obs: np.ndarray,
     discount: np.ndarray,
+    logprob: Optional[np.ndarray] = None,
 ) -> bytes:
     """Pack ``n`` complete windows into one WINDOWS2 payload (columnar:
     obs block, action block, reward, next_obs block, discount). Inputs
     are f32-shaped like :func:`encode_windows`; obs/next_obs go out in
-    ``obs_mode``."""
+    ``obs_mode``. ``logprob`` (``[n]``, flywheel mirror frames only)
+    appends the behavior-log-prob column and sets ``FLAG_LOGPROB``;
+    omitted, the payload is byte-identical to the pre-flywheel wire."""
     if obs_mode not in OBS_MODE_IDS:
         raise ProtocolError(f"unknown obs wire mode {obs_mode!r}")
     obs = np.atleast_2d(np.asarray(obs, np.float32))
@@ -418,6 +436,8 @@ def encode_windows2(
     action = np.atleast_2d(np.asarray(action, np.float32))
     n = obs.shape[0]
     flags = FLAG_RELABELED if relabeled else 0
+    if logprob is not None:
+        flags |= FLAG_LOGPROB
     payload = (
         _WINDOWS2_HEAD.pack(
             int(generation), int(stats_generation), n,
@@ -428,6 +448,8 @@ def encode_windows2(
         + np.asarray(reward, np.float32).tobytes()
         + encode_obs_block(next_obs, obs_mode)
         + np.asarray(discount, np.float32).tobytes()
+        + (b"" if logprob is None
+           else np.asarray(logprob, np.float32).tobytes())
     )
     if len(payload) > MAX_PAYLOAD:
         raise ProtocolError(
@@ -455,12 +477,16 @@ def decode_windows2(
     obs_mode = OBS_MODE_NAMES.get(mode_id)
     if obs_mode is None:
         raise ProtocolError(f"WINDOWS2 declares unknown obs mode {mode_id}")
+    has_logprob = bool(flags & FLAG_LOGPROB)
     ob = obs_dim * OBS_MODE_BYTES[obs_mode]
     want = _WINDOWS2_HEAD.size + count * (ob * 2 + 4 * (action_dim + 2))
+    if has_logprob:
+        want += 4 * count
     if len(payload) != want:
         raise ProtocolError(
             f"WINDOWS2 payload is {len(payload)} bytes, header declares "
-            f"{count} rows ({obs_mode} obs) = {want}"
+            f"{count} rows ({obs_mode} obs"
+            f"{', +logprob' if has_logprob else ''}) = {want}"
         )
     off = _WINDOWS2_HEAD.size
     obs = decode_obs_block(
@@ -478,18 +504,26 @@ def decode_windows2(
     )
     off += count * ob
     discount = np.frombuffer(payload, np.float32, count, offset=off).copy()
+    off += 4 * count
+    cols = {
+        "obs": obs,
+        "action": action,
+        "reward": reward,
+        "next_obs": next_obs,
+        "discount": discount,
+    }
+    if has_logprob:
+        # present ONLY when the frame declared it — plain frames keep the
+        # exact pre-flywheel column dict (ingest passes it to Transition)
+        cols["logprob"] = np.frombuffer(
+            payload, np.float32, count, offset=off
+        ).copy()
     return (
         int(gen),
         int(stats_gen),
         obs_mode,
         bool(flags & FLAG_RELABELED),
-        {
-            "obs": obs,
-            "action": action,
-            "reward": reward,
-            "next_obs": next_obs,
-            "discount": discount,
-        },
+        cols,
     )
 
 

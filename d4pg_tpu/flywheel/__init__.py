@@ -1,0 +1,33 @@
+"""The flywheel: served traffic becomes training data, gated off-policy.
+
+Closes the product loop between the serving stack (``d4pg_tpu/serve``)
+and the training stack (``d4pg_tpu/fleet`` ingest → replay → learner):
+
+- :mod:`~d4pg_tpu.flywheel.tap` — the mirror tap. Rides inside a replica
+  (``serve/server.py``) or the router (``serve/router.py``), mirrors a
+  Bresenham-striped fraction of live obs→action traffic whose reward the
+  client echoes back (``FEEDBACK`` frames), assembles it through the
+  existing :class:`~d4pg_tpu.replay.nstep_writer.NStepWriter` into
+  generation-tagged WINDOWS2 frames carrying the behavior log-prob
+  column, and streams them to the fleet ingest (``source: "mirror"``)
+  while appending the same frame bytes to the on-disk mirror spool.
+- :mod:`~d4pg_tpu.flywheel.spool` — the bounded on-disk frame log the
+  tap writes and the router's promotion gate reads (the two live in
+  different processes; the spool is the shared-filesystem seam, same
+  assumption the router's bundle deployment already makes).
+- :mod:`~d4pg_tpu.flywheel.gate` — the off-policy promotion gate: a
+  self-normalized importance-sampling return estimate of the CANDIDATE
+  bundle over mirrored windows, computed with the JAX-free NumPy bundle
+  policy. The router's canary observe phase refuses to promote unless
+  the estimate clears the configured band — a bad-but-valid bundle is
+  blocked before live error rate could ever see it.
+- :mod:`~d4pg_tpu.flywheel.sim_client` — the sim-attached client: plays
+  env episodes THROUGH the serve path (obs from env, action from the
+  server, reward/done echoed back as ``FEEDBACK``), the honest
+  production analog of a logged-reward system; doubles as the fixed-seed
+  evaluator the closed-loop soak measures with.
+
+Every module here is JAX-free (d4pglint ``host-jax-import``): the tap
+runs inside the host-only router, the gate inside its control thread,
+and the sim client is a thin env+socket loop.
+"""

@@ -1,0 +1,173 @@
+"""The mirror spool: a bounded on-disk log of mirrored WINDOWS2 payloads.
+
+The tap (inside a replica or the router) appends every mirrored frame's
+PAYLOAD bytes — exactly what went to the fleet ingest, behavior-log-prob
+column included — and the router's off-policy promotion gate reads them
+back at gate time. One codec (``fleet/wire.py``), two consumers.
+
+Records are length-prefixed payloads in numbered segment files
+(``mirror-00000.log``, ``mirror-00001.log``, …). The writer rotates to a
+new segment past ``segment_bytes`` and deletes the oldest past
+``max_segments`` — the spool is a bounded window over RECENT traffic
+(the gate estimates the CURRENT serving distribution; ancient windows
+would bias it), never an unbounded disk leak. The reader walks segments
+in order and stops cleanly at a torn tail (writer crashed mid-append):
+a torn record never half-decodes, mirroring the wire's whole-frame drop
+contract.
+
+Writer and reader run in different processes with no locking: segment
+files are append-only, the reader tolerates concurrent appends (it reads
+whatever records are complete at open time), and rotation unlinks whole
+segments — a reader holding a deleted segment's fd just finishes it.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterator, List, Optional, Tuple
+
+from d4pg_tpu.fleet import wire
+
+_REC_HEAD = struct.Struct("<I")  # payload byte count
+_SEGMENT_FMT = "mirror-%05d.log"
+_SEGMENT_PREFIX = "mirror-"
+_SEGMENT_SUFFIX = ".log"
+
+
+def _segment_paths(root: str) -> List[str]:
+    try:
+        names = os.listdir(root)
+    except FileNotFoundError:
+        return []
+    segs = sorted(
+        n for n in names
+        if n.startswith(_SEGMENT_PREFIX) and n.endswith(_SEGMENT_SUFFIX)
+    )
+    return [os.path.join(root, n) for n in segs]
+
+
+class MirrorSpool:
+    """Append-only writer half. NOT thread-safe by itself — the tap's
+    single sender thread is the only writer (same single-writer-thread
+    shape as the ingest staging rotation)."""
+
+    def __init__(
+        self,
+        root: str,
+        *,
+        segment_bytes: int = 8 << 20,
+        max_segments: int = 8,
+    ):
+        assert segment_bytes > 0 and max_segments >= 1
+        self.root = root
+        self.segment_bytes = int(segment_bytes)
+        self.max_segments = int(max_segments)
+        os.makedirs(root, exist_ok=True)
+        existing = _segment_paths(root)
+        if existing:
+            last = os.path.basename(existing[-1])
+            self._seq = int(
+                last[len(_SEGMENT_PREFIX):-len(_SEGMENT_SUFFIX)]
+            )
+        else:
+            self._seq = 0
+        self._path = os.path.join(root, _SEGMENT_FMT % self._seq)
+        self._f = open(self._path, "ab")
+        self.appended = 0       # records appended this process
+        self.bytes_appended = 0
+
+    def append(self, payload: bytes) -> None:
+        """One mirrored WINDOWS2 payload. Flushed per record: the gate
+        may read from another process at any moment, and a record
+        buffered in this process is a record the gate silently never
+        sees."""
+        self._f.write(_REC_HEAD.pack(len(payload)) + payload)
+        self._f.flush()
+        self.appended += 1
+        self.bytes_appended += _REC_HEAD.size + len(payload)
+        if self._f.tell() >= self.segment_bytes:
+            self._rotate()
+
+    def _rotate(self) -> None:
+        self._f.close()
+        self._seq += 1
+        self._path = os.path.join(self.root, _SEGMENT_FMT % self._seq)
+        self._f = open(self._path, "ab")
+        segs = _segment_paths(self.root)
+        while len(segs) > self.max_segments:
+            try:
+                os.unlink(segs.pop(0))
+            except OSError:
+                break  # racing cleanup: bounded either way
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except OSError:
+            pass
+
+
+def iter_payloads(root: str) -> Iterator[bytes]:
+    """Every complete record across all segments, oldest first. Stops
+    cleanly at a torn tail (short header or short payload)."""
+    for path in _segment_paths(root):
+        try:
+            f = open(path, "rb")
+        except OSError:
+            continue  # rotated away between listdir and open
+        with f:
+            while True:
+                head = f.read(_REC_HEAD.size)
+                if len(head) < _REC_HEAD.size:
+                    break
+                (n,) = _REC_HEAD.unpack(head)
+                payload = f.read(n)
+                if len(payload) < n:
+                    break  # torn tail: writer died mid-append
+                yield payload
+
+
+def read_windows(
+    root: str,
+    obs_dim: int,
+    action_dim: int,
+    *,
+    min_generation: Optional[int] = None,
+    max_windows: Optional[int] = None,
+) -> Tuple[dict, int]:
+    """Decode the spool into one concatenated column dict (newest last).
+
+    Returns ``(cols, n)`` where ``cols`` holds obs / action / reward /
+    next_obs / discount / logprob arrays (``logprob`` only from frames
+    that carried the column — frames without it are SKIPPED: the gate
+    cannot weight a window whose behavior propensity was never logged).
+    ``min_generation`` drops windows produced by bundles older than the
+    given generation; ``max_windows`` keeps only the NEWEST that many
+    (the gate wants the freshest picture of the serving distribution).
+    ``n == 0`` returns ``({}, 0)``.
+    """
+    import numpy as np
+
+    frames = []
+    for payload in iter_payloads(root):
+        try:
+            gen, _stats_gen, _mode, _relab, cols = wire.decode_windows2(
+                payload, obs_dim, action_dim
+            )
+        except Exception:  # d4pglint: disable=broad-except  -- any undecodable record (foreign dims, torn column block) is skipped by design: the gate reads best-effort from a spool other processes write
+            continue
+        if "logprob" not in cols:
+            continue
+        if min_generation is not None and gen < min_generation:
+            continue
+        frames.append(cols)
+    if not frames:
+        return {}, 0
+    keys = ("obs", "action", "reward", "next_obs", "discount", "logprob")
+    out = {k: np.concatenate([f[k] for f in frames]) for k in keys}
+    n = len(out["reward"])
+    if max_windows is not None and n > max_windows:
+        out = {k: v[-max_windows:] for k, v in out.items()}
+        n = max_windows
+    return out, n

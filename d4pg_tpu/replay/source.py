@@ -525,6 +525,10 @@ LEGACY_ACTOR_CAPS = {
     "her": False,
     "obs_norm": False,
     "variant": 0,
+    # ISSUE 18: which experience stream this connection feeds ("actor" =
+    # collection fleet, "mirror" = flywheel serving tap). Informational —
+    # it selects the ingest server's per-source counter, never a refusal.
+    "source": "actor",
 }
 
 
@@ -611,6 +615,9 @@ def negotiate_fleet(learner: dict, actor: dict
             "her": learner["her"],
             "obs_norm": learner["obs_norm"],
             "variant": learner_variant,
+            # pure passthrough: a mirror tap's windows count under their
+            # own ingest counter but are otherwise ordinary experience
+            "source": str(actor.get("source", "actor")),
         },
         (),
     )

@@ -57,6 +57,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fleet identity: stamped into healthz and every "
                         "metrics.jsonl row so multi-replica soak logs are "
                         "attributable per process")
+    p.add_argument("--mirror-fraction", type=float, default=0.0,
+                   help="flywheel mirror tap: fraction of served EPISODES "
+                        "(Bresenham-striped per connection) whose "
+                        "obs/action/reward traffic is mirrored into "
+                        "training windows; needs clients that echo reward "
+                        "via FEEDBACK frames (flywheel/sim_client.py)")
+    p.add_argument("--mirror-ingest", default=None, metavar="HOST:PORT",
+                   help="fleet ingest to stream mirrored WINDOWS2 frames "
+                        "to (the learner's --fleet-listen port)")
+    p.add_argument("--mirror-spool", default=None, metavar="DIR",
+                   help="on-disk spool of mirrored frames (what the "
+                        "router's off-policy promotion gate reads); "
+                        "independent of --mirror-ingest liveness")
     p.add_argument("--chaos", default=None, metavar="PLAN",
                    help="deterministic fault injection (d4pg_tpu/chaos.py): "
                         "e.g. 'sock_reset@5' force-resets the serving "
@@ -96,6 +109,30 @@ def main(argv=None) -> None:
         if name in policies:
             raise SystemExit(f"--policy {name!r} given twice")
         policies[name] = load_bundle(path)
+    tap = None
+    if args.mirror_fraction > 0:
+        from d4pg_tpu.flywheel.spool import MirrorSpool
+        from d4pg_tpu.flywheel.tap import MirrorTap
+
+        ingest_addr = None
+        if args.mirror_ingest:
+            ih, _, ip = args.mirror_ingest.rpartition(":")
+            ingest_addr = (ih, int(ip))
+        spool = MirrorSpool(args.mirror_spool) if args.mirror_spool else None
+        tap = MirrorTap(
+            obs_dim=bundle.obs_dim,
+            action_dim=bundle.action_dim,
+            n_step=bundle.config.n_step,
+            gamma=bundle.config.gamma,
+            fraction=args.mirror_fraction,
+            ingest_addr=ingest_addr,
+            spool=spool,
+            bundle_dir=args.bundle,
+            env=bundle.meta.get("env", "unknown"),
+            tap_id=f"mirror-replica-{args.replica_id}"
+            if args.replica_id is not None else "mirror-replica",
+            chaos=chaos,
+        )
     server = PolicyServer(
         bundle,
         policies=policies or None,
@@ -113,6 +150,7 @@ def main(argv=None) -> None:
         debug_guards=args.debug_guards,
         chaos=chaos,
         replica_id=args.replica_id,
+        mirror_tap=tap,
     )
 
     install_graceful_signals(
@@ -131,6 +169,16 @@ def main(argv=None) -> None:
         flush=True,
     )
     server.serve_until_shutdown()
+    if tap is not None:
+        # Drain the tap AFTER the server: every admitted request's
+        # feedback has been acked, so the mirror books are final.
+        tap.close()
+        mc = tap.counters()
+        print(
+            "[serve] mirror: "
+            + " ".join(f"{k}={mc[k]}" for k in sorted(mc)),
+            flush=True,
+        )
     snap = server.healthz()
     # aggregate across every resident policy (top-level counters are the
     # DEFAULT policy's — the PR-3 schema)

@@ -189,6 +189,8 @@ class PolicyClient:
                     continue
                 if msg_type == protocol.ACT_OK:
                     fut.set_result(protocol.decode_action(payload))
+                elif msg_type == protocol.FEEDBACK_OK:
+                    fut.set_result(True)
                 elif msg_type == protocol.HEALTHZ_OK:
                     fut.set_result(payload.decode("utf-8", "replace"))
                 elif msg_type == protocol.OVERLOADED:
@@ -309,6 +311,51 @@ class PolicyClient:
                 last = e  # bounded: the Backoff iterator sleeps, then stops
         assert last is not None
         raise last
+
+    def feedback_async(
+        self,
+        reward: float,
+        action: np.ndarray,
+        next_obs: np.ndarray,
+        *,
+        log_prob: float = 0.0,
+        terminated: bool = False,
+        truncated: bool = False,
+        policy_id: Optional[str] = None,
+    ) -> Future:
+        """The flywheel reward echo (``FEEDBACK``, frame version 2): the
+        env outcome of the EXECUTED action for this connection's previous
+        request, with its behavior log-prob. Resolves True on the
+        server's ack; against an old server it fails loudly with the
+        version ERROR — plain v1 traffic never emits this frame."""
+        req_id, fut = self._register()
+        if self._fail_if_dead(req_id, fut):
+            return fut
+        payload = protocol.encode_feedback(
+            reward,
+            action,
+            next_obs,
+            log_prob=log_prob,
+            terminated=terminated,
+            truncated=truncated,
+            policy_id=(
+                policy_id if policy_id is not None
+                else (self.policy_id or protocol.DEFAULT_POLICY)
+            ),
+        )
+        try:
+            self._send(protocol.FEEDBACK, req_id, payload)
+        except OSError as e:
+            with self._pending_lock:
+                self._pending.pop(req_id, None)
+            if not fut.done():
+                fut.set_exception(ConnectionClosed(str(e)))
+        return fut
+
+    def feedback(self, *args, timeout: Optional[float] = None, **kw) -> bool:
+        return self.feedback_async(*args, **kw).result(
+            timeout if timeout is not None else self.timeout
+        )
 
     def healthz(self, timeout: Optional[float] = None) -> dict:
         import json

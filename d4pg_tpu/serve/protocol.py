@@ -76,6 +76,26 @@ layout on its own port with four more message types (payload codecs in
                      stats-generation drops). A shed frame is answered
                      ``OVERLOADED`` instead.
 
+The flywheel (ISSUE 18) adds one client→server pair, rides frame
+version 2 — plain v1/v2 ACT traffic never carries it, so the v1
+sublanguage stays byte-identical both directions:
+
+- ``FEEDBACK``     → ``u8 policy_len  u8 action_dim  u8 flags (bit 0
+                     terminated, bit 1 truncated)  u8 reserved
+                     f32 reward  f32 log_prob`` + policy_id utf-8 +
+                     executed action float32s + next_obs float32s. A
+                     sim-attached client's reward echo for its PREVIOUS
+                     request on this connection: the env outcome of the
+                     action it executed (served action + client-side
+                     exploration noise), with the behavior-policy
+                     log-prob of that executed action — the logged
+                     propensity the off-policy promotion gate weights
+                     by. Carrying next_obs explicitly lets the mirror
+                     tap close episode ends without a following ACT.
+- ``FEEDBACK_OK``  ← empty. Ack (the client may pipeline feedback like
+                     requests). A server without the tap enabled still
+                     acks — feedback is then simply not mirrored.
+
 ``read_frame`` returns ``None`` on clean EOF (peer closed between frames)
 and raises :class:`ProtocolError` on anything malformed — oversized
 declared length, bad magic, version mismatch, or EOF mid-frame.
@@ -102,6 +122,8 @@ MAX_PAYLOAD = 1 << 20
 HEADER = struct.Struct("<2sBBII")
 _DEADLINE = struct.Struct("<I")
 _ACT2_HEAD = struct.Struct("<BBBBI")  # qos, policy_len, tenant_len, rsvd, deadline
+# policy_len, action_dim, flags, rsvd, reward, log_prob
+_FEEDBACK_HEAD = struct.Struct("<BBBBff")
 
 # message types (one id space across serving AND fleet ingest: the framing
 # layer is shared, so a frame routed at the wrong port fails loudly on type)
@@ -117,6 +139,8 @@ WINDOWS = 9       # batch of complete n-step windows
 WINDOWS_OK = 10
 ACT2 = 11         # versioned multi-tenant request: policy_id + QoS + tenant
 WINDOWS2 = 12     # capability-era window frame: obs mode + stats generation
+FEEDBACK = 13     # flywheel reward echo: env outcome of the served action
+FEEDBACK_OK = 14
 
 # QoS classes carried in the ACT2 frame. Interactive is the protected
 # tier (the router sheds bulk FIRST under overload — docs/serving.md);
@@ -129,7 +153,7 @@ QOS_NAMES = {QOS_INTERACTIVE: "interactive", QOS_BULK: "bulk"}
 # PR-8 wire language). ``write_frame`` applies it, so call sites never
 # choose a version — interop with old peers is automatic for old types,
 # and new types fail loudly on old peers with a version error.
-_FRAME_MIN_VERSION = {ACT2: 2, WINDOWS2: 2}
+_FRAME_MIN_VERSION = {ACT2: 2, WINDOWS2: 2, FEEDBACK: 2, FEEDBACK_OK: 2}
 
 
 class ProtocolError(Exception):
@@ -365,6 +389,90 @@ def decode_act2(payload: bytes) -> Tuple[np.ndarray, int, str, int, str]:
         )
     obs = np.frombuffer(payload, np.float32, offset=obs_off).copy()
     return obs, deadline_us, policy_id or DEFAULT_POLICY, qos, tenant
+
+
+# Flags carried in the FEEDBACK frame (episode-boundary bits; both unset
+# for a mid-episode step).
+FEEDBACK_TERMINATED = 1
+FEEDBACK_TRUNCATED = 2
+
+
+def encode_feedback(
+    reward: float,
+    action: np.ndarray,
+    next_obs: np.ndarray,
+    *,
+    log_prob: float = 0.0,
+    terminated: bool = False,
+    truncated: bool = False,
+    policy_id: str = DEFAULT_POLICY,
+) -> bytes:
+    """The flywheel reward echo (see module docstring layout). ``action``
+    is the EXECUTED action (served action + any client-side exploration
+    noise) and ``log_prob`` its density under the client's behavior
+    policy — the logged propensity the IS promotion gate divides by."""
+    pid = policy_id.encode("utf-8")
+    if len(pid) > 255:
+        raise ProtocolError(f"policy_id longer than 255 bytes: {policy_id!r}")
+    action = np.ascontiguousarray(action, dtype=np.float32)
+    if action.ndim != 1 or action.shape[0] > 255:
+        raise ProtocolError(
+            f"FEEDBACK action must be 1-D with dim <= 255, got "
+            f"shape {action.shape}"
+        )
+    flags = (FEEDBACK_TERMINATED if terminated else 0) | (
+        FEEDBACK_TRUNCATED if truncated else 0
+    )
+    next_obs = np.ascontiguousarray(next_obs, dtype=np.float32)
+    return (
+        _FEEDBACK_HEAD.pack(
+            len(pid), action.shape[0], flags, 0,
+            float(reward), float(log_prob),
+        )
+        + pid
+        + action.tobytes()
+        + next_obs.tobytes()
+    )
+
+
+def decode_feedback(payload: bytes) -> dict:
+    """→ ``{policy_id, reward, log_prob, terminated, truncated, action,
+    next_obs}``. The next_obs length is self-described (remainder); the
+    SERVER validates both dims against the routed policy and answers a
+    per-request ``ERROR`` on mismatch (framing intact, connection
+    survives) — the same contract as ``ACT2``."""
+    if len(payload) < _FEEDBACK_HEAD.size:
+        raise ProtocolError(
+            f"FEEDBACK payload is {len(payload)} bytes, header needs "
+            f"{_FEEDBACK_HEAD.size}"
+        )
+    plen, adim, flags, _rsvd, reward, log_prob = _FEEDBACK_HEAD.unpack_from(
+        payload
+    )
+    off = _FEEDBACK_HEAD.size
+    if len(payload) < off + plen + 4 * adim:
+        raise ProtocolError(
+            f"FEEDBACK payload is {len(payload)} bytes, ids+action declare "
+            f"{off + plen + 4 * adim}"
+        )
+    policy_id = payload[off:off + plen].decode("utf-8", "replace")
+    off += plen
+    action = np.frombuffer(payload, np.float32, adim, offset=off).copy()
+    off += 4 * adim
+    if (len(payload) - off) % 4:
+        raise ProtocolError(
+            f"FEEDBACK next_obs bytes ({len(payload) - off}) not float32"
+        )
+    next_obs = np.frombuffer(payload, np.float32, offset=off).copy()
+    return {
+        "policy_id": policy_id or DEFAULT_POLICY,
+        "reward": float(reward),
+        "log_prob": float(log_prob),
+        "terminated": bool(flags & FEEDBACK_TERMINATED),
+        "truncated": bool(flags & FEEDBACK_TRUNCATED),
+        "action": action,
+        "next_obs": next_obs,
+    }
 
 
 def encode_action(action: np.ndarray) -> bytes:

@@ -184,6 +184,14 @@ class RouterStats:
         self.protocol_errors = 0
         self.canary_rollbacks = 0
         self.canary_promotions = 0
+        # flywheel (ISSUE 18): reward echoes handled at the router tap,
+        # and the off-policy promotion gate's verdict tallies
+        # (evaluations == pass + block + stalls at quiesce)
+        self.feedback_frames = 0
+        self.gate_evaluations = 0
+        self.gate_pass = 0
+        self.gate_block = 0
+        self.gate_stalls = 0
         # admission sheds (each also counted in replies_overloaded — they
         # ARE overloaded answers; these break the reason down)
         self.shed_quota = 0
@@ -258,6 +266,11 @@ class RouterStats:
                 "protocol_errors": self.protocol_errors,
                 "canary_rollbacks": self.canary_rollbacks,
                 "canary_promotions": self.canary_promotions,
+                "feedback_frames": self.feedback_frames,
+                "gate_evaluations": self.gate_evaluations,
+                "gate_pass": self.gate_pass,
+                "gate_block": self.gate_block,
+                "gate_stalls": self.gate_stalls,
                 "shed_quota": self.shed_quota,
                 "shed_bulk_capacity": self.shed_bulk_capacity,
                 "shed_capacity": self.shed_capacity,
@@ -343,6 +356,11 @@ class _Rollout:
     _THREAD_SAFE = (
         "seen_mtime", "version", "deadline", "rollback_deadline",
         "deploys", "promote_done", "rollback_dir", "backed_up", "state",
+        # off-policy gate handshake: gate_started/gate_token are
+        # control-thread-only; gate_result is a single None→dict
+        # transition by the gate worker, read by the control thread
+        # (one-tick staleness tolerated, token-fenced against stragglers)
+        "gate_started", "gate_result", "gate_token",
     )
 
     def __init__(self, policy: str, src_dir: str, window: int):
@@ -357,6 +375,14 @@ class _Rollout:
         self.promote_done: set = set()
         self.rollback_dir: Optional[str] = None
         self.backed_up: set = set()
+        # off-policy promotion gate (ISSUE 18): one evaluation per
+        # observation phase, run off the control thread (the spool read +
+        # policy load may block; a stalled gate must not freeze every
+        # OTHER rollout's state machine). gate_token fences late writes
+        # from a stalled worker of a PREVIOUS observation phase.
+        self.gate_started = False
+        self.gate_result: Optional[dict] = None
+        self.gate_token = 0
         # per-rollout stripe counter (under the router lock): the
         # Bresenham fraction must be exact over THIS policy's requests,
         # not the global sequence mixed across policies
@@ -390,7 +416,13 @@ class Router:
     # d4pglint thread-lifecycle: per-connection reader threads are not
     # joined — drain() closes every socket in _conns, which unblocks the
     # blocking read_frame immediately (same contract as PolicyServer).
-    _DETACHED_THREADS = ("router-conn",)
+    # router-gate workers are bounded by the gate evaluation itself
+    # (spool read + one NumPy policy forward); a wedged one (gate_stall
+    # chaos, hung filesystem) is exactly the fault the observe-deadline
+    # rollback covers, and its late verdict is token-fenced out —
+    # joining would hand the control thread the very stall the design
+    # isolates it from.
+    _DETACHED_THREADS = ("router-conn", "router-gate")
 
     def __init__(
         self,
@@ -414,6 +446,13 @@ class Router:
         canary_p99_ratio: float = 3.0,
         canary_attest_timeout_s: float = 30.0,
         canary_observe_timeout_s: float = 600.0,
+        mirror_tap=None,
+        gate_spool: Optional[str] = None,
+        gate_sigma: float = 0.3,
+        gate_min_windows: int = 16,
+        gate_min_ess: float = 4.0,
+        gate_band: float = 1.0,
+        gate_max_windows: int = 512,
         log_dir: Optional[str] = None,
         metrics_interval_s: float = 30.0,
         chaos=None,
@@ -505,6 +544,18 @@ class Router:
         # probes count as healthy again (the re-eject-until-old-bundle
         # rollback contract, per policy)
         self._readmit_gate: dict = {}
+
+        # ---- flywheel (ISSUE 18): router-position mirror tap + IS gate ----
+        # The tap is externally owned (main() builds/closes it); the gate
+        # reads the mirror SPOOL — candidate return is estimated from
+        # logged behavior traffic, never from live requests.
+        self._tap = mirror_tap
+        self._gate_spool = gate_spool
+        self._gate_sigma = float(gate_sigma)
+        self._gate_min_windows = int(gate_min_windows)
+        self._gate_min_ess = float(gate_min_ess)
+        self._gate_band = float(gate_band)
+        self._gate_max_windows = int(gate_max_windows)
 
         # ---- QoS + per-tenant admission (the multi-tenant tier) ----
         # tenant -> TokenBucket, built from the configured quotas and
@@ -1361,8 +1412,31 @@ class Router:
                             f"{policy!r} wants {known}".encode(),
                         )
                         continue
+                elif msg_type == protocol.FEEDBACK:
+                    # Reward echo for THIS connection's previous request —
+                    # handled LOCALLY (the router decoded the obs, so it
+                    # can pair the feedback itself; forwarding would need
+                    # replica-sticky feedback routing for no benefit).
+                    # Always acked: clients need not know whether a tap
+                    # rides this router.
+                    fb = protocol.decode_feedback(payload)
+                    self.stats.inc("feedback_frames")
+                    if (
+                        self._tap is not None
+                        and fb["policy_id"] == protocol.DEFAULT_POLICY
+                    ):
+                        self._tap.on_feedback(id(conn), fb)
+                    reply(protocol.FEEDBACK_OK, req_id)
+                    continue
                 else:
                     raise ProtocolError(f"unexpected message type {msg_type}")
+                if (
+                    self._tap is not None
+                    and policy == protocol.DEFAULT_POLICY
+                ):
+                    # remember the obs this connection's next FEEDBACK
+                    # pairs with
+                    self._tap.on_request(id(conn), obs)
                 self.stats.inc("requests_total")
                 self.stats.tenant_request(tenant, qos)
                 if self._shutdown.is_set():
@@ -1418,6 +1492,9 @@ class Router:
         finally:
             with self._conns_lock:
                 self._conns.discard(conn)
+            if self._tap is not None:
+                # vanished client: drop its half-built mirror window whole
+                self._tap.on_disconnect(id(conn))
             try:
                 rfile.close()
             except OSError:
@@ -1562,6 +1639,12 @@ class Router:
             # is worse than retrying later under real load)
             ro.deadline = time.monotonic() + self._observe_timeout_s
             self._clear_windows(ro)
+            # fresh observation phase → fresh gate: the token fences any
+            # still-running gate worker from a previous phase out of
+            # writing into this one
+            ro.gate_started = False
+            ro.gate_result = None
+            ro.gate_token += 1
             self._record_event("canary_observing", policy=ro.policy,
                                version=ro.version)
         elif failed or time.monotonic() > ro.deadline:
@@ -1624,6 +1707,50 @@ class Router:
                 **verdict,
             )
         else:
+            # The live verdict (errors + p99) passed. A bad-but-valid
+            # bundle shows NEITHER signal — it serves cleanly while
+            # steering the plant wrong — so when an off-policy gate is
+            # configured, promotion additionally needs its IS-estimate
+            # verdict over the MIRRORED windows (flywheel/gate.py).
+            if self._gate_spool is not None:
+                if not ro.gate_started:
+                    # kick the evaluation off-thread (spool read + policy
+                    # load may block; gate_stall chaos sleeps in there)
+                    # and keep observing until it resolves
+                    ro.gate_started = True
+                    ro.gate_result = None
+                    token = ro.gate_token
+                    self.stats.inc("gate_evaluations")
+                    self._record_event("gate_evaluating", policy=ro.policy,
+                                       version=ro.version)
+                    threading.Thread(
+                        target=self._gate_worker, args=(ro, token),
+                        name="router-gate", daemon=True,
+                    ).start()
+                    return
+                gate = ro.gate_result
+                if gate is None:
+                    if time.monotonic() > ro.deadline:
+                        # the observe deadline bounds the gate too: a
+                        # wedged evaluation must not hold the rollout —
+                        # and every newer version behind it — forever
+                        self.stats.inc("gate_stalls")
+                        self._canary_rollback(
+                            ro,
+                            "promotion gate stalled past observe deadline",
+                            **verdict,
+                        )
+                    return
+                if not gate.get("passed"):
+                    self.stats.inc("gate_block")
+                    self._canary_rollback(
+                        ro,
+                        f"off-policy gate: {gate.get('reason')}",
+                        gate=gate, **verdict,
+                    )
+                    return
+                self.stats.inc("gate_pass")
+                verdict["gate"] = gate
             # canary_promotions ticks at COMPLETION (the canary_promoted
             # terminal in _canary_promote), not here at the verdict: a
             # promote that later fails (deploy I/O, attestation timeout)
@@ -1633,6 +1760,44 @@ class Router:
             self._set_canary_state(ro, "promoting")
             self._record_event("canary_promote", policy=ro.policy,
                                version=ro.version, **verdict)
+
+    def _gate_worker(self, ro: _Rollout, token: int) -> None:
+        """One off-policy gate evaluation (its own thread): estimate the
+        CANDIDATE bundle's return on the mirror spool's logged behavior
+        windows. Any failure becomes a refusing verdict — a gate that
+        dies must block the promotion loudly, never wedge or wave it
+        through."""
+        try:
+            if self._chaos is not None:
+                e = self._chaos.tick("gate_stall")
+                if e is not None:
+                    # stall INSIDE the evaluation (a wedged spool read /
+                    # slow shared filesystem): the control thread must
+                    # roll back at the observe deadline, not wait forever
+                    time.sleep(e.arg if e.arg is not None else 3600.0)
+            from d4pg_tpu.fleet.policy import load_numpy_policy
+            from d4pg_tpu.flywheel.gate import evaluate_is_gate
+            from d4pg_tpu.flywheel.spool import read_windows
+
+            pol = load_numpy_policy(ro.src_dir)
+            cols, _n = read_windows(
+                self._gate_spool, pol.obs_dim, pol.action_dim,
+                max_windows=self._gate_max_windows,
+            )
+            verdict = evaluate_is_gate(
+                cols, pol,
+                sigma=self._gate_sigma,
+                min_windows=self._gate_min_windows,
+                min_ess=self._gate_min_ess,
+                band=self._gate_band,
+            )
+        except Exception as e:  # d4pglint: disable=broad-except  -- every failure class (missing spool, unreadable bundle, bad dims) becomes a REFUSING verdict carrying the repr: logged via the canary_rollback event, never swallowed
+            verdict = {
+                "samples": 0, "passed": False,
+                "reason": f"gate evaluation failed: {e!r}",
+            }
+        if ro.gate_token == token:
+            ro.gate_result = verdict
 
     def _canary_promote(self, ro: _Rollout) -> None:
         """Roll the remaining baselines forward ONE at a time, each
@@ -1945,6 +2110,18 @@ class Router:
             "bulk_limit": int(capacity * self._bulk_fraction),
         }
         snap["tenants"] = self.stats.tenants_snapshot()
+        if self._tap is not None:
+            # router-position mirror tap books (ISSUE 18): the smoke/soak
+            # recompute the windows_built identity from this block
+            snap["mirror"] = self._tap.counters()
+        if self._gate_spool is not None:
+            snap["gate"] = {
+                "spool": self._gate_spool,
+                "sigma": self._gate_sigma,
+                "min_windows": self._gate_min_windows,
+                "min_ess": self._gate_min_ess,
+                "band": self._gate_band,
+            }
         with self._events_lock:
             snap["events_total"] = self._events_total
             snap["events_tail"] = list(self._events)[-20:]
@@ -2111,6 +2288,46 @@ def build_parser():
                         "--canary-min-samples before the rollout rolls "
                         "back (too little traffic must not wedge a "
                         "rollout in 'observing' forever)")
+    g = p.add_argument_group("flywheel (d4pg_tpu/flywheel)")
+    g.add_argument("--mirror-fraction", type=float, default=0.0,
+                   help="mirror tap at the ROUTER: fraction of served "
+                        "episodes (per client connection, Bresenham-"
+                        "striped) whose obs/action/reward traffic becomes "
+                        "training windows; needs clients that echo reward "
+                        "via FEEDBACK frames (flywheel/sim_client.py)")
+    g.add_argument("--mirror-bundle", default=None, metavar="DIR",
+                   help="bundle dir giving the tap its obs/action dims, "
+                        "n-step/gamma, and generation tags (default: the "
+                        "first --backend-bundles default-policy dir)")
+    g.add_argument("--mirror-ingest", default=None, metavar="HOST:PORT",
+                   help="fleet ingest to stream mirrored WINDOWS2 frames "
+                        "to (the learner's --fleet-listen port)")
+    g.add_argument("--mirror-spool", default=None, metavar="DIR",
+                   help="on-disk spool of mirrored frames; also the "
+                        "default --gate-spool")
+    g.add_argument("--gate-spool", default=None, metavar="DIR",
+                   help="arm the off-policy promotion gate on this mirror "
+                        "spool: a canary additionally needs its "
+                        "importance-weighted return estimate over the "
+                        "mirrored windows to clear the gate before it "
+                        "promotes (defaults to --mirror-spool when set)")
+    g.add_argument("--gate-sigma", type=float, default=0.3,
+                   help="exploration σ the behavior propensities were "
+                        "logged under (must match the clients' "
+                        "--noise-sigma); the candidate is scored as "
+                        "N(μ_cand(s), σ²)")
+    g.add_argument("--gate-min-windows", type=int, default=16,
+                   help="mirrored windows required for a verdict: a "
+                        "starved gate refuses, it never guesses")
+    g.add_argument("--gate-min-ess", type=float, default=4.0,
+                   help="minimum effective sample size: below it the "
+                        "candidate is too far off the serving "
+                        "distribution to estimate, and is refused")
+    g.add_argument("--gate-band", type=float, default=1.0,
+                   help="tolerated estimated-return shortfall vs the "
+                        "behavior policy before the gate refuses")
+    g.add_argument("--gate-max-windows", type=int, default=512,
+                   help="newest spool windows the gate evaluates over")
     p.add_argument("--log-dir", default=None,
                    help="append router metrics rows (metrics.jsonl) here")
     p.add_argument("--metrics-interval", type=float, default=30.0)
@@ -2118,8 +2335,9 @@ def build_parser():
                    help="deterministic fault injection (d4pg_tpu/chaos.py): "
                         "replica_kill@N / replica_slow@N:ms / "
                         "canary_corrupt@N / tenant_flood@N:tenant / "
-                        "policy_skew@N (scaledown_during_canary@N ticks "
-                        "in the autoscaler)")
+                        "policy_skew@N / mirror_drop@N / gate_stall@N:s "
+                        "(scaledown_during_canary@N ticks in the "
+                        "autoscaler)")
     g = p.add_argument_group("autoscaler (serve/autoscaler.py)")
     g.add_argument("--autoscale", action="store_true",
                    help="run the healthz-driven autoscaler in-process: "
@@ -2209,6 +2427,47 @@ def main(argv=None) -> None:
         from d4pg_tpu.chaos import ChaosInjector, ChaosPlan
 
         chaos = ChaosInjector(ChaosPlan.parse(args.chaos))
+    tap = None
+    if args.mirror_fraction > 0:
+        from d4pg_tpu.fleet.policy import load_numpy_policy
+        from d4pg_tpu.flywheel.spool import MirrorSpool
+        from d4pg_tpu.flywheel.tap import MirrorTap
+
+        mirror_bundle = args.mirror_bundle
+        if mirror_bundle is None:
+            for b in bundles or []:
+                if isinstance(b, str):
+                    mirror_bundle = b
+                    break
+                if isinstance(b, dict) and protocol.DEFAULT_POLICY in b:
+                    mirror_bundle = b[protocol.DEFAULT_POLICY]
+                    break
+        if mirror_bundle is None:
+            raise SystemExit(
+                "--mirror-fraction needs --mirror-bundle (or a "
+                "--backend-bundles default-policy dir) for the tap's "
+                "dims, n-step/gamma, and generation tags"
+            )
+        np_pol = load_numpy_policy(mirror_bundle)
+        ingest_addr = None
+        if args.mirror_ingest:
+            ih, _, ip = args.mirror_ingest.rpartition(":")
+            ingest_addr = (ih, int(ip))
+        spool = MirrorSpool(args.mirror_spool) if args.mirror_spool else None
+        tap = MirrorTap(
+            obs_dim=np_pol.obs_dim,
+            action_dim=np_pol.action_dim,
+            n_step=np_pol.n_step,
+            gamma=np_pol.gamma,
+            fraction=args.mirror_fraction,
+            ingest_addr=ingest_addr,
+            spool=spool,
+            bundle_dir=mirror_bundle,
+            env="router",
+            tap_id="mirror-router",
+            chaos=chaos,
+        )
+    gate_spool = args.gate_spool or args.mirror_spool
     router = Router(
         backends,
         host=args.host,
@@ -2235,6 +2494,13 @@ def main(argv=None) -> None:
         canary_p99_ratio=args.canary_p99_ratio,
         canary_attest_timeout_s=args.canary_attest_timeout,
         canary_observe_timeout_s=args.canary_observe_timeout,
+        mirror_tap=tap,
+        gate_spool=gate_spool,
+        gate_sigma=args.gate_sigma,
+        gate_min_windows=args.gate_min_windows,
+        gate_min_ess=args.gate_min_ess,
+        gate_band=args.gate_band,
+        gate_max_windows=args.gate_max_windows,
         log_dir=args.log_dir,
         metrics_interval_s=args.metrics_interval,
         chaos=chaos,
@@ -2309,6 +2575,16 @@ def main(argv=None) -> None:
             flush=True,
         )
     router.serve_until_shutdown()
+    if tap is not None:
+        # after the router's drain: every connection is closed, so the
+        # mirror books are final
+        tap.close()
+        mc = tap.counters()
+        print(
+            "[router] mirror: "
+            + " ".join(f"{k}={mc[k]}" for k in sorted(mc)),
+            flush=True,
+        )
     if scaler is not None:
         scaler.close()
         print(f"[router] autoscaler: {scaler.snapshot()}", flush=True)

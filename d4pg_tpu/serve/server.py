@@ -168,6 +168,7 @@ class PolicyServer:
         chaos=None,
         replica_id: Optional[int] = None,
         policies: Optional[dict] = None,
+        mirror_tap=None,
     ):
         self.bundle = bundle
         # Fleet attribution (--replica-id): stamped into healthz and every
@@ -234,6 +235,12 @@ class PolicyServer:
         # once per received frame and force-resets the connection — proves
         # the reader/reply paths survive abrupt client death end-to-end.
         self._chaos = chaos
+        # Flywheel mirror tap (ISSUE 18, or None): mirrors DEFAULT-policy
+        # obs→action traffic whose reward the client echoes back with
+        # FEEDBACK frames. Externally owned (``serve/__main__`` builds and
+        # closes it) — the server only feeds it request/feedback pairs and
+        # surfaces its counters in healthz.
+        self._tap = mirror_tap
         self._watch_run = watch_run
         self._poll_interval_s = poll_interval_s
         self._best_mtime = self._stat_best() if watch_run else None
@@ -601,8 +608,46 @@ class PolicyServer:
                             f"{policy_id!r} wants {pol.bundle.obs_dim}".encode(),
                         )
                         continue
+                elif msg_type == protocol.FEEDBACK:
+                    # Reward echo for THIS connection's previous ACT (the
+                    # flywheel's closed loop). Malformed frames are
+                    # per-request ERRORs — the connection survives; the
+                    # frame is ALWAYS acked so clients need not know
+                    # whether a tap is attached.
+                    fb = protocol.decode_feedback(payload)
+                    fpol = self._policies.get(fb["policy_id"])
+                    if fpol is None:
+                        self.stats.inc("unknown_policy")
+                        reply(
+                            protocol.ERROR, req_id,
+                            f"unknown policy {fb['policy_id']!r} (resident: "
+                            f"{sorted(self._policies)})".encode(),
+                        )
+                        continue
+                    if (
+                        fb["action"].shape[0] != fpol.bundle.action_dim
+                        or fb["next_obs"].shape[0] != fpol.bundle.obs_dim
+                    ):
+                        reply(
+                            protocol.ERROR, req_id,
+                            f"feedback dims ({fb['action'].shape[0]} act, "
+                            f"{fb['next_obs'].shape[0]} obs) do not match "
+                            f"policy {fb['policy_id']!r} "
+                            f"({fpol.bundle.action_dim} act, "
+                            f"{fpol.bundle.obs_dim} obs)".encode(),
+                        )
+                        continue
+                    self.stats.inc("feedback_frames")
+                    if self._tap is not None and fpol is self._default:
+                        self._tap.on_feedback(id(conn), fb)
+                    reply(protocol.FEEDBACK_OK, req_id)
+                    continue
                 else:
                     raise ProtocolError(f"unexpected message type {msg_type}")
+                if self._tap is not None and pol is self._default:
+                    # Remember this connection's latest request obs; the
+                    # client's next FEEDBACK frame completes the pair.
+                    self._tap.on_request(id(conn), obs)
                 deadline_s = (
                     deadline_us / 1e6 if deadline_us else self.default_deadline_s
                 )
@@ -640,6 +685,11 @@ class PolicyServer:
         finally:
             with self._conns_lock:
                 self._conns.discard(conn)
+            if self._tap is not None:
+                # Episode boundary is the CONNECTION: a vanished client's
+                # half-built window is dropped whole, never flushed as if
+                # the episode ended cleanly.
+                self._tap.on_disconnect(id(conn))
             try:
                 rfile.close()
             except OSError:
@@ -694,6 +744,11 @@ class PolicyServer:
         snap["policies"] = rows
         snap["replica_id"] = self.replica_id
         snap["pid"] = os.getpid()
+        if self._tap is not None:
+            # Mirror-tap accounting (ISSUE 18): every counter the tap's
+            # windows_built == acked + stale + shed + dropped_* identity
+            # is recomputed from by the smoke/soak checks.
+            snap["mirror"] = self._tap.counters()
         snap["stage_ms"] = {
             k: round(v, 4)
             for k, v in self.batcher.timers.summary_ms().items()

@@ -106,6 +106,7 @@ class ServeStats:
         self.protocol_errors = 0
         self.dropped_replies = 0   # client gone before its reply
         self.unknown_policy = 0    # well-formed ACT2 naming a non-resident policy
+        self.feedback_frames = 0   # reward echoes accepted (flywheel mirror)
         self.batches_total = 0
         self.padded_rows_total = 0
         self.params_version = 0
@@ -138,6 +139,7 @@ class ServeStats:
                 "protocol_errors": self.protocol_errors,
                 "dropped_replies": self.dropped_replies,
                 "unknown_policy": self.unknown_policy,
+                "feedback_frames": self.feedback_frames,
                 "batches_total": self.batches_total,
                 "padded_rows_total": self.padded_rows_total,
                 "params_version": self.params_version,

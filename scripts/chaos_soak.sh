@@ -48,6 +48,17 @@
 #      accounting identity exact (process tenures, actor windows), end
 #      with lockwitness 0 contradictions and zero surviving processes,
 #      and emit the schema-gated league_soak.json artifact.
+#  10. the flywheel (ISSUE 18): a fleet-only learner paced ENTIRELY by
+#      the router's mirror tap (served traffic becomes training data,
+#      logged propensities riding the frames), a promotion ladder where
+#      every canary additionally needs the off-policy IS gate's verdict
+#      over the mirrored windows, under gate_stall (first evaluation
+#      wedges — bounded rollback, never a hang) and mirror_drop (tap
+#      losses stay on the books); a planted collapsed bundle that serves
+#      error-free must be gate-BLOCKED before live error rate sees it,
+#      the fixed-seed served return must strictly rise across the soak,
+#      both planes' accounting identities hold exact, and the leg emits
+#      the schema-gated flywheel_soak.json artifact.
 #
 # Knobs (env vars): SOAK_DIR (default mktemp), SOAK_ENV (Pendulum-v1),
 # SOAK_STEPS (grad steps per leg, default 6), SOAK_HIDDEN (16,16),
@@ -892,6 +903,423 @@ if pgrep -f "log-dir $DIR/league/v" > /dev/null 2>&1 \
    || pgrep -f "d4pg_tpu.fleet.actor.*$LEAGUE9_PORT" > /dev/null 2>&1; then
   echo "CHAOS_SOAK_FAIL: league processes survived the shutdown"
   pgrep -af "$DIR/league" || true
+  exit 1
+fi
+
+# ---- leg 10: the flywheel — served traffic becomes training data, gated
+# promotions close the loop (ISSUE 18). A fleet-only learner is paced
+# ENTIRELY by the router's mirror tap (no actors, no local envs): two
+# replicas serve the learner's random gen-0 bundle to noisy sim clients
+# through the router, whose tap streams every served episode back to the
+# learner's ingest and spools it for the gate. A promotion ladder then
+# offers the learner's published generations a few hops at a time — each
+# offer must clear the off-policy IS gate's verdict over the spooled
+# windows, and each promotion moves the SERVING behavior, which is what
+# keeps the next candidate inside the gate's effective-sample-size reach.
+# Chaos: gate_stall (the first evaluation wedges — the observe deadline
+# must bound it into a rollback, never a hang) and mirror_drop (tap
+# losses stay on the books). A planted collapsed-constant bundle (serves
+# error-free, steers the plant into the ground) must be BLOCKED by the
+# gate before live error rate ever sees it. Contracts: the fixed-seed
+# served return strictly rises across the soak (the bundle improved on
+# its OWN served traffic), gate accounting exact (evaluations == pass +
+# block + stalls), both planes' window identities exact, every drain
+# rc 0, zero surviving processes, and the run emits the schema-gated
+# flywheel_soak.json acceptance artifact.
+python - "$DIR" <<'EOF'
+import json
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, "scripts")
+sys.path.insert(0, ".")
+from spawnlib import spawn
+
+d = sys.argv[1]
+F = f"{d}/flywheel"
+os.makedirs(F, exist_ok=True)
+
+
+def wait_for(cond, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if cond():
+                return
+        except OSError:
+            pass
+        time.sleep(0.3)
+    raise SystemExit(f"CHAOS_SOAK_FAIL: timed out waiting for {what}")
+
+
+# The learner: NO local collection, NO fleet actors — its pacing loop can
+# only advance on windows the router's mirror tap feeds it. max-gen-lag is
+# effectively off because flywheel data is off-generation BY DESIGN: the
+# serving fleet always lags training, that is what the gate is for.
+learner = spawn(
+    [sys.executable, "train.py", "--env", "Pendulum-v1",
+     "--hidden-sizes", "64,64", "--n-step", "3", "--tau", "0.005",
+     "--lr-actor", "5e-4", "--lr-critic", "5e-4", "--bsize", "128",
+     "--rmsize", "50000", "--warmup", "1500",
+     "--env-steps-per-train-step", "2.0", "--total-steps", "4000",
+     "--seed", "0", "--eval-interval", "1000000", "--eval-episodes", "1",
+     "--checkpoint-interval", "1000000", "--num-envs", "0",
+     "--fleet-listen", "0", "--fleet-host", "127.0.0.1",
+     "--fleet-bundle", f"{F}/lbundle", "--fleet-publish-interval", "250",
+     "--fleet-max-gen-lag", "1000000",
+     "--debug-guards", "--log-dir", F],
+    "fly-learner")
+iport = learner.wait_port(600)
+wait_for(lambda: os.path.exists(f"{F}/lbundle/bundle.json"), 300,
+         "the learner's gen-0 publish")
+
+
+def lgen():
+    with open(f"{F}/lbundle/bundle.json") as f:
+        return int(json.load(f)["meta"]["generation"])
+
+
+def snapshot(dst):
+    """Copy the learner's live publish dir without tearing a params/json
+    pair (the export is params-first/json-second: an equal generation
+    before and after the copy means the pair is consistent)."""
+    for _ in range(50):
+        g0 = lgen()
+        if os.path.exists(dst):
+            shutil.rmtree(dst)
+        shutil.copytree(f"{F}/lbundle", dst)
+        if lgen() == g0:
+            return g0
+        time.sleep(0.1)
+    raise SystemExit("CHAOS_SOAK_FAIL: could not snapshot a stable bundle")
+
+
+# gen 0 — the random init, the deliberately-degraded start — serves the fleet
+for rid in (0, 1):
+    snapshot(f"{F}/r{rid}")
+reps = [
+    spawn([sys.executable, "-m", "d4pg_tpu.serve",
+           "--bundle", f"{F}/r{rid}", "--port", "0",
+           "--max-batch", "8", "--max-wait-us", "500",
+           "--poll-interval", "0.2", "--replica-id", str(rid),
+           "--debug-guards"], f"fly-replica{rid}")
+    for rid in (0, 1)
+]
+ports = [r.wait_port(300) for r in reps]
+
+router = spawn(
+    [sys.executable, "-m", "d4pg_tpu.serve.router",
+     "--backends", ",".join(f"127.0.0.1:{p}" for p in ports),
+     "--backend-bundles", f"{F}/r0,{F}/r1",
+     "--port", "0", "--probe-interval", "0.2", "--readmit-after", "1",
+     "--canary-bundle", f"{F}/canary_src",
+     "--canary-fraction", "0.5", "--canary-min-samples", "20",
+     "--canary-attest-timeout", "90", "--canary-observe-timeout", "30",
+     "--mirror-fraction", "1.0",
+     "--mirror-ingest", f"127.0.0.1:{iport}",
+     "--mirror-spool", f"{F}/spool",
+     "--gate-sigma", "0.3", "--gate-min-windows", "64",
+     "--gate-min-ess", "16", "--gate-band", "3.0",
+     "--gate-max-windows", "512",
+     "--chaos", "seed=18;gate_stall@1:600;mirror_drop@400;mirror_drop@900",
+     "--log-dir", F],
+    "fly-router")
+rport = router.wait_port(120)
+wait_for(lambda: any("admitted 2/2" in l for l in router.lines), 180,
+         "flywheel router admission")
+
+from d4pg_tpu.serve.protocol import probe_healthz
+
+
+def healthz():
+    return probe_healthz("127.0.0.1", rport, timeout_s=5.0)
+
+
+def evaluate(tag):
+    """Fixed-seed serving quality through the ROUTER: plain v1 ACT
+    traffic (σ=0, no feedback, nothing mirrored)."""
+    p = subprocess.run(
+        [sys.executable, "-m", "d4pg_tpu.flywheel.sim_client",
+         "--connect", f"127.0.0.1:{rport}", "--env", "Pendulum-v1",
+         "--episodes", "3", "--seed", "12345", "--noise-sigma", "0",
+         "--no-feedback", "--max-steps", "200", "--retries", "64"],
+        capture_output=True, text=True, timeout=600)
+    out = p.stdout + p.stderr
+    assert p.returncode == 0 and "SIM_CLIENT_OK" in p.stdout, (
+        tag, out[-2000:])
+    row = [l for l in p.stdout.splitlines() if "mean_return=" in l][-1]
+    return float(row.split("mean_return=")[1].split()[0])
+
+
+eval_before = evaluate("before")
+print(f"[chaos-soak] flywheel eval BEFORE (gen 0): {eval_before:.1f}",
+      flush=True)
+
+# noisy served traffic — THE data source (σ must match --gate-sigma: the
+# logged propensity is what the gate importance-weights by)
+sims = [
+    spawn([sys.executable, "-m", "d4pg_tpu.flywheel.sim_client",
+           "--connect", f"127.0.0.1:{rport}", "--env", "Pendulum-v1",
+           "--episodes", "1000000", "--seed", str(100 + i),
+           "--noise-sigma", "0.3", "--max-steps", "100",
+           "--retries", "64"], f"fly-sim{i}")
+    for i in range(2)
+]
+wait_for(lambda: healthz().get("mirror", {}).get("windows_acked", 0) > 200,
+         300, "mirrored windows reaching the learner")
+
+
+def events(kind):
+    rows = []
+    for l in list(router.lines):
+        if "[router-event]" not in l:
+            continue
+        try:
+            e = json.loads(l.split("[router-event]", 1)[1])
+        except ValueError:
+            continue
+        if e.get("event") == kind:
+            rows.append(e)
+    return rows
+
+
+def offer(src):
+    if os.path.exists(f"{F}/canary_src"):
+        shutil.rmtree(f"{F}/canary_src")
+    shutil.copytree(src, f"{F}/canary_src")
+    # copytree preserves mtimes; a rollout only starts on a NEW mtime
+    os.utime(f"{F}/canary_src/bundle.json", None)
+
+
+def rollout_idle():
+    ros = healthz().get("rollouts", {})
+    return all(ro["state"] == "idle" for ro in ros.values())
+
+
+# -- offer 1: chaos wedges the FIRST gate evaluation (gate_stall@1:600) —
+# the observe deadline must bound it into a rollback, never a hang
+snapshot(f"{F}/offer_stall")
+offer(f"{F}/offer_stall")
+wait_for(lambda: healthz()["canary_rollbacks"] >= 1, 300,
+         "the stalled gate's bounded rollback")
+stall_ev = events("canary_rollback")[0]
+assert "stalled" in stall_ev["reason"], stall_ev
+wait_for(rollout_idle, 180, "fleet settle after the stall rollback")
+print("[chaos-soak] stalled gate evaluation rolled back (bounded)",
+      flush=True)
+
+# -- offer 2: the planted bad bundle — a collapsed constant policy that
+# SERVES error-free (the live canary verdict sees nothing wrong) while
+# steering the plant into the ground; only the off-policy gate sees it
+snapshot(f"{F}/bad_bundle")
+z = dict(np.load(f"{F}/bad_bundle/actor_params.npz"))
+bias = min((k for k in z if z[k].ndim == 1), key=lambda k: z[k].size)
+# Saturate toward the boundary the LOGGED traffic avoids: a constant on
+# the behavior's own favored side would overlap the clip atoms there and
+# score full ESS (indistinguishable from behavior — and as harmless).
+# The side the serving distribution never visits is the one that IS the
+# bad bundle: concentrated overlap on a handful of windows, ESS ~1.
+from d4pg_tpu.flywheel.spool import read_windows
+scols, sn = read_windows(f"{F}/spool", 3, 1, max_windows=512)
+side = -50.0 if float(np.mean(scols["action"])) > 0 else 50.0
+z[bias] = np.full_like(z[bias], side)  # tanh saturates: action ≡ ∓1
+np.savez(f"{F}/bad_bundle/actor_params.npz", **z)
+print(f"[chaos-soak] planting constant action {np.sign(side):+.0f} "
+      f"(logged action mean {float(np.mean(scols['action'])):+.3f} "
+      f"over {sn} spooled windows)", flush=True)
+offer(f"{F}/bad_bundle")
+wait_for(lambda: healthz()["canary_rollbacks"] >= 2, 300,
+         "the gate blocking the planted bad bundle")
+bad_ev = [e for e in events("canary_rollback")
+          if e["reason"].startswith("off-policy gate:")][0]
+bad_verdict = bad_ev["gate"]
+assert bad_verdict["passed"] is False, bad_verdict
+# blocked BEFORE the live plane saw anything: error rates were clean
+assert (bad_ev["canary_error_rate"]
+        <= bad_ev["baseline_error_rate"] + 0.05), bad_ev
+wait_for(rollout_idle, 180, "fleet settle after the gate block")
+print(f"[chaos-soak] bad bundle BLOCKED by the gate: "
+      f"{bad_verdict['reason']}", flush=True)
+
+# -- the promotion ladder: archive every published generation, then walk
+# the serving fleet up the ladder a few generations per offer
+archive = {}
+arch_lock = threading.Lock()
+arch_stop = threading.Event()
+
+
+def archiver():
+    while not arch_stop.is_set():
+        try:
+            g = lgen()
+            with arch_lock:
+                have = g in archive
+            if not have:
+                got = snapshot(f"{F}/gens/{g}.tmp")
+                dst = f"{F}/gens/{got}"
+                if os.path.exists(dst):
+                    shutil.rmtree(f"{F}/gens/{g}.tmp")
+                else:
+                    os.rename(f"{F}/gens/{g}.tmp", dst)
+                with arch_lock:
+                    archive[got] = dst
+        except (OSError, ValueError, SystemExit):
+            pass
+        time.sleep(0.3)
+
+
+os.makedirs(f"{F}/gens", exist_ok=True)
+threading.Thread(target=archiver, name="fly-archiver", daemon=True).start()
+
+served_gen, hop = 0, 3
+promoted_gens = []
+final_gen = None
+deadline = time.monotonic() + 1800
+while True:
+    if time.monotonic() > deadline:
+        raise SystemExit("CHAOS_SOAK_FAIL: promotion ladder never converged")
+    if final_gen is None and learner.proc.poll() is not None:
+        rc = learner.proc.wait()
+        assert rc == 0, f"flywheel learner exit {rc} (guards tripped?)"
+        final_gen = lgen()
+        print(f"[chaos-soak] flywheel learner done rc 0 "
+              f"(final gen {final_gen})", flush=True)
+    if not rollout_idle():
+        time.sleep(0.5)
+        continue
+    with arch_lock:
+        gens = sorted(archive)
+    ahead = [g for g in gens if g > served_gen]
+    if not ahead:
+        if final_gen is not None and served_gen >= final_gen:
+            break
+        time.sleep(0.5)
+        continue
+    in_reach = [g for g in ahead if g <= served_gen + hop]
+    target = max(in_reach) if in_reach else ahead[0]
+    n_prom = healthz()["canary_promotions"]
+    offer(archive[target])
+    wait_for(lambda: not rollout_idle()
+             or healthz()["canary_promotions"] > n_prom,
+             90, f"rollout start for gen {target}")
+    wait_for(rollout_idle, 300, f"rollout settle for gen {target}")
+    if healthz()["canary_promotions"] > n_prom:
+        served_gen = target
+        promoted_gens.append(target)
+        hop = min(hop + 1, 6)
+        print(f"[chaos-soak] promoted gen {target} "
+              f"(ladder {promoted_gens})", flush=True)
+    else:
+        # refused (low ESS against current traffic): shrink the hop and
+        # retry — the gate converges the ladder, it never wedges it
+        hop = max(1, hop - 1)
+        print(f"[chaos-soak] gen {target} refused; hop -> {hop}",
+              flush=True)
+arch_stop.set()
+
+# traffic off, then the fixed-seed AFTER measurement on the promoted fleet
+for s in sims:
+    s.stop(drain_timeout_s=60)
+time.sleep(2)  # let in-flight tap sends land on a side of the ledger
+eval_after = evaluate("after")
+print(f"[chaos-soak] flywheel eval AFTER (gen {served_gen}): "
+      f"{eval_after:.1f}", flush=True)
+assert eval_after > eval_before + 100.0, (
+    "the served policy did not improve on its own traffic",
+    eval_before, eval_after)
+
+h = healthz()
+good_ev = events("canary_promote")[-1]  # the last PASSING gate verdict
+good_verdict = good_ev["gate"]
+router_counters = {k: h[k] for k in (
+    "gate_evaluations", "gate_pass", "gate_block", "gate_stalls",
+    "canary_promotions", "canary_rollbacks")}
+assert router_counters["gate_evaluations"] == (
+    router_counters["gate_pass"] + router_counters["gate_block"]
+    + router_counters["gate_stalls"]), router_counters
+assert router_counters["gate_stalls"] >= 1, router_counters
+assert router_counters["gate_block"] >= 1, router_counters
+assert router_counters["gate_pass"] >= 1, router_counters
+assert router_counters["canary_promotions"] >= 1, router_counters
+
+# the tap's window ledger: exact, with the chaos losses ON the books
+tap = h["mirror"]
+sides = ("windows_acked", "windows_stale", "windows_shed",
+         "windows_dropped_chaos", "windows_dropped_link",
+         "windows_dropped_full", "pending")
+assert tap["windows_built"] == sum(tap[k] for k in sides), tap
+assert tap["windows_dropped_chaos"] >= 1, tap
+
+# the ingest's per-source split: every window the learner trained on
+# came from the mirror
+rows = [json.loads(l) for l in open(f"{F}/metrics.jsonl")]
+fleet = [r for r in rows if "fleet_windows_ingested" in r][-1]
+ingest = {
+    "windows_ingested": int(fleet["fleet_windows_ingested"]),
+    "windows_from_mirror": int(fleet["fleet_windows_from_mirror"]),
+    "windows_from_actors": int(fleet["fleet_windows_from_actors"]),
+}
+assert ingest["windows_from_mirror"] > 0, ingest
+assert ingest["windows_from_actors"] == 0, ingest
+assert (ingest["windows_from_mirror"] + ingest["windows_from_actors"]
+        == ingest["windows_ingested"]), ingest
+
+# graceful drains: rc 0 = guards + sentinel budgets clean everywhere
+rc = router.stop(drain_timeout_s=180)
+assert rc == 0, f"flywheel router exit {rc}"
+for rid in (0, 1):
+    rc = reps[rid].stop(drain_timeout_s=120)
+    assert rc == 0, f"flywheel replica {rid} exit {rc}"
+
+doc = {
+    "backend": "cpu",
+    "schema": "flywheel-soak/v1",
+    "env": "Pendulum-v1",
+    "eval": {"before": round(eval_before, 2),
+             "after": round(eval_after, 2),
+             "episodes": 3, "seed": 12345},
+    "gate": {
+        "stall": {"rolled_back": True, "reason": stall_ev["reason"]},
+        "bad": {"blocked": True, "verdict": bad_verdict,
+                "live_error_rates": {
+                    "baseline": bad_ev["baseline_error_rate"],
+                    "canary": bad_ev["canary_error_rate"]}},
+        "good": {"promoted": True, "verdict": good_verdict,
+                 "generation": served_gen},
+    },
+    "promoted_generations": promoted_gens,
+    "counters": {"router": router_counters, "tap": tap, "ingest": ingest},
+    "identity_ok": True,
+}
+with open(f"{d}/flywheel_soak.json", "w") as f:
+    json.dump(doc, f, indent=1, sort_keys=True)
+from tools.d4pglint.schema_check import check_flywheel_soak
+errs = check_flywheel_soak(f"{d}/flywheel_soak.json")
+assert not errs, errs
+
+print("CHAOS_SOAK_FLYWHEEL_OK", json.dumps({
+    "eval_before": round(eval_before, 1),
+    "eval_after": round(eval_after, 1),
+    "promoted_generations": promoted_gens,
+    **router_counters,
+    "tap_acked": tap["windows_acked"],
+    "tap_dropped_chaos": tap["windows_dropped_chaos"],
+    "ingested": ingest["windows_ingested"],
+}))
+EOF
+
+# zero flywheel processes survive (learner, replicas, router, sim clients)
+if pgrep -f "fleet-bundle $DIR/flywheel/lbundle" > /dev/null 2>&1 \
+   || pgrep -f "d4pg_tpu.serve.*$DIR/flywheel/r" > /dev/null 2>&1 \
+   || pgrep -f "d4pg_tpu.flywheel.sim_client" > /dev/null 2>&1; then
+  echo "CHAOS_SOAK_FAIL: flywheel processes survived the shutdown"
+  pgrep -af "$DIR/flywheel" || true
   exit 1
 fi
 

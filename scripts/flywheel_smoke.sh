@@ -1,0 +1,149 @@
+#!/usr/bin/env bash
+# Flywheel smoke: the closed data loop end to end through the REAL CLIs
+# (docs/flywheel.md) — served traffic becomes training data. Wired into
+# tier-1 via tests/test_flywheel_smoke.py; also runnable by hand:
+#
+#   scripts/flywheel_smoke.sh                  # throwaway run dir
+#   FLYWHEEL_SMOKE_DIR=/tmp/x scripts/flywheel_smoke.sh
+#
+# The flow:
+#   1. train.py --fleet-listen 0 --num-envs 0 --debug-guards: the learner
+#      runs the ingest server with NO local collection and NO fleet
+#      actors — it can only finish if the MIRROR supplies real windows
+#      (fleet pacing proves the tap end to end);
+#   2. python -m d4pg_tpu.serve serves the learner-published bundle with
+#      --mirror-fraction 1.0, streaming every served episode's windows
+#      to the ingest AND spooling them on disk;
+#   3. the sim client plays env episodes through the serve path, echoing
+#      reward/done + behavior log-prob on FEEDBACK frames;
+#   4. learner completes rc 0 (paced purely by mirrored traffic); a
+#      fixed-seed evaluator run (--noise-sigma 0 --no-feedback, pure v1
+#      ACT) then proves the v1 sublanguage still round-trips on the same
+#      server; SIGTERM-drain the server and audit the books:
+#      every ingested window came from source=mirror, the tap's window
+#      accounting identity is exact, and the spool decodes with the
+#      behavior-log-prob column the promotion gate needs.
+#
+# Knobs (env vars): FLYWHEEL_SMOKE_DIR, FLYWHEEL_SMOKE_STEPS (default
+# 60), FLYWHEEL_SMOKE_HIDDEN (default 16,16).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+RUN=${FLYWHEEL_SMOKE_DIR:-$(mktemp -d /tmp/flywheel_smoke.XXXXXX)}
+mkdir -p "$RUN"
+STEPS=${FLYWHEEL_SMOKE_STEPS:-60}
+HIDDEN=${FLYWHEEL_SMOKE_HIDDEN:-16,16}
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+
+echo "[flywheel-smoke] run dir: $RUN"
+
+python train.py --env Pendulum-v1 --hidden-sizes "$HIDDEN" \
+  --total-steps "$STEPS" --warmup 24 --bsize 8 --rmsize 512 \
+  --eval-interval "$STEPS" --eval-episodes 2 \
+  --checkpoint-interval "$STEPS" --num-envs 0 \
+  --fleet-listen 0 --fleet-bundle "$RUN/bundle" \
+  --fleet-publish-interval 20 --debug-guards \
+  --log-dir "$RUN" > "$RUN/learner.log" 2>&1 &
+LEARNER=$!
+
+PORT=
+for _ in $(seq 1 600); do
+  PORT=$(sed -n 's/.*ingest listening on :\([0-9][0-9]*\).*/\1/p' "$RUN/learner.log" | head -1)
+  if [ -n "$PORT" ] && [ -f "$RUN/bundle/bundle.json" ]; then break; fi
+  kill -0 "$LEARNER" 2>/dev/null \
+    || { cat "$RUN/learner.log"; echo "FLYWHEEL_SMOKE_FAIL: learner died before listening"; exit 1; }
+  sleep 0.2
+done
+[ -n "$PORT" ] || { cat "$RUN/learner.log"; echo "FLYWHEEL_SMOKE_FAIL: no ingest port"; exit 1; }
+echo "[flywheel-smoke] ingest on :$PORT"
+
+python -m d4pg_tpu.serve --bundle "$RUN/bundle" --port 0 \
+  --max-batch 8 --max-wait-us 500 \
+  --mirror-fraction 1.0 --mirror-ingest "127.0.0.1:$PORT" \
+  --mirror-spool "$RUN/spool" > "$RUN/server.log" 2>&1 &
+SERVER=$!
+
+SPORT=
+for _ in $(seq 1 600); do
+  SPORT=$(sed -n 's/.*listening on 127\.0\.0\.1:\([0-9][0-9]*\).*/\1/p' "$RUN/server.log" | head -1)
+  [ -n "$SPORT" ] && break
+  kill -0 "$SERVER" 2>/dev/null \
+    || { cat "$RUN/server.log"; echo "FLYWHEEL_SMOKE_FAIL: server died before listening"; exit 1; }
+  sleep 0.2
+done
+[ -n "$SPORT" ] || { cat "$RUN/server.log"; echo "FLYWHEEL_SMOKE_FAIL: no serve port"; exit 1; }
+echo "[flywheel-smoke] serving on :$SPORT"
+
+# Served traffic with reward echo: short truncated episodes so windows
+# flow continuously while the learner paces through its steps.
+python -m d4pg_tpu.flywheel.sim_client --connect "127.0.0.1:$SPORT" \
+  --env Pendulum-v1 --episodes 500 --seed 7 --noise-sigma 0.3 \
+  --max-steps 25 > "$RUN/sim.log" 2>&1 &
+SIM=$!
+
+# The learner can only complete because the MIRROR feeds it (there are
+# no actors and no local envs): its rc 0 IS the closed-loop proof, and
+# --debug-guards means any recompile/transfer/staging trip raised.
+if ! wait "$LEARNER"; then
+  cat "$RUN/learner.log"; kill -9 "$SIM" "$SERVER" 2>/dev/null || true
+  echo "FLYWHEEL_SMOKE_FAIL: learner exited non-zero"; exit 1
+fi
+kill -TERM "$SIM" 2>/dev/null || true
+wait "$SIM" 2>/dev/null || true
+
+# The v1 sublanguage must survive the flywheel: a fixed-seed evaluator
+# run over plain v1 ACT frames (no feedback, nothing mirrored) against
+# the SAME server that just carried FEEDBACK traffic.
+python -m d4pg_tpu.flywheel.sim_client --connect "127.0.0.1:$SPORT" \
+  --env Pendulum-v1 --episodes 1 --seed 3 --noise-sigma 0 \
+  --no-feedback --max-steps 20 > "$RUN/eval.log" 2>&1 \
+  || { cat "$RUN/eval.log"; echo "FLYWHEEL_SMOKE_FAIL: v1 evaluator run failed"; exit 1; }
+grep -q "SIM_CLIENT_OK" "$RUN/eval.log" \
+  || { cat "$RUN/eval.log"; echo "FLYWHEEL_SMOKE_FAIL: evaluator never finished"; exit 1; }
+
+kill -TERM "$SERVER"
+if ! wait "$SERVER"; then
+  cat "$RUN/server.log"; echo "FLYWHEEL_SMOKE_FAIL: server drain exited non-zero"; exit 1
+fi
+grep -q "\[serve\] mirror:" "$RUN/server.log" \
+  || { cat "$RUN/server.log"; echo "FLYWHEEL_SMOKE_FAIL: server never printed mirror books"; exit 1; }
+
+# The books: every ingested window came from the mirror (per-source
+# split), the tap's window accounting identity is exact, and the spool
+# holds gate-readable frames with the behavior-log-prob column.
+python - "$RUN" <<'EOF'
+import json, sys
+run = sys.argv[1]
+rows = [json.loads(l) for l in open(f"{run}/metrics.jsonl")]
+fleet = [r for r in rows if "fleet_windows_ingested" in r]
+assert fleet, "no metrics row carries fleet counters"
+last = fleet[-1]
+assert last["fleet_windows_ingested"] > 0, last
+assert last["fleet_windows_from_mirror"] > 0, last
+assert last["fleet_windows_from_actors"] == 0, last
+assert (last["fleet_windows_from_mirror"] + last["fleet_windows_from_actors"]
+        == last["fleet_windows_ingested"]), last
+
+mline = [l for l in open(f"{run}/server.log") if "[serve] mirror:" in l][-1]
+tap = dict(kv.split("=") for kv in mline.split("mirror:", 1)[1].split())
+tap = {k: int(v) for k, v in tap.items()}
+assert tap["feedback_steps"] > 0 and tap["episodes_mirrored"] > 0, tap
+assert tap["windows_built"] == (
+    tap["windows_acked"] + tap["windows_stale"] + tap["windows_shed"]
+    + tap["windows_dropped_chaos"] + tap["windows_dropped_link"]
+    + tap["windows_dropped_full"] + tap["pending"]
+), tap
+assert tap["windows_acked"] > 0, tap
+
+from d4pg_tpu.flywheel.spool import read_windows
+cols, n = read_windows(f"{run}/spool", 3, 1)
+assert n > 0 and "logprob" in cols and len(cols["logprob"]) == n, n
+print("FLYWHEEL_SMOKE_COUNTERS_OK", {
+    "ingested": last["fleet_windows_ingested"],
+    "from_mirror": last["fleet_windows_from_mirror"],
+    "tap_acked": tap["windows_acked"],
+    "spooled": n,
+})
+EOF
+
+echo "FLYWHEEL_SMOKE_OK"

@@ -20,7 +20,7 @@ from tools.d4pglint.schema_check import (
     check_metrics_jsonl,
 )
 
-# A minimal conforming model of serve/protocol.py: all ten wire ids, the
+# A minimal conforming model of serve/protocol.py: every wire id, the
 # protocol-module codecs, MAX_PAYLOAD-bounded framing, and the prober
 # endpoint. Shared with tests/test_wholeprog.py (its multi-file endpoint
 # fixtures need a clean protocol module in the map) so the two files can
@@ -44,6 +44,8 @@ WINDOWS = 9
 WINDOWS_OK = 10
 ACT2 = 11
 WINDOWS2 = 12
+FEEDBACK = 13
+FEEDBACK_OK = 14
 
 
 class ProtocolError(Exception):
@@ -84,6 +86,15 @@ def encode_action(action):
 
 def decode_action(payload):
     return payload
+
+
+def encode_feedback(reward, action, next_obs, log_prob=0.0,
+                    terminated=False, truncated=False, policy_id=None):
+    return b""
+
+
+def decode_feedback(payload):
+    return {}
 
 
 def probe_healthz(host, port):

@@ -1,0 +1,508 @@
+"""Flywheel units (ISSUE 18): the FEEDBACK frame codec and its version
+floor, the WINDOWS2 behavior-log-prob column, the mirror spool, the
+off-policy IS gate's math, the mirror tap's striping + accounting
+identity — and the headline parity claim extended to mirrored traffic:
+an episode mirrored through MirrorTap → socket → IngestServer leaves
+replay content byte-identical to the in-process NStepWriter path.
+
+Everything here is in-process and device-free. The end-to-end loop
+(server + tap + learner + sim client) lives in
+``tests/test_flywheel_smoke.py`` (scripts/flywheel_smoke.sh); the
+closed-loop improvement + gate-blocks-bad-bundle soak in
+``scripts/chaos_soak.sh`` leg 10.
+"""
+
+import math
+import os
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from d4pg_tpu.chaos import ChaosInjector, ChaosPlan
+from d4pg_tpu.fleet import wire
+from d4pg_tpu.fleet.ingest import IngestServer
+from d4pg_tpu.flywheel.gate import evaluate_is_gate, gaussian_log_prob
+from d4pg_tpu.flywheel.spool import MirrorSpool, iter_payloads, read_windows
+from d4pg_tpu.flywheel.tap import MirrorTap
+from d4pg_tpu.replay.nstep_writer import NStepWriter
+from d4pg_tpu.replay.source import negotiate_fleet
+from d4pg_tpu.replay.uniform import ReplayBuffer
+from d4pg_tpu.serve import protocol
+from d4pg_tpu.serve.protocol import ProtocolError
+
+OBS, ACT, NSTEP, GAMMA = 3, 2, 2, 0.99
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+def _fb(step, *, terminated=False, truncated=False, action=None):
+    return dict(
+        policy_id="default",
+        reward=float(step),
+        log_prob=-0.5 * step,
+        terminated=terminated,
+        truncated=truncated,
+        action=(np.full(ACT, 0.1 * step, np.float32)
+                if action is None else action),
+        next_obs=np.full(OBS, step + 1, np.float32),
+    )
+
+
+# ---------------------------------------------------------- FEEDBACK codec
+def test_feedback_roundtrip():
+    action = np.array([0.25, -0.75], np.float32)
+    next_obs = np.array([1.0, 2.0, 3.0], np.float32)
+    payload = protocol.encode_feedback(
+        1.5, action, next_obs, log_prob=-0.625, terminated=True,
+        policy_id="pol_b",
+    )
+    fb = protocol.decode_feedback(payload)
+    assert fb["policy_id"] == "pol_b"
+    assert fb["reward"] == 1.5
+    assert abs(fb["log_prob"] - -0.625) < 1e-6
+    assert fb["terminated"] and not fb["truncated"]
+    np.testing.assert_array_equal(fb["action"], action)
+    np.testing.assert_array_equal(fb["next_obs"], next_obs)
+    # both episode bits, independently
+    fb2 = protocol.decode_feedback(
+        protocol.encode_feedback(0.0, action, next_obs, truncated=True)
+    )
+    assert fb2["truncated"] and not fb2["terminated"]
+    assert fb2["policy_id"] == protocol.DEFAULT_POLICY
+
+
+def test_feedback_malformed():
+    action = np.zeros(ACT, np.float32)
+    next_obs = np.zeros(OBS, np.float32)
+    good = protocol.encode_feedback(0.0, action, next_obs)
+    with pytest.raises(ProtocolError):
+        protocol.decode_feedback(good[: protocol._FEEDBACK_HEAD.size - 1])
+    with pytest.raises(ProtocolError):
+        protocol.decode_feedback(good[:-2])  # next_obs not a f32 multiple
+    with pytest.raises(ProtocolError):
+        # action block truncated away entirely
+        protocol.decode_feedback(good[: protocol._FEEDBACK_HEAD.size + 3])
+    with pytest.raises(ProtocolError):
+        protocol.encode_feedback(0.0, np.zeros((2, 2), np.float32), next_obs)
+    with pytest.raises(ProtocolError):
+        protocol.encode_feedback(0.0, action, next_obs, policy_id="x" * 300)
+
+
+def test_feedback_rides_version2_v1_frames_pinned():
+    """The backward-compat satellite: FEEDBACK/FEEDBACK_OK stamp frame
+    version 2, while the v1 sublanguage — ACT out, ACT_OK back, WINDOWS
+    up — stays byte-for-byte what a PR-8-era peer speaks, BOTH
+    directions, pinned against hand-packed golden bytes."""
+
+    class Sink:
+        def __init__(self):
+            self.data = b""
+
+        def sendall(self, b):
+            self.data += b
+
+    def framed(msg_type, req_id, payload):
+        s = Sink()
+        protocol.write_frame(s, msg_type, req_id, payload)
+        return s.data
+
+    # client -> server request path, v1
+    obs = np.arange(OBS, dtype=np.float32)
+    act_payload = protocol.encode_act(obs, 500)
+    golden_act = (
+        protocol.HEADER.pack(b"D4", 1, protocol.ACT, 7, len(act_payload))
+        + struct.pack("<I", 500) + obs.tobytes()
+    )
+    assert framed(protocol.ACT, 7, act_payload) == golden_act
+    # server -> client reply path, v1
+    action = np.array([0.5, -0.5], np.float32)
+    golden_ok = (
+        protocol.HEADER.pack(b"D4", 1, protocol.ACT_OK, 7, 4 * ACT)
+        + action.tobytes()
+    )
+    assert framed(protocol.ACT_OK, 7, protocol.encode_action(action)) == \
+        golden_ok
+    # actor -> ingest v1 WINDOWS: header byte stays 1
+    w = wire.encode_windows(
+        0, np.zeros((1, OBS), np.float32), np.zeros((1, ACT), np.float32),
+        np.zeros(1, np.float32), np.zeros((1, OBS), np.float32),
+        np.zeros(1, np.float32),
+    )
+    assert framed(protocol.WINDOWS, 1, w)[:4] == b"D4" + bytes(
+        [1, protocol.WINDOWS]
+    )
+    # the flywheel frames ride version 2
+    fb = protocol.encode_feedback(
+        0.0, np.zeros(ACT, np.float32), np.zeros(OBS, np.float32)
+    )
+    assert framed(protocol.FEEDBACK, 3, fb)[:4] == b"D4" + bytes(
+        [2, protocol.FEEDBACK]
+    )
+    assert framed(protocol.FEEDBACK_OK, 3, b"")[:4] == b"D4" + bytes(
+        [2, protocol.FEEDBACK_OK]
+    )
+
+
+# ------------------------------------------------- WINDOWS2 logprob column
+def _cols(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return dict(
+        obs=rng.standard_normal((n, OBS)).astype(np.float32),
+        action=rng.standard_normal((n, ACT)).astype(np.float32),
+        reward=rng.standard_normal(n).astype(np.float32),
+        next_obs=rng.standard_normal((n, OBS)).astype(np.float32),
+        discount=rng.random(n).astype(np.float32),
+    )
+
+
+def test_windows2_logprob_column_roundtrip_and_plain_unchanged():
+    cols = _cols(4)
+    lp = np.linspace(-3, -1, 4).astype(np.float32)
+    with_lp = wire.encode_windows2(5, 6, "f32", False, logprob=lp, **cols)
+    gen, sg, mode, relab, out = wire.decode_windows2(with_lp, OBS, ACT)
+    assert (gen, sg, mode, relab) == (5, 6, "f32", False)
+    np.testing.assert_array_equal(out["logprob"], lp)
+    np.testing.assert_array_equal(out["obs"], cols["obs"])
+    np.testing.assert_array_equal(out["discount"], cols["discount"])
+    # plain frame: byte-identical to the pre-flywheel wire (flags 0, no
+    # trailing column), and the decode has no logprob key
+    plain = wire.encode_windows2(5, 6, "f32", False, **cols)
+    golden = (
+        wire._WINDOWS2_HEAD.pack(5, 6, 4, wire.OBS_MODE_IDS["f32"], 0, 0)
+        + cols["obs"].tobytes() + cols["action"].tobytes()
+        + cols["reward"].tobytes() + cols["next_obs"].tobytes()
+        + cols["discount"].tobytes()
+    )
+    assert plain == golden
+    assert with_lp == golden[:12] + with_lp[12:16] + golden[16:] + lp.tobytes()
+    _, _, _, _, out2 = wire.decode_windows2(plain, OBS, ACT)
+    assert "logprob" not in out2
+    # truncated logprob block dies whole
+    with pytest.raises(ProtocolError, match="declares"):
+        wire.decode_windows2(with_lp[:-4], OBS, ACT)
+
+
+def test_hello_source_cap_and_negotiation():
+    from d4pg_tpu.replay.source import LEGACY_ACTOR_CAPS
+
+    learner = {"obs_mode": "f32", "her": False, "obs_norm": False,
+               "variant": 0}
+    # the mirror tap's HELLO declares source=mirror; it survives the
+    # HELLO codec roundtrip and negotiation hands it through
+    hello = wire.decode_hello(wire.encode_hello(
+        actor_id="m", env="e", obs_dim=OBS, action_dim=ACT,
+        n_step=NSTEP, gamma=GAMMA, generation=0,
+        caps={"wire": 2, "obs_modes": ["f32"], "her": False,
+              "obs_norm": False, "variant": 0, "source": "mirror"},
+    ))
+    assert hello["caps"]["source"] == "mirror"
+    chosen, gaps = negotiate_fleet(learner, hello["caps"])
+    assert gaps == () and chosen["source"] == "mirror"
+    # caps-less v1 actor — and a caps vector without the key — both
+    # negotiate as plain actors
+    chosen, gaps = negotiate_fleet(learner, LEGACY_ACTOR_CAPS)
+    assert gaps == () and chosen["source"] == "actor"
+    chosen, gaps = negotiate_fleet(
+        learner,
+        {"obs_modes": ["f32"], "her": False, "obs_norm": False,
+         "variant": 0},
+    )
+    assert gaps == () and chosen["source"] == "actor"
+
+
+# ------------------------------------------------------------------- spool
+def test_spool_roundtrip_rotation_torn_tail(tmp_path):
+    root = str(tmp_path / "spool")
+    sp = MirrorSpool(root, segment_bytes=256, max_segments=2)
+    payloads = [bytes([i]) * (40 + i) for i in range(12)]
+    for p in payloads:
+        sp.append(p)
+    sp.close()
+    kept = list(iter_payloads(root))
+    assert 0 < len(kept) < len(payloads)
+    assert kept == payloads[-len(kept):]  # oldest segments rotated away
+    # torn tail: a half-written record is skipped, everything before reads
+    segs = sorted(
+        f for f in os.listdir(root) if f.startswith("mirror-")
+    )
+    last = os.path.join(root, segs[-1])
+    with open(last, "ab") as f:
+        f.write(struct.pack("<I", 9999) + b"short")
+    assert list(iter_payloads(root)) == kept
+
+
+def test_read_windows_filters(tmp_path):
+    root = str(tmp_path / "spool")
+    sp = MirrorSpool(root)
+    cols = _cols(3, seed=1)
+    lp = np.float32([-1, -2, -3])
+    sp.append(wire.encode_windows2(1, 1, "f32", False, **cols))  # no logprob
+    sp.append(wire.encode_windows2(2, 2, "f32", False, logprob=lp, **cols))
+    sp.append(wire.encode_windows2(7, 7, "f32", False, logprob=lp, **cols))
+    sp.append(b"not a frame")  # foreign record: skipped, never raises
+    sp.close()
+    out, n = read_windows(root, OBS, ACT)
+    assert n == 6  # the logprob-less frame is skipped
+    out, n = read_windows(root, OBS, ACT, min_generation=3)
+    assert n == 3
+    out, n = read_windows(root, OBS, ACT, max_windows=2)
+    assert n == 2 and len(out["logprob"]) == 2
+    assert read_windows(str(tmp_path / "missing"), OBS, ACT) == ({}, 0)
+
+
+# ---------------------------------------------------------------- IS gate
+def test_gaussian_log_prob_matches_closed_form():
+    a = np.array([[0.3, -0.1]])
+    m = np.array([[0.1, 0.2]])
+    sigma = 0.25
+    want = sum(
+        -((a[0, i] - m[0, i]) ** 2) / (2 * sigma**2)
+        - math.log(sigma) - 0.5 * math.log(2 * math.pi)
+        for i in range(2)
+    )
+    got = gaussian_log_prob(a, m, sigma)
+    assert got.shape == (1,) and abs(float(got[0]) - want) < 1e-12
+
+
+def _gate_cols(n, behavior_mean, sigma, reward_of, seed=0):
+    """Windows logged by a behavior policy acting N(behavior_mean, σ²)."""
+    rng = np.random.default_rng(seed)
+    obs = rng.standard_normal((n, OBS)).astype(np.float32)
+    mean = behavior_mean(obs)
+    action = (mean + rng.normal(0, sigma, (n, ACT))).astype(np.float32)
+    return dict(
+        obs=obs, action=action,
+        reward=reward_of(obs, action).astype(np.float32),
+        logprob=gaussian_log_prob(action, mean, sigma).astype(np.float32),
+    )
+
+
+class _Lin:
+    """Deterministic linear policy μ(s) = s @ W — the NumpyPolicy shape
+    the gate needs (act + dims)."""
+
+    obs_dim, action_dim = OBS, ACT
+
+    def __init__(self, w):
+        self.w = np.asarray(w, np.float64)
+
+    def act(self, obs):
+        return np.asarray(obs, np.float64) @ self.w
+
+
+class _Const:
+    """Constant-action candidate: acts nowhere near anything the
+    behavior policy served, so its importance weights collapse onto
+    whichever single window is least unlike it."""
+
+    obs_dim, action_dim = OBS, ACT
+
+    def __init__(self, value):
+        self.value = float(value)
+
+    def act(self, obs):
+        return np.full((len(obs), ACT), self.value)
+
+
+def test_gate_pass_block_starve_ess():
+    sigma = 0.3
+    w_good = np.zeros((OBS, ACT))
+    behavior = _Lin(w_good)
+    # reward: high when the action is near 0 (what behavior does)
+    reward_of = lambda obs, act: 2.0 - np.sum(act**2, axis=1)  # noqa: E731
+    cols = _gate_cols(200, behavior.act, sigma, reward_of, seed=2)
+    # candidate == behavior: ρ == 1 everywhere, estimate == mean, passes
+    v = evaluate_is_gate(cols, _Lin(w_good), sigma=sigma,
+                         min_windows=16, min_ess=4.0, band=0.5)
+    assert v["passed"] and v["reason"] == "ok"
+    assert abs(v["v_candidate"] - v["v_behavior"]) < 0.2
+    assert v["ess"] > 100
+    # far-off-distribution candidate: ESS collapses, blocked
+    v = evaluate_is_gate(cols, _Const(2.0), sigma=sigma,
+                         min_windows=16, min_ess=4.0, band=0.5)
+    assert not v["passed"] and "sample size" in v["reason"]
+    assert v["ess"] < 4.0
+    # pathologically far candidate: EVERY weight underflows — the gate
+    # refuses rather than dividing by zero
+    v = evaluate_is_gate(cols, _Const(50.0), sigma=sigma,
+                         min_windows=16, min_ess=4.0, band=0.5)
+    assert not v["passed"] and v["ess"] == 0.0
+    # starved gate refuses, never guesses
+    few = {k: c[:4] for k, c in cols.items()}
+    v = evaluate_is_gate(few, _Lin(w_good), sigma=sigma, min_windows=16)
+    assert not v["passed"] and v["reason"].startswith("starved")
+    assert evaluate_is_gate({}, _Lin(w_good), sigma=sigma)["passed"] is False
+    # near-distribution but WORSE candidate: rewarded region is where
+    # behavior acts, candidate drifts away -> estimate drops below band
+    v = evaluate_is_gate(
+        cols, _Lin(np.full((OBS, ACT), 0.25)), sigma=sigma,
+        min_windows=16, min_ess=4.0, band=0.05,
+    )
+    assert not v["passed"] and "below behavior" in v["reason"]
+    for k in ("samples", "sigma", "ess", "v_behavior", "v_candidate",
+              "min_windows", "min_ess", "band", "passed", "reason"):
+        assert k in v
+
+
+# -------------------------------------------------------------- mirror tap
+def test_tap_striping_identity_and_unpaired(tmp_path):
+    tap = MirrorTap(obs_dim=OBS, action_dim=ACT, n_step=NSTEP, gamma=GAMMA,
+                    fraction=0.5, spool=MirrorSpool(str(tmp_path / "sp")))
+    try:
+        # feedback with no preceding request: counted, never paired
+        tap.on_feedback("conn", _fb(0))
+        assert tap.counters()["feedback_unpaired"] == 1
+        # 8 episodes of 4 steps on one connection: Bresenham at 500‰
+        # mirrors exactly every other episode
+        for _ep in range(8):
+            for step in range(4):
+                tap.on_request("conn", np.full(OBS, step, np.float32))
+                tap.on_feedback("conn", _fb(step, terminated=step == 3))
+        c = tap.counters()
+        assert c["episodes_seen"] == 8 and c["episodes_mirrored"] == 4
+        assert c["feedback_steps"] == 32
+        assert c["windows_built"] == 16  # 4 windows per mirrored episode
+    finally:
+        tap.close()
+    c = tap.counters()
+    assert c["windows_built"] == (
+        c["windows_acked"] + c["windows_stale"] + c["windows_shed"]
+        + c["windows_dropped_chaos"] + c["windows_dropped_link"]
+        + c["windows_dropped_full"] + c["pending"]
+    )
+    # no ingest configured: the spool got everything, the link dropped all
+    assert c["windows_dropped_link"] == 16 and c["spool_records"] >= 1
+    _, n = read_windows(str(tmp_path / "sp"), OBS, ACT)
+    assert n == 16
+
+
+def test_tap_disconnect_drops_half_built_episode():
+    tap = MirrorTap(obs_dim=OBS, action_dim=ACT, n_step=NSTEP, gamma=GAMMA,
+                    fraction=1.0)
+    try:
+        tap.on_request("c", np.zeros(OBS, np.float32))
+        tap.on_feedback("c", _fb(0))  # 1 step < n_step: nothing emitted
+        assert tap.counters()["windows_built"] == 0
+        tap.on_disconnect("c")
+        # stream gone whole: the next feedback on the same key is unpaired
+        tap.on_feedback("c", _fb(1))
+        c = tap.counters()
+        assert c["windows_built"] == 0 and c["feedback_unpaired"] == 1
+    finally:
+        tap.close()
+
+
+def test_tap_chaos_mirror_drop_keeps_identity(tmp_path):
+    chaos = ChaosInjector(ChaosPlan.parse("mirror_drop@1;mirror_drop@3"))
+    tap = MirrorTap(obs_dim=OBS, action_dim=ACT, n_step=NSTEP, gamma=GAMMA,
+                    fraction=1.0, spool=MirrorSpool(str(tmp_path / "sp")),
+                    chaos=chaos)
+    try:
+        for step in range(6):
+            tap.on_request("c", np.full(OBS, step, np.float32))
+            tap.on_feedback("c", _fb(step, terminated=step == 5))
+    finally:
+        tap.close()
+    c = tap.counters()
+    assert c["windows_built"] == 6
+    assert c["windows_dropped_chaos"] == 2  # the 1st and 3rd built windows
+    assert c["windows_built"] == (
+        c["windows_acked"] + c["windows_stale"] + c["windows_shed"]
+        + c["windows_dropped_chaos"] + c["windows_dropped_link"]
+        + c["windows_dropped_full"] + c["pending"]
+    )
+    # dropped BEFORE both sinks: the spool holds only the surviving 4
+    _, n = read_windows(str(tmp_path / "sp"), OBS, ACT)
+    assert n == 4
+
+
+def test_tap_rejects_bad_fraction():
+    with pytest.raises(ValueError):
+        MirrorTap(obs_dim=OBS, action_dim=ACT, n_step=NSTEP, gamma=GAMMA,
+                  fraction=1.5)
+
+
+# ------------------------------------------------- mirrored-replay parity
+def _episode_stream(seed, steps):
+    rng = np.random.default_rng(seed)
+    obs = rng.standard_normal(OBS).astype(np.float32)
+    t = 0
+    for i in range(steps):
+        action = rng.standard_normal(ACT).astype(np.float32)
+        reward = float(rng.standard_normal())
+        next_obs = rng.standard_normal(OBS).astype(np.float32)
+        t += 1
+        term = t == 9 and (i // 9) % 2 == 0
+        trunc = t == 9 and not term
+        yield obs, action, reward, next_obs, term, trunc
+        if term or trunc:
+            obs = rng.standard_normal(OBS).astype(np.float32)
+            t = 0
+        else:
+            obs = next_obs
+
+
+def test_mirrored_and_inprocess_replay_content_identical():
+    """The parity claim extended to the flywheel: the same episode stream
+    through (a) the in-process NStepWriter -> ReplayBuffer path and
+    (b) the mirror path — MirrorTap -> WINDOWS2+logprob frame -> socket
+    -> IngestServer (source: mirror) -> ReplayBuffer — leaves
+    byte-identical replay content, split out on the ingest's per-source
+    counters, with the logprob column stripped before storage."""
+    buf_local = ReplayBuffer(512, OBS, ACT)
+    w_local = NStepWriter(buf_local, NSTEP, GAMMA)
+    buf_fleet = ReplayBuffer(512, OBS, ACT)
+    srv = IngestServer(buf_fleet, obs_dim=OBS, action_dim=ACT,
+                       n_step=NSTEP, gamma=GAMMA, port=0).start()
+    tap = MirrorTap(obs_dim=OBS, action_dim=ACT, n_step=NSTEP, gamma=GAMMA,
+                    fraction=1.0, ingest_addr=("127.0.0.1", srv.port))
+    try:
+        for obs, action, reward, next_obs, term, trunc in \
+                _episode_stream(11, 120):
+            w_local.add(obs, action, reward, next_obs, term, trunc)
+            tap.on_request("c", obs)
+            tap.on_feedback("c", dict(
+                policy_id="default", reward=reward, log_prob=-1.0,
+                terminated=term, truncated=trunc,
+                action=action, next_obs=next_obs,
+            ))
+        emitted = len(buf_local)
+        assert emitted > 0
+        assert _wait(lambda: len(buf_fleet) == emitted), (
+            f"fleet buffer {len(buf_fleet)} != local {emitted}"
+        )
+        tap.close()
+        n = emitted
+        np.testing.assert_array_equal(buf_fleet.obs[:n], buf_local.obs[:n])
+        np.testing.assert_array_equal(
+            buf_fleet.action[:n], buf_local.action[:n]
+        )
+        np.testing.assert_array_equal(
+            buf_fleet.reward[:n], buf_local.reward[:n]
+        )
+        np.testing.assert_array_equal(
+            buf_fleet.next_obs[:n], buf_local.next_obs[:n]
+        )
+        np.testing.assert_array_equal(
+            buf_fleet.discount[:n], buf_local.discount[:n]
+        )
+        c = tap.counters()
+        assert c["windows_acked"] == emitted
+        snap = srv.counters()
+        assert snap["windows_from_mirror"] == emitted
+        assert snap["windows_from_actors"] == 0
+        assert snap["windows_ingested"] == (
+            snap["windows_from_mirror"] + snap["windows_from_actors"]
+        )
+    finally:
+        tap.close()
+        srv.close()

@@ -1,0 +1,46 @@
+"""Tier-1 flywheel smoke: the closed data loop through the real CLIs
+(``scripts/flywheel_smoke.sh``) — a learner with NO local collection and
+NO fleet actors paced to completion purely by MIRRORED serving traffic
+(serve --mirror-fraction 1.0 + the sim client's FEEDBACK reward echo),
+then a fixed-seed v1 evaluator run on the same server, a SIGTERM drain,
+and the three-ledger audit: ingest per-source split, tap accounting
+identity, gate-readable spool.
+
+This is THE end-to-end smoke for the flywheel subsystem (conftest
+fast-tier policy): everything else flywheel-related tests layers
+in-process (``tests/test_flywheel.py``); only this one proves the
+shipped commands compose. The promotion-gate leg (planted bad bundle
+blocked, closed-loop improvement) needs real training time and lives in
+``scripts/chaos_soak.sh`` leg 10.
+"""
+
+import os
+import subprocess
+import sys
+
+from conftest import clean_cpu_env
+
+
+def test_flywheel_smoke_script(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = clean_cpu_env()
+    env["FLYWHEEL_SMOKE_DIR"] = str(tmp_path / "run")
+    p = subprocess.run(
+        ["bash", os.path.join(repo, "scripts", "flywheel_smoke.sh")],
+        capture_output=True,
+        text=True,
+        timeout=840,
+        env=env,
+        cwd=repo,
+    )
+    out = p.stdout + p.stderr
+    assert p.returncode == 0, out[-4000:]
+    assert "FLYWHEEL_SMOKE_COUNTERS_OK" in p.stdout, out[-4000:]
+    assert "FLYWHEEL_SMOKE_OK" in p.stdout, out[-4000:]
+    # the spool is a real on-disk artifact the gate could read
+    spool = tmp_path / "run" / "spool"
+    assert any(f.name.startswith("mirror-") for f in spool.iterdir())
+
+
+if __name__ == "__main__":
+    sys.exit(0)

@@ -90,6 +90,14 @@ HOST_ONLY_MODULES = (
     # The JAX-free twin of the pure-JAX pixel env — what a fleet actor
     # host runs for the pixel cell (parity-tested against the jnp one).
     "d4pg_tpu/envs/pixel_pendulum_host.py",
+    # The flywheel (ISSUE 18): the mirror tap rides inside router AND
+    # replica processes, the IS gate inside the (host-only) router, and
+    # the sim client is a thin env+socket loop — none may pull JAX.
+    "d4pg_tpu/flywheel/__init__.py",
+    "d4pg_tpu/flywheel/spool.py",
+    "d4pg_tpu/flywheel/tap.py",
+    "d4pg_tpu/flywheel/gate.py",
+    "d4pg_tpu/flywheel/sim_client.py",
     # utils/__init__ must stay lazy: an eager profiling import there would
     # drag JAX into every utils.retry / utils.signals importer (fleet hosts).
     "d4pg_tpu/utils/__init__.py",

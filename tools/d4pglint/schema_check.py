@@ -660,6 +660,168 @@ def check_league_soak(path: str) -> list[str]:
     return errs
 
 
+def check_flywheel_soak(path: str) -> list[str]:
+    """Shape + invariants for ``benchmarks/flywheel_soak.json`` — the
+    ISSUE-18 acceptance artifact (the closed-loop chaos soak's summary,
+    chaos_soak.sh leg 10):
+
+    - the EVAL CLAIM recomputed, not trusted: the fixed-seed return
+      after training on the bundle's own served traffic must be STRICTLY
+      above the degraded starting point;
+    - the GATE story complete: the stalled evaluation rolled back (never
+      wedged), the planted bad bundle was BLOCKED by the off-policy gate
+      (a refusing verdict with the full decision-table fields), the good
+      bundle PASSED and promoted — and the router's gate counters add up
+      (evaluations == pass + block + stalls);
+    - both planes' ACCOUNTING IDENTITIES recomputed from the committed
+      counters: the tap's window ledger (built == acked + stale + shed
+      + dropped_chaos + dropped_link + dropped_full + pending) and the
+      ingest's per-source split (from_mirror + from_actors == ingested,
+      every window mirror-sourced);
+    - the chaos sites demonstrably FIRED: ``mirror_drop`` losses appear
+      in the tap's explicit dropped counter, ``gate_stall`` in the
+      router's gate_stalls.
+    """
+    errs = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: unreadable/invalid JSON ({e})"]
+    for key in ("backend", "schema", "env", "eval", "gate", "counters",
+                "identity_ok"):
+        if key not in doc:
+            errs.append(f"{path}: missing top-level key {key!r}")
+    if doc.get("schema") != "flywheel-soak/v1":
+        errs.append(
+            f"{path}: unknown schema {doc.get('schema')!r} "
+            "(expected 'flywheel-soak/v1')"
+        )
+    ev = doc.get("eval")
+    if not isinstance(ev, dict):
+        errs.append(f"{path}: 'eval' must be an object")
+    else:
+        for key in ("before", "after", "episodes", "seed"):
+            if key not in ev:
+                errs.append(f"{path}: eval missing {key!r}")
+        before, after = ev.get("before"), ev.get("after")
+        if not (isinstance(before, (int, float))
+                and isinstance(after, (int, float)) and after > before):
+            errs.append(
+                f"{path}: eval return must STRICTLY rise across the soak "
+                f"(before={before!r}, after={after!r}) — the closed loop "
+                "exists to improve the bundle on its own served traffic"
+            )
+    gate = doc.get("gate")
+    if not isinstance(gate, dict):
+        errs.append(f"{path}: 'gate' must be an object")
+        gate = {}
+    verdict_keys = ("samples", "ess", "v_behavior", "v_candidate",
+                    "passed", "reason")
+    for leg, want_passed in (("bad", False), ("good", True)):
+        row = gate.get(leg)
+        if not isinstance(row, dict) or not isinstance(
+            row.get("verdict"), dict
+        ):
+            errs.append(f"{path}: gate.{leg}.verdict must be an object")
+            continue
+        v = row["verdict"]
+        for key in verdict_keys:
+            if key not in v:
+                errs.append(f"{path}: gate.{leg}.verdict missing {key!r}")
+        if v.get("passed") is not want_passed:
+            errs.append(
+                f"{path}: gate.{leg}.verdict.passed is "
+                f"{v.get('passed')!r} (the planted {leg} bundle must be "
+                f"{'allowed' if want_passed else 'blocked'})"
+            )
+    if gate.get("bad", {}).get("blocked") is not True:
+        errs.append(
+            f"{path}: gate.bad.blocked must attest True — the bad bundle "
+            "must be stopped BEFORE live error rate ever sees it"
+        )
+    if gate.get("good", {}).get("promoted") is not True:
+        errs.append(f"{path}: gate.good.promoted must attest True")
+    counters = doc.get("counters")
+    if not isinstance(counters, dict):
+        return errs + [f"{path}: 'counters' must be an object"]
+    router = counters.get("router")
+    if not isinstance(router, dict):
+        errs.append(f"{path}: counters.router must be an object")
+    else:
+        for key, floor in (("gate_evaluations", 3), ("gate_pass", 1),
+                           ("gate_block", 1), ("gate_stalls", 1),
+                           ("canary_promotions", 1),
+                           ("canary_rollbacks", 2)):
+            if not isinstance(router.get(key), int) or router[key] < floor:
+                errs.append(
+                    f"{path}: counters.router.{key} must be an int >= "
+                    f"{floor}, got {router.get(key)!r}"
+                )
+        if isinstance(router.get("gate_evaluations"), int) and (
+            router["gate_evaluations"]
+            != router.get("gate_pass", 0) + router.get("gate_block", 0)
+            + router.get("gate_stalls", 0)
+        ):
+            errs.append(
+                f"{path}: gate accounting broken: evaluations "
+                f"({router.get('gate_evaluations')}) != pass + block + "
+                f"stalls — a gate verdict went unaccounted"
+            )
+    tap = counters.get("tap")
+    if not isinstance(tap, dict):
+        errs.append(f"{path}: counters.tap must be an object")
+    else:
+        sides = ("windows_acked", "windows_stale", "windows_shed",
+                 "windows_dropped_chaos", "windows_dropped_link",
+                 "windows_dropped_full", "pending")
+        missing = [k for k in ("windows_built",) + sides if k not in tap]
+        if missing:
+            errs.append(f"{path}: counters.tap missing {missing}")
+        elif tap["windows_built"] != sum(tap[k] for k in sides):
+            errs.append(
+                f"{path}: tap window identity broken: windows_built "
+                f"({tap['windows_built']}) != acked+stale+shed+dropped+"
+                f"pending ({sum(tap[k] for k in sides)}) — a mirrored "
+                "window went unaccounted"
+            )
+        if tap.get("windows_dropped_chaos", 0) < 1:
+            errs.append(
+                f"{path}: counters.tap.windows_dropped_chaos is "
+                f"{tap.get('windows_dropped_chaos')!r} — the mirror_drop "
+                "chaos site must demonstrably fire (and balance)"
+            )
+    ingest = counters.get("ingest")
+    if not isinstance(ingest, dict):
+        errs.append(f"{path}: counters.ingest must be an object")
+    else:
+        mir = ingest.get("windows_from_mirror")
+        act = ingest.get("windows_from_actors")
+        tot = ingest.get("windows_ingested")
+        if not all(isinstance(v, (int, float)) for v in (mir, act, tot)):
+            errs.append(
+                f"{path}: counters.ingest needs numeric windows_ingested "
+                "/ windows_from_mirror / windows_from_actors"
+            )
+        else:
+            if mir + act != tot:
+                errs.append(
+                    f"{path}: ingest source identity broken: from_mirror "
+                    f"({mir}) + from_actors ({act}) != ingested ({tot})"
+                )
+            if not mir > 0 or act != 0:
+                errs.append(
+                    f"{path}: the soak's learner is mirror-fed ONLY "
+                    f"(from_mirror={mir!r}, from_actors={act!r})"
+                )
+    if doc.get("identity_ok") is not True:
+        errs.append(
+            f"{path}: identity_ok is {doc.get('identity_ok')!r} — the "
+            "committed artifact must attest the accounting identities"
+        )
+    return errs
+
+
 # League identity columns (ISSUE 15): when a row carries one it must
 # carry both, integer-valued and non-negative — the league controller
 # groups rows by (variant_id, league_generation).
@@ -741,6 +903,8 @@ def check_tree(root: str) -> list[str]:
             errs.extend(check_composition_matrix(path))
         if os.path.basename(path) == "league_soak.json":
             errs.extend(check_league_soak(path))
+        if os.path.basename(path) == "flywheel_soak.json":
+            errs.extend(check_flywheel_soak(path))
         if os.path.basename(path) == "multihost_microbench.json":
             errs.extend(check_multihost_microbench(path))
     for path in sorted(

@@ -14,9 +14,11 @@ from __future__ import annotations
 PROTOCOL_MODULE = "d4pg_tpu/serve/protocol.py"
 
 # Names in the protocol module that look like frame-constants but are NOT
-# message-type ids (QOS_* are ACT2 payload field values).
+# message-type ids (QOS_* are ACT2 payload field values, FEEDBACK_* the
+# FEEDBACK frame's flag bits).
 PROTOCOL_NON_IDS = ("PROTOCOL_VERSION", "MAX_PAYLOAD",
-                    "QOS_INTERACTIVE", "QOS_BULK")
+                    "QOS_INTERACTIVE", "QOS_BULK",
+                    "FEEDBACK_TERMINATED", "FEEDBACK_TRUNCATED")
 
 # Message id -> (payload encoder, payload decoder). ``module.py::func``
 # names a codec function that must exist; the literals mean:
@@ -50,6 +52,12 @@ PROTOCOL_CODECS = {
                  "d4pg_tpu/fleet/wire.py::decode_windows2"),
     "WINDOWS_OK": ("d4pg_tpu/fleet/wire.py::encode_windows_ok",
                    "d4pg_tpu/fleet/wire.py::decode_windows_ok"),
+    # the flywheel's reward echo (ISSUE 18): executed action + reward +
+    # next_obs + episode bits + behavior log-prob for the previous ACT on
+    # the same connection; rides frame version 2 via _FRAME_MIN_VERSION
+    "FEEDBACK": ("d4pg_tpu/serve/protocol.py::encode_feedback",
+                 "d4pg_tpu/serve/protocol.py::decode_feedback"),
+    "FEEDBACK_OK": ("empty", "empty"),
 }
 
 # Every receive loop in the system: endpoint name ->
@@ -62,15 +70,16 @@ PROTOCOL_CODECS = {
 # justified suppression).
 PROTOCOL_ENDPOINTS = {
     "server": ("d4pg_tpu/serve/server.py::PolicyServer._serve_conn",
-               ("HEALTHZ", "ACT", "ACT2")),
+               ("HEALTHZ", "ACT", "ACT2", "FEEDBACK")),
     "router": ("d4pg_tpu/serve/router.py::Router._serve_conn",
-               ("HEALTHZ", "ACT", "ACT2")),
+               ("HEALTHZ", "ACT", "ACT2", "FEEDBACK")),
     "ingest-handshake": ("d4pg_tpu/fleet/ingest.py::IngestServer._handshake",
                          ("HEALTHZ", "HELLO")),
     "ingest": ("d4pg_tpu/fleet/ingest.py::IngestServer._serve_conn",
                ("HEALTHZ", "WINDOWS", "WINDOWS2")),
     "client": ("d4pg_tpu/serve/client.py::PolicyClient._read_loop",
-               ("ACT_OK", "HEALTHZ_OK", "OVERLOADED", "ERROR")),
+               ("ACT_OK", "HEALTHZ_OK", "FEEDBACK_OK", "OVERLOADED",
+                "ERROR")),
     "fleet-link": ("d4pg_tpu/fleet/actor.py::FleetLink._read_loop",
                    ("WINDOWS_OK", "OVERLOADED", "ERROR")),
     "fleet-handshake": ("d4pg_tpu/fleet/actor.py::FleetLink.__init__",
