@@ -34,6 +34,7 @@ _EXPORTS = {
     "no_implicit_transfers": "d4pg_tpu.analysis.transfer",
     "no_transfers": "d4pg_tpu.analysis.transfer",
     "explicit_transfer": "d4pg_tpu.analysis.transfer",
+    "ConservationError": "d4pg_tpu.analysis.flowledger",
 }
 
 __getattr__, __dir__ = lazy_exports(__name__, _EXPORTS)

@@ -59,7 +59,7 @@ from d4pg_tpu.replay.nstep_writer import NStepWriter
 from d4pg_tpu.serve import protocol
 from d4pg_tpu.serve.protocol import ProtocolError
 from d4pg_tpu.utils.retry import Backoff
-from d4pg_tpu.analysis import lockwitness
+from d4pg_tpu.analysis import flowledger, lockwitness
 
 STAT_KEYS = (
     "env_steps",
@@ -932,7 +932,6 @@ class FleetActor:
     def run(self) -> dict:
         """The main loop; returns the final stats dict. Blocks until
         ``max_env_steps`` (0 = until stopped) or :meth:`request_stop`."""
-        emitted_base = 0
         next_poll = time.monotonic() + self.poll_interval_s
         next_stats = time.monotonic() + self.stats_interval_s
         try:
@@ -951,9 +950,10 @@ class FleetActor:
                     next_stats = now + self.stats_interval_s
                 before = len(self.spool) + self.spool.dropped
                 self._step_envs()
-                emitted_base += (len(self.spool) + self.spool.dropped) - before
-                with self._stats_lock:
-                    self._stats["windows_emitted"] = emitted_base
+                self._inc(
+                    "windows_emitted",
+                    (len(self.spool) + self.spool.dropped) - before,
+                )
                 while (
                     len(self.spool) >= self.batch_windows
                     and not self._stop.is_set()
@@ -970,6 +970,10 @@ class FleetActor:
                     env.close()
         out = self.stats()
         print(f"[fleet-actor] drained: {out}", flush=True)
+        # --debug-guards: every emitted window must be booked under
+        # exactly one terminal (acked/stale/shed/dropped) or still be
+        # spooled — the vanished-windows bug class, checked at exit
+        flowledger.check("fleet-actor", out, where="actor drain")
         return out
 
     def _drain(self) -> None:
@@ -1059,11 +1063,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "actor-side sites reconnect_flap@N, stale_bundle@N, "
                         "slow_link@N:ms, stale_stats@N, pixel_truncate@N, "
                         "her_actor_kill@N")
+    p.add_argument("--debug-guards", action="store_true",
+                   help="arm the runtime witnesses (lock-order, window "
+                        "conservation): drain fails loudly on a lock-order "
+                        "contradiction or an accounting imbalance")
     return p
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.debug_guards:
+        # BEFORE FleetActor() so its named locks register witnessed
+        lockwitness.enable()
+        flowledger.enable()
     chaos = None
     if args.chaos:
         from d4pg_tpu.chaos import ChaosInjector, ChaosPlan

@@ -61,7 +61,7 @@ from d4pg_tpu.replay import source
 from d4pg_tpu.replay.uniform import Transition
 from d4pg_tpu.serve import protocol
 from d4pg_tpu.serve.protocol import ProtocolError
-from d4pg_tpu.analysis import lockwitness
+from d4pg_tpu.analysis import flowledger, lockwitness
 
 # counter keys, in the order they appear in metrics rows / healthz
 COUNTER_KEYS = (
@@ -269,6 +269,10 @@ class IngestServer:
             if self._writer_thread.is_alive():
                 raise RuntimeError("ingest writer thread failed to drain")
             self._writer_thread = None
+        # --debug-guards: the per-source ingest split must balance once
+        # the writer thread has drained the queue
+        flowledger.check("fleet-ingest", self.counters(),
+                         where="ingest close")
 
     def check_alive(self) -> None:
         if self._thread_error is not None:

@@ -49,7 +49,7 @@ from typing import Optional
 
 import numpy as np
 
-from d4pg_tpu.analysis import lockwitness
+from d4pg_tpu.analysis import flowledger, lockwitness
 from d4pg_tpu.fleet import wire
 from d4pg_tpu.replay.nstep_writer import NStepWriter
 from d4pg_tpu.serve import protocol
@@ -499,3 +499,5 @@ class MirrorTap:
             self._link = None
         if self.spool is not None:
             self.spool.close()
+        # --debug-guards: the window identity must balance at close
+        flowledger.check("mirror-tap", self.counters(), where="tap close")

@@ -106,6 +106,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--summary-out", default=None,
                    help="also write the end-of-run summary artifact "
                         "(league_soak.json schema) here")
+    p.add_argument("--debug-guards", action="store_true",
+                   help="arm the conservation ledger: the end-of-run "
+                        "summary re-checks every variant's process-tenure "
+                        "identity against the FLOW_IDENTITIES manifest "
+                        "and raises on imbalance")
     p.add_argument("learner", nargs=argparse.REMAINDER,
                    help="-- then the base learner command")
     return p
@@ -125,6 +130,10 @@ def main(argv=None) -> int:
         genomes = [parse_genome(g) for g in args.genome]
     except ValueError as e:
         raise SystemExit(str(e))
+    if args.debug_guards:
+        from d4pg_tpu.analysis import flowledger
+
+        flowledger.enable()
     from d4pg_tpu.league.controller import LeagueConfig, LeagueController
 
     config = LeagueConfig(

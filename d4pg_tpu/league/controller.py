@@ -56,6 +56,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from d4pg_tpu.analysis import flowledger
 from d4pg_tpu.runtime import manifest as ckpt_manifest
 from d4pg_tpu.utils import procs
 from d4pg_tpu.utils.retry import Backoff
@@ -1063,6 +1064,10 @@ class LeagueController:
             + v["killed"] + v["live"]
             for v in variants.values()
         )
+        # --debug-guards: the same tenure equation, machine-checked
+        # against the FLOW_IDENTITIES manifest (no-op when disarmed)
+        flowledger.check_rows("league-tenure", variants,
+                              where="league summary")
         summary = {
             "backend": "cpu",
             "schema": "league-soak/v1",

@@ -88,10 +88,13 @@ def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
     if args.debug_guards:
         # Arm the lock-order witness BEFORE the server builds its locks;
-        # drain() checks the recorded nesting against the committed graph.
-        from d4pg_tpu.analysis import lockwitness
+        # drain() checks the recorded nesting against the committed graph,
+        # and the conservation ledger checks the serve/tap accounting
+        # identities at drain/close.
+        from d4pg_tpu.analysis import flowledger, lockwitness
 
         lockwitness.enable()
+        flowledger.enable()
     from d4pg_tpu.serve.bundle import load_bundle
     from d4pg_tpu.serve.server import PolicyServer
 

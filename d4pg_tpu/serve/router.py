@@ -111,7 +111,7 @@ from d4pg_tpu.serve.client import ConnectionClosed, Overloaded, PolicyClient
 from d4pg_tpu.serve.protocol import ProtocolError
 from d4pg_tpu.serve.stats import LatencyReservoir
 from d4pg_tpu.utils.retry import Backoff
-from d4pg_tpu.analysis import lockwitness
+from d4pg_tpu.analysis import flowledger, lockwitness
 
 # Bundle file names, duplicated from serve/bundle.py ON PURPOSE: that
 # module imports the agent config (and with it JAX) at module top, and the
@@ -777,6 +777,16 @@ class Router:
                 c.close()
             except OSError:
                 pass
+        # --debug-guards: admission/terminal accounting, the promotion
+        # gate's poll accounting, and every tenant row must balance now
+        # that in-flight dispatches resolved and the readers are gone
+        snap = self.stats.snapshot()
+        flowledger.check("router", snap, where="router drain")
+        flowledger.check("router-gate", snap, where="router drain")
+        flowledger.check_rows(
+            "router-tenant", self.stats.tenants_snapshot(),
+            where="router drain",
+        )
 
     # ------------------------------------------------------------ event log
     def _record_event(self, kind: str, **fields) -> None:
@@ -2338,6 +2348,11 @@ def build_parser():
                         "policy_skew@N / mirror_drop@N / gate_stall@N:s "
                         "(scaledown_during_canary@N ticks in the "
                         "autoscaler)")
+    p.add_argument("--debug-guards", action="store_true",
+                   help="arm the runtime witnesses (lock-order, flow "
+                        "conservation): drain checks the recorded lock "
+                        "nesting and the admission/gate/tenant accounting "
+                        "identities, raising on any imbalance")
     g = p.add_argument_group("autoscaler (serve/autoscaler.py)")
     g.add_argument("--autoscale", action="store_true",
                    help="run the healthz-driven autoscaler in-process: "
@@ -2398,6 +2413,11 @@ def main(argv=None) -> None:
     from d4pg_tpu.utils.signals import install_graceful_signals
 
     args = build_parser().parse_args(argv)
+    if args.debug_guards:
+        # BEFORE the Router/tap build their locks (named_lock wraps only
+        # while enabled); drain() then checks nesting + identities.
+        lockwitness.enable()
+        flowledger.enable()
     backends = [b.strip() for b in args.backends.split(",") if b.strip()]
     bundles = None
     if args.backend_bundles:

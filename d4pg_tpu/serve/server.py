@@ -44,7 +44,7 @@ from d4pg_tpu.serve import protocol
 from d4pg_tpu.serve.batcher import DynamicBatcher, ShedError
 from d4pg_tpu.serve.bundle import PolicyBundle, bundle_mtime, load_bundle
 from d4pg_tpu.serve.protocol import ProtocolError
-from d4pg_tpu.analysis import lockwitness
+from d4pg_tpu.analysis import flowledger, lockwitness
 
 
 def load_best_actor_params(run_dir: str, config):
@@ -356,6 +356,10 @@ class PolicyServer:
             # (benchmarks/lock_order_graph.json): a nesting this process
             # performed that contradicts the graph fails the drain.
             lockwitness.check_against_committed(where="serve drain")
+        # --debug-guards: every admitted request must have resolved as
+        # exactly one of ok/shed (inflight 0 after the batcher drained)
+        flowledger.check("serve-stats", self.stats.snapshot(),
+                         where="serve drain")
 
     # ------------------------------------------------------------- hot reload
     def _stat_best(self) -> Optional[float]:

@@ -183,7 +183,7 @@ done
 
 python -m d4pg_tpu.fleet.actor --connect "127.0.0.1:$FLEET_PORT" \
   --bundle "$DIR/fleet_bundle" --batch-windows 8 --poll-interval 0.3 \
-  --stats-interval 5 --seed 13 --reconnect-attempts 400 \
+  --stats-interval 5 --seed 13 --reconnect-attempts 400 --debug-guards \
   --chaos "seed=7;reconnect_flap@1;stale_bundle@1;slow_link@3:150" \
   > "$DIR/fleet_actor.log" 2>&1 &
 FACTOR=$!
@@ -223,18 +223,21 @@ wait "$FACTOR" \
   || { cat "$DIR/fleet_actor.log"; echo "CHAOS_SOAK_FAIL: fleet actor drain exited non-zero"; exit 1; }
 
 # every emitted window accounted (torn windows never half-land: they are
-# either acked, counted stale/shed/dropped, or still spooled), the actor
-# reconnected at least once (the kill -9 guarantees it), and the resumed
-# learner ingested real windows with guards green (its rc 0 above).
+# either acked, counted stale/shed/dropped, or still spooled) — asserted
+# by the actor's own --debug-guards ConservationLedger, whose
+# [flow-verdict] line we parse instead of re-deriving the arithmetic in
+# bash; plus the actor reconnected at least once (the kill -9 guarantees
+# it), and the resumed learner ingested real windows with guards green.
 python - "$DIR" "$FPRE_ROWS" <<'EOF'
 import ast, json, sys
 d, pre_rows = sys.argv[1], int(sys.argv[2])
+verdicts = [json.loads(l.split("[flow-verdict]", 1)[1])
+            for l in open(f"{d}/fleet_actor.log") if "[flow-verdict]" in l]
+fam = [v for v in verdicts if v["family"] == "fleet-actor"]
+assert fam, "actor drain emitted no fleet-actor flow verdict"
+assert all(v["ok"] for v in fam), fam
 drained = [l for l in open(f"{d}/fleet_actor.log") if "drained:" in l][-1]
 s = ast.literal_eval(drained.split("drained:", 1)[1].strip())
-acct = (s["windows_acked"] + s["windows_stale"] + s["windows_shed"]
-        + s["windows_dropped_reconnect"] + s["windows_dropped_spool"]
-        + s["spool_depth"])
-assert acct == s["windows_emitted"], (acct, s)
 assert s["reconnects"] >= 1, s
 # only rows APPENDED by the resumed leg count — a surviving pre-kill row
 # must not satisfy the ingest assertion vacuously
@@ -292,7 +295,7 @@ router = spawn(
      "--port", "0", "--probe-interval", "0.2", "--readmit-after", "2",
      "--canary-bundle", f"{d}/canary_src", "--canary-fraction", "0.5",
      "--canary-min-samples", "10", "--canary-attest-timeout", "30",
-     "--chaos", "seed=11;canary_corrupt@1"],
+     "--debug-guards", "--chaos", "seed=11;canary_corrupt@1"],
     "router",
 )
 rport = router.wait_port(120)
@@ -388,9 +391,10 @@ h = healthz()
 submitted = sum(counts.values())
 assert submitted > 0 and counts["ok"] > 0, counts
 # identity (client side): every request answered ok / OVERLOADED / error
-# (error = failed-after-bounded-retry; the threads count every outcome)
-# identity (router side): every ACT it admitted was answered
-assert h["requests_total"] == h["answered_total"], (counts, h)
+# (error = failed-after-bounded-retry; the threads count every outcome).
+# The router-side identity (every admitted ACT answered) is asserted by
+# the router's own --debug-guards ConservationLedger at drain — its
+# [flow-verdict] lines are parsed after the stop() below.
 assert h["canary_rollbacks"] == 1 and h["canary_promotions"] == 0, h
 assert h["ejections"] >= 2 and h["admissions"] >= 4, h  # kill + rollback
 # the corrupt deploy really fired and the rollback re-ejected the canary
@@ -412,9 +416,23 @@ assert h0["replica_id"] == 0 and h1["replica_id"] == 1
 # soak in proc.wait)
 rc = router.stop(drain_timeout_s=120)
 assert rc == 0, f"router exit {rc}"
+# drain-time conservation verdicts: requests_total == ok + overloaded +
+# error (aggregate) and gate evaluations == pass + block + stalls, from
+# the ledger the router armed under --debug-guards
+verdicts = [json.loads(l.split("[flow-verdict]", 1)[1])
+            for l in router.lines if "[flow-verdict]" in l]
+for fam in ("router", "router-gate", "router-tenant"):
+    fv = [v for v in verdicts if v["family"] == fam]
+    assert fv, f"router drain emitted no {fam} flow verdict"
+    assert all(v["ok"] for v in fv), fv
 for rid in (0, 1):
     rc = reps[rid].stop(drain_timeout_s=120)
     assert rc == 0, f"replica {rid} exit {rc} (guards/sentinel not clean?)"
+    # each replica's serve drain balanced its admitted-request books
+    rv = [json.loads(l.split("[flow-verdict]", 1)[1])
+          for l in reps[rid].lines if "[flow-verdict]" in l]
+    sv = [v for v in rv if v["family"] == "serve-stats"]
+    assert sv and all(v["ok"] for v in sv), (rid, rv)
 
 # metrics attribution: every surviving replica's rows carry ITS replica_id
 for rid in (0, 1):
@@ -470,6 +488,7 @@ router = spawn(
      ",".join(f"default={d}/mt_r{r}_def+alt={d}/mt_r{r}_alt"
               for r in (0, 1)),
      "--port", "0", "--probe-interval", "0.2", "--readmit-after", "1",
+     "--debug-guards",
      "--replica-capacity", "8", "--bulk-fraction", "0.5",
      "--tenant-quota", "bulky=40:60",
      "--canary-bundle", f"{d}/mt_canary",
@@ -603,11 +622,10 @@ for c in clients:
     c.close()
 
 h = healthz()
-# aggregate + per-(tenant, class) accounting identity, EXACT
-assert h["requests_total"] == h["answered_total"], (
-    h["requests_total"], h["answered_total"])
-for key, row in h["tenants"].items():
-    assert row["requests"] == row["answered"], (key, row)
+# The aggregate + per-(tenant, class) accounting identities are asserted
+# EXACTLY at drain by the router's --debug-guards ConservationLedger
+# ([flow-verdict] lines parsed after stop() below) — healthz keeps the
+# load-shape asserts that need a live snapshot.
 # the flood was real and bulk shed FIRST: the bulk tenant absorbed
 # overload at its quota/bulk-capacity lines...
 bulk = h["tenants"]["bulky/bulk"]
@@ -651,9 +669,23 @@ for p in ports:
 # (the shared bounded escalation — see leg 6)
 rc = router.stop(drain_timeout_s=180)
 assert rc == 0, f"mt router exit {rc}"
+# drain-time conservation verdicts: the aggregate request identity, the
+# gate identity, and EVERY per-(tenant, class) row (bad_rows == 0)
+verdicts = [json.loads(l.split("[flow-verdict]", 1)[1])
+            for l in router.lines if "[flow-verdict]" in l]
+for fam in ("router", "router-gate", "router-tenant"):
+    fv = [v for v in verdicts if v["family"] == fam]
+    assert fv, f"mt router drain emitted no {fam} flow verdict"
+    assert all(v["ok"] for v in fv), fv
+tenant_rows = [v for v in verdicts if v["family"] == "router-tenant"][-1]
+assert tenant_rows["counters"]["rows"] >= 3, tenant_rows  # flood was real
 for rid in (0, 1):
     rc = reps[rid].stop(drain_timeout_s=120)
     assert rc == 0, f"mt replica {rid} exit {rc} (guards/sentinel not clean?)"
+    rv = [json.loads(l.split("[flow-verdict]", 1)[1])
+          for l in reps[rid].lines if "[flow-verdict]" in l]
+    sv = [v for v in rv if v["family"] == "serve-stats"]
+    assert sv and all(v["ok"] for v in sv), (rid, rv)
 
 print("CHAOS_SOAK_MT_OK", json.dumps({
     "interactive_p99_ms": p99, "slo_ms": slo_ms,
@@ -837,7 +869,7 @@ echo "[chaos-soak] killed the league controller mid-generation (gen $GEN9)"
 # the rerun: same args (journal-checked), clone_corrupt re-armed so the
 # fork fires truncated whichever side of the crash it lands on
 python -m d4pg_tpu.league --dir "$DIR/league" "${league9_args[@]}" \
-  --chaos "seed=5;clone_corrupt@1" \
+  --chaos "seed=5;clone_corrupt@1" --debug-guards \
   --summary-out "$DIR/league_soak.json" \
   -- "${league9_learner[@]}" > "$DIR/league9_run2.log" 2>&1 \
   || { tail -80 "$DIR/league9_run2.log"; echo "CHAOS_SOAK_FAIL: league rerun exited non-zero"; exit 1; }
@@ -876,6 +908,13 @@ from tools.d4pglint.schema_check import check_league_soak
 errs = check_league_soak(f"{d}/league_soak.json")
 assert not errs, errs
 assert s["identity_ok"] is True and s["orphans_swept"] == 0, s
+# ...and via the rerun's --debug-guards ConservationLedger: the same
+# tenure equation per variant row, machine-checked at summary time
+ltv = [json.loads(l.split("[flow-verdict]", 1)[1])
+       for l in open(f"{d}/league9_run2.log") if "[flow-verdict]" in l]
+ltv = [v for v in ltv if v["family"] == "league-tenure"]
+assert ltv and all(v["ok"] for v in ltv), ltv
+assert ltv[-1]["counters"]["bad_rows"] == 0, ltv
 # every drained learner's lock-order witness: 0 contradictions, and the
 # guards never tripped (non-zero learner exits other than kill/preempt
 # would have broken the identity above)
@@ -1027,6 +1066,7 @@ router = spawn(
      "--gate-sigma", "0.3", "--gate-min-windows", "64",
      "--gate-min-ess", "16", "--gate-band", "3.0",
      "--gate-max-windows", "512",
+     "--debug-guards",
      "--chaos", "seed=18;gate_stall@1:600;mirror_drop@400;mirror_drop@900",
      "--log-dir", F],
     "fly-router")
@@ -1125,14 +1165,35 @@ bias = min((k for k in z if z[k].ndim == 1), key=lambda k: z[k].size)
 # score full ESS (indistinguishable from behavior — and as harmless).
 # The side the serving distribution never visits is the one that IS the
 # bad bundle: concentrated overlap on a handful of windows, ESS ~1.
+# Pick that side with the GATE'S OWN estimator over the spool (the sign
+# of the logged action mean is a bad proxy when the behavior straddles
+# zero: both boundaries carry clip atoms and the mean says nothing about
+# which side's atoms are thinner).
 from d4pg_tpu.flywheel.spool import read_windows
+from d4pg_tpu.flywheel.gate import CLIP_LOG_RHO, gaussian_log_prob
+
 scols, sn = read_windows(f"{F}/spool", 3, 1, max_windows=512)
-side = -50.0 if float(np.mean(scols["action"])) > 0 else 50.0
+acts = np.asarray(scols["action"], np.float64)
+logp = np.asarray(scols["logprob"], np.float64)
+
+
+def plant_ess(boundary):
+    lr = np.minimum(
+        gaussian_log_prob(acts, np.full_like(acts, boundary), 0.3) - logp,
+        CLIP_LOG_RHO)
+    rho = np.exp(lr)
+    s = float(rho.sum())
+    return 0.0 if s <= 0.0 else s * s / float((rho * rho).sum())
+
+
+ess_by_side = {b: plant_ess(b) for b in (-1.0, 1.0)}
+side = 50.0 * min(ess_by_side, key=ess_by_side.get)
 z[bias] = np.full_like(z[bias], side)  # tanh saturates: action ≡ ∓1
 np.savez(f"{F}/bad_bundle/actor_params.npz", **z)
 print(f"[chaos-soak] planting constant action {np.sign(side):+.0f} "
-      f"(logged action mean {float(np.mean(scols['action'])):+.3f} "
-      f"over {sn} spooled windows)", flush=True)
+      f"(plant ESS by side {ess_by_side}, logged action mean "
+      f"{float(np.mean(scols['action'])):+.3f} over {sn} spooled windows)",
+      flush=True)
 offer(f"{F}/bad_bundle")
 wait_for(lambda: healthz()["canary_rollbacks"] >= 2, 300,
          "the gate blocking the planted bad bundle")
@@ -1240,20 +1301,17 @@ good_verdict = good_ev["gate"]
 router_counters = {k: h[k] for k in (
     "gate_evaluations", "gate_pass", "gate_block", "gate_stalls",
     "canary_promotions", "canary_rollbacks")}
-assert router_counters["gate_evaluations"] == (
-    router_counters["gate_pass"] + router_counters["gate_block"]
-    + router_counters["gate_stalls"]), router_counters
+# the gate identity (evaluations == pass + block + stalls) is asserted
+# at drain by the router's ConservationLedger [flow-verdict] below
 assert router_counters["gate_stalls"] >= 1, router_counters
 assert router_counters["gate_block"] >= 1, router_counters
 assert router_counters["gate_pass"] >= 1, router_counters
 assert router_counters["canary_promotions"] >= 1, router_counters
 
-# the tap's window ledger: exact, with the chaos losses ON the books
+# the tap's window ledger is asserted exact at close by the ledger's
+# mirror-tap [flow-verdict] (parsed below); here just prove the chaos
+# losses landed ON the books
 tap = h["mirror"]
-sides = ("windows_acked", "windows_stale", "windows_shed",
-         "windows_dropped_chaos", "windows_dropped_link",
-         "windows_dropped_full", "pending")
-assert tap["windows_built"] == sum(tap[k] for k in sides), tap
 assert tap["windows_dropped_chaos"] >= 1, tap
 
 # the ingest's per-source split: every window the learner trained on
@@ -1267,15 +1325,33 @@ ingest = {
 }
 assert ingest["windows_from_mirror"] > 0, ingest
 assert ingest["windows_from_actors"] == 0, ingest
-assert (ingest["windows_from_mirror"] + ingest["windows_from_actors"]
-        == ingest["windows_ingested"]), ingest
+# the per-source split identity (from_actors + from_mirror == ingested)
+# is asserted at ingest close by the learner's ConservationLedger
+fiv = [json.loads(l.split("[flow-verdict]", 1)[1])
+       for l in learner.lines if "[flow-verdict]" in l]
+fiv = [v for v in fiv if v["family"] == "fleet-ingest"]
+assert fiv, "learner close emitted no fleet-ingest flow verdict"
+assert all(v["ok"] for v in fiv), fiv
 
 # graceful drains: rc 0 = guards + sentinel budgets clean everywhere
 rc = router.stop(drain_timeout_s=180)
 assert rc == 0, f"flywheel router exit {rc}"
+# drain/close-time conservation verdicts from the router process: the
+# request books, the gate verdict tally, every tenant row, and the
+# mirror tap's window ledger (chaos losses on the books, pending zero)
+verdicts = [json.loads(l.split("[flow-verdict]", 1)[1])
+            for l in router.lines if "[flow-verdict]" in l]
+for fam in ("router", "router-gate", "router-tenant", "mirror-tap"):
+    fv = [v for v in verdicts if v["family"] == fam]
+    assert fv, f"flywheel router drain emitted no {fam} flow verdict"
+    assert all(v["ok"] for v in fv), fv
 for rid in (0, 1):
     rc = reps[rid].stop(drain_timeout_s=120)
     assert rc == 0, f"flywheel replica {rid} exit {rc}"
+    rv = [json.loads(l.split("[flow-verdict]", 1)[1])
+          for l in reps[rid].lines if "[flow-verdict]" in l]
+    sv = [v for v in rv if v["family"] == "serve-stats"]
+    assert sv and all(v["ok"] for v in sv), (rid, rv)
 
 doc = {
     "backend": "cpu",

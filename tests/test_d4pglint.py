@@ -394,6 +394,33 @@ FIXTURES = [
         """,
     ),
     (
+        "counter-discipline",
+        "d4pg_tpu/serve/stats.py",
+        """
+        import threading
+
+        class ServeStats:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.requests_total = 0
+
+            def admit(self):
+                self.requests_total += 1
+        """,
+        """
+        import threading
+
+        class ServeStats:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.requests_total = 0
+
+            def admit(self):
+                with self._lock:
+                    self.requests_total += 1
+        """,
+    ),
+    (
         "lock-order",
         "d4pg_tpu/runtime/x.py",
         """
@@ -483,6 +510,123 @@ FIXTURES = [
 
             def close(self):
                 self._t.join(timeout=5)
+        """,
+    ),
+    (
+        "flowcheck",
+        "d4pg_tpu/fleet/actor.py",
+        # A consumed-but-unbooked exit: the else arm pops the pending
+        # entry, then raises without booking a terminal disposition —
+        # the exact FleetLink bug class the pass exists to catch. The
+        # good twin books "dropped" before raising.
+        """
+        import threading
+
+        class FleetLink:
+            def __init__(self, on_ack):
+                self._pending = {}
+                self._pending_lock = threading.Lock()
+                self._on_ack = on_ack
+
+            def _read_loop(self):
+                while True:
+                    msg_type, req_id = self._recv()
+                    with self._pending_lock:
+                        n = self._pending.pop(req_id, None)
+                    if n is None:
+                        continue
+                    if msg_type == 1:
+                        self._on_ack("accepted", n)
+                    elif msg_type == 2:
+                        self._on_ack("stale", n)
+                    elif msg_type == 3:
+                        self._on_ack("shed", n)
+                    else:
+                        raise RuntimeError("unexpected reply type")
+
+            def _fail_send(self, req_id):
+                with self._pending_lock:
+                    n = self._pending.pop(req_id, None)
+                if n is not None:
+                    self._on_ack("dropped", n)
+
+        class FleetActor:
+            def __init__(self):
+                self._stats_lock = threading.Lock()
+                self._stats = {}
+
+            def _inc(self, key, n=1):
+                with self._stats_lock:
+                    self._stats[key] += n
+
+            def run(self):
+                self._inc("windows_emitted", 1)
+
+            def _on_ack(self, kind, n):
+                self._inc(
+                    {
+                        "accepted": "windows_acked",
+                        "stale": "windows_stale",
+                        "shed": "windows_shed",
+                        "dropped": "windows_dropped_reconnect",
+                    }[kind],
+                    n,
+                )
+        """,
+        """
+        import threading
+
+        class FleetLink:
+            def __init__(self, on_ack):
+                self._pending = {}
+                self._pending_lock = threading.Lock()
+                self._on_ack = on_ack
+
+            def _read_loop(self):
+                while True:
+                    msg_type, req_id = self._recv()
+                    with self._pending_lock:
+                        n = self._pending.pop(req_id, None)
+                    if n is None:
+                        continue
+                    if msg_type == 1:
+                        self._on_ack("accepted", n)
+                    elif msg_type == 2:
+                        self._on_ack("stale", n)
+                    elif msg_type == 3:
+                        self._on_ack("shed", n)
+                    else:
+                        self._on_ack("dropped", n)
+                        raise RuntimeError("unexpected reply type")
+
+            def _fail_send(self, req_id):
+                with self._pending_lock:
+                    n = self._pending.pop(req_id, None)
+                if n is not None:
+                    self._on_ack("dropped", n)
+
+        class FleetActor:
+            def __init__(self):
+                self._stats_lock = threading.Lock()
+                self._stats = {}
+
+            def _inc(self, key, n=1):
+                with self._stats_lock:
+                    self._stats[key] += n
+
+            def run(self):
+                self._inc("windows_emitted", 1)
+
+            def _on_ack(self, kind, n):
+                self._inc(
+                    {
+                        "accepted": "windows_acked",
+                        "stale": "windows_stale",
+                        "shed": "windows_shed",
+                        "dropped": "windows_dropped_reconnect",
+                    }[kind],
+                    n,
+                )
         """,
     ),
     (

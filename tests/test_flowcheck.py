@@ -1,0 +1,266 @@
+"""Flow conservation, both halves (ISSUE 19 acceptance fixture).
+
+The seeded bug is the PR-7 FleetLink vanished-windows class: an
+unexpected reply type for a KNOWN req_id pops the pending entry and
+kills the link WITHOUT booking the windows as dropped, so they vanish
+from the ``windows_emitted == accounted`` identity. One test re-seeds
+that bug into the real source and asserts the static ``flowcheck`` pass
+names the unbooked exit; one drives a LIVE FleetLink into the same arm
+against an impostor server whose books ignore the drop, and asserts the
+runtime ConservationLedger raises at drain. Plus unit coverage for the
+ledger itself and the committed flow-identities artifact.
+"""
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from d4pg_tpu.analysis import flowledger
+from d4pg_tpu.analysis.flowledger import ConservationError
+from d4pg_tpu.fleet import wire
+from d4pg_tpu.fleet.actor import FleetLink
+from d4pg_tpu.serve import protocol
+from tools.d4pglint.core import lint_source
+from tools.d4pglint.wholeprog.config import FLOW_IDENTITIES
+from tools.d4pglint.wholeprog.flowcheck import identity_counters
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ACTOR_REL = "d4pg_tpu/fleet/actor.py"
+OBS, ACT, NSTEP, GAMMA = 5, 2, 3, 0.99
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+def _actor_src() -> str:
+    with open(os.path.join(REPO, ACTOR_REL)) as f:
+        return f.read()
+
+
+# ------------------------------------------------------------- static half
+def test_real_actor_source_is_conservation_clean():
+    findings, _ = lint_source(_actor_src(), ACTOR_REL, checks=["flowcheck"])
+    assert findings == [], findings
+
+
+def test_seeded_fleetlink_bug_caught_by_static_pass():
+    """Delete the unexpected-reply-type booking (the historical bug) and
+    the pass must name the now-unbooked ``raise`` exit in _read_loop."""
+    src = _actor_src()
+    lines = src.splitlines()
+    booked = [
+        i for i, ln in enumerate(lines)
+        if '"dropped"' in ln
+        and i + 1 < len(lines)
+        and "unexpected reply type" in lines[i + 1]
+    ]
+    assert len(booked) == 1, "seeded-bug site moved: update this test"
+    del lines[booked[0]]
+    findings, _ = lint_source(
+        "\n".join(lines), ACTOR_REL, checks=["flowcheck"]
+    )
+    assert findings, "static pass missed the seeded vanished-windows bug"
+    msgs = [f.message for f in findings]
+    assert any(
+        "FleetLink._read_loop" in m and "raise" in m for m in msgs
+    ), msgs
+
+
+# ------------------------------------------------------------ ledger units
+@pytest.fixture(autouse=True)
+def _reset_ledger():
+    flowledger.reset()
+    yield
+    flowledger.reset()
+
+
+def test_ledger_disabled_is_a_noop():
+    assert flowledger.check("fleet-actor", {"windows_emitted": 9}) is None
+
+
+def test_ledger_balanced_emits_verdict_line(capsys):
+    flowledger.enable()
+    assert flowledger.check(
+        "router",
+        {"requests_total": 5, "replies_ok": 3, "replies_overloaded": 1,
+         "replies_error": 1},
+        where="unit",
+    )
+    line = [
+        ln for ln in capsys.readouterr().out.splitlines()
+        if ln.startswith("[flow-verdict] ")
+    ]
+    assert len(line) == 1
+    doc = json.loads(line[0][len("[flow-verdict] "):])
+    assert doc["family"] == "router" and doc["ok"] is True
+    assert doc["counters"]["requests_total"] == 5
+
+
+def test_ledger_imbalance_raises_named_error():
+    flowledger.enable()
+    with pytest.raises(ConservationError) as ei:
+        flowledger.check(
+            "fleet-ingest",
+            {"windows_from_actors": 4, "windows_from_mirror": 1,
+             "windows_ingested": 3},
+            where="unit",
+        )
+    assert "fleet-ingest" in str(ei.value)
+    assert "windows_ingested" in str(ei.value)
+
+
+def test_ledger_per_row_families(capsys):
+    flowledger.enable()
+    rows = {
+        "acme/interactive": {"requests": 3, "ok": 2, "overloaded": 1,
+                             "error": 0},
+        "acme/bulk": {"requests": 2, "ok": 1, "overloaded": 0, "error": 0},
+    }
+    with pytest.raises(ConservationError) as ei:
+        flowledger.check_rows("router-tenant", rows, where="unit")
+    assert "acme/bulk" in str(ei.value)
+    doc = json.loads(
+        capsys.readouterr().out.splitlines()[0][len("[flow-verdict] "):]
+    )
+    assert doc["counters"] == {"rows": 2, "bad_rows": 1}
+    rows["acme/bulk"]["error"] = 1
+    assert flowledger.check_rows("router-tenant", rows, where="unit")
+
+
+# -------------------------------------------------------- committed artifact
+def test_committed_flow_identities_artifact_is_fresh():
+    from tools.d4pglint.core import parse_default_files, repo_root
+    from tools.d4pglint.wholeprog.flowcheck import build_flow_graph
+
+    with open(os.path.join(REPO, "benchmarks", "flow_identities.json")) as f:
+        committed = json.load(f)
+    root = repo_root()
+    rebuilt = build_flow_graph(parse_default_files(root), root)
+    assert committed == rebuilt, (
+        "benchmarks/flow_identities.json is stale — regenerate with "
+        "`python -m tools.d4pglint.wholeprog.flowcheck --write`"
+    )
+    for fam, doc in committed["families"].items():
+        assert doc["assertion_sites"], f"{fam}: identity asserted nowhere"
+
+
+def test_every_family_identity_parses_and_references_known_counters():
+    for fam, doc in FLOW_IDENTITIES.items():
+        names = identity_counters(doc)
+        assert names, fam
+        # the ledger's evaluator must accept every committed identity
+        flowledger.enable()
+        flowledger.check(fam, {n: 0 for n in names}, where="unit") \
+            if not doc.get("per_row") else \
+            flowledger.check_rows(fam, {"r": {n: 0 for n in names}},
+                                  where="unit")
+        flowledger.reset()
+
+
+# ------------------------------------------------------------- runtime half
+def _impostor_server(reply_type: int, state: dict):
+    """Handshakes, reads ONE windows frame, answers it with
+    ``reply_type`` — protocol betrayal after a clean HELLO."""
+    lsock = socket.create_server(("127.0.0.1", 0))
+    state["port"] = lsock.getsockname()[1]
+
+    def serve():
+        conn, _ = lsock.accept()
+        with conn:
+            frame = protocol.read_frame(conn)  # HELLO
+            protocol.write_frame(
+                conn, protocol.HELLO_OK, frame[1],
+                wire.encode_hello_ok(
+                    generation=0, max_windows=64, max_inflight=4
+                ),
+            )
+            t, req_id, _payload = protocol.read_frame(conn)
+            assert t == protocol.WINDOWS
+            protocol.write_frame(conn, reply_type, req_id, b"gotcha")
+            state["replied"] = True
+            time.sleep(0.5)  # let the client read before RST
+    threading.Thread(target=serve, name="impostor", daemon=True).start()
+    return lsock
+
+
+def _frame_cols(n):
+    rng = np.random.default_rng(0)
+    return {
+        "obs": rng.standard_normal((n, OBS)).astype(np.float32),
+        "action": rng.standard_normal((n, ACT)).astype(np.float32),
+        "reward": rng.standard_normal(n).astype(np.float32),
+        "next_obs": rng.standard_normal((n, OBS)).astype(np.float32),
+        "discount": rng.random(n).astype(np.float32),
+    }
+
+
+def _drive_link_into_unexpected_reply(on_ack):
+    state = {}
+    lsock = _impostor_server(reply_type=protocol.ACT_OK, state=state)
+    link = FleetLink(
+        "127.0.0.1", state["port"],
+        dict(actor_id="seeded", env="e", obs_dim=OBS, action_dim=ACT,
+             n_step=NSTEP, gamma=GAMMA, generation=0),
+        on_ack=on_ack,
+    )
+    try:
+        assert link.acquire_credit(5)
+        link.send_windows((0, 0, False), _frame_cols(3))
+        assert _wait(lambda: link.dead is not None)
+        assert "unexpected reply type" in str(link.dead)
+    finally:
+        link.close()
+        lsock.close()
+
+
+def test_seeded_fleetlink_bug_caught_by_ledger():
+    """Live FleetLink hits the unexpected-reply arm. With the seeded
+    bug's books (the drop never recorded), the ledger raises at drain;
+    with honest books the same drain balances."""
+    stats = {k: 0 for k in (
+        "windows_emitted", "windows_acked", "windows_stale", "windows_shed",
+        "windows_dropped_reconnect", "windows_dropped_spool", "spool_depth",
+    )}
+    lock = threading.Lock()
+    kinds = {"accepted": "windows_acked", "stale": "windows_stale",
+             "shed": "windows_shed", "dropped": "windows_dropped_reconnect"}
+
+    def buggy_on_ack(kind, n):
+        with lock:
+            if kind != "dropped":  # the seeded bug: drops vanish
+                stats[kinds[kind]] += n
+
+    stats["windows_emitted"] = 3
+    _drive_link_into_unexpected_reply(buggy_on_ack)
+    flowledger.enable()
+    with pytest.raises(ConservationError) as ei:
+        flowledger.check("fleet-actor", stats, where="actor drain")
+    assert "fleet-actor" in str(ei.value)
+    assert "consumed without booking" in str(ei.value)
+
+    # control: honest books → the SAME drain balances
+    for k in kinds.values():
+        stats[k] = 0
+
+    def honest_on_ack(kind, n):
+        with lock:
+            stats[kinds[kind]] += n
+
+    _drive_link_into_unexpected_reply(honest_on_ack)
+    assert flowledger.check("fleet-actor", stats, where="actor drain")
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v"]))

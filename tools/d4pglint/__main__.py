@@ -18,7 +18,9 @@ from __future__ import annotations
 import argparse
 import subprocess
 import sys
+import time
 
+from tools.d4pglint import core
 from tools.d4pglint.config import ALL_CHECKS, DEFAULT_PATHS
 from tools.d4pglint.core import lint_paths, repo_root
 
@@ -44,9 +46,22 @@ def main(argv=None) -> int:
         unknown = [c for c in args.checks if c not in ALL_CHECKS]
         if unknown:
             p.error(f"unknown check ids: {', '.join(unknown)}")
+    t0 = time.perf_counter()
     findings, suppressed = lint_paths(args.paths or None, checks=args.checks)
+    lint_s = time.perf_counter() - t0
     for f in findings:
         print(f)
+    if not args.checks and core.FILE_TIMINGS:
+        # The wall-time budget scripts/lint.sh asserts is only
+        # actionable with a culprit list: name the slowest files.
+        slowest = sorted(
+            core.FILE_TIMINGS.items(), key=lambda kv: -kv[1]
+        )[:3]
+        print(
+            f"[lint-timing] {len(core.FILE_TIMINGS)} files in "
+            f"{lint_s:.2f}s (jobs={core._jobs()}), slowest: "
+            + " ".join(f"{rel}={dt * 1000:.0f}ms" for rel, dt in slowest)
+        )
     if args.show_suppressed:
         for f in suppressed:
             print(f"(suppressed) {f}")

@@ -736,3 +736,174 @@ def device_loop_transfer(tree, src_lines, relpath):
                 if qual in wanted:
                     scan_fn(m, qual)
     return out
+
+
+# ----------------------------------------------------------------- check 12
+@check("counter-discipline")
+def counter_discipline(tree, src_lines, relpath):
+    """Counters named in the FLOW_IDENTITIES manifest may only be mutated
+    with `+=`/`-=` of a non-negative operand, under a lock-ish `with` (or
+    in a method the owning class declares in its `_FLOW_SINGLE_WRITER`
+    tuple). A plain `self.K = ...` outside `__init__` resets the books; a
+    negative operand un-books an event that already happened; an unlocked
+    bump tears under concurrent writers — each silently breaks the
+    conservation identity the flowcheck pass proves. Scope is the owning
+    class's module, so a caller bypassing the owner's locked `inc()` with
+    a direct `self.stats.K += 1` is flagged too. Dynamic mutations
+    (`setattr(self, field, ...)`, `self._c[key] += n` with a variable
+    key) are invisible per-file — the whole-program flowcheck indexes
+    their call sites instead."""
+    # Lazy import: wholeprog.__init__ loads the whole-program checkers,
+    # which import core, which imports this module — a top-level import
+    # here would close that cycle on a half-initialized checks module.
+    from tools.d4pglint.wholeprog.config import FLOW_IDENTITIES
+
+    counters: set[str] = set()
+    gauges: set[str] = set()
+    for fam in FLOW_IDENTITIES.values():
+        owner = fam.get("class")
+        if not owner or owner.split("::")[0] != relpath:
+            continue
+        for tok in fam["identity"].replace("==", "+").split("+"):
+            name = tok.strip()
+            if name and not name.isdigit():
+                counters.add(name)
+        gauges.update(fam.get("gauges", ()))
+        counters.difference_update(fam.get("derived", ()))
+    if not counters:
+        return []
+
+    def counter_store(node) -> str | None:
+        """'K' when node stores manifest counter K via a self-rooted
+        attribute chain (`self.K`, `self.stats.K`) or constant subscript
+        (`self._store["K"]`); else None."""
+        if isinstance(node, ast.Subscript):
+            if not (
+                isinstance(node.slice, ast.Constant)
+                and node.slice.value in counters
+            ):
+                return None
+            root = node.value
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name) and root.id == "self":
+                return node.slice.value
+            return None
+        if (
+            isinstance(node, ast.Attribute)
+            and node.attr in counters
+        ):
+            root = node.value
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name) and root.id == "self":
+                return node.attr
+        return None
+
+    def negative_operand(value) -> bool:
+        if isinstance(value, ast.UnaryOp) and isinstance(value.op, ast.USub):
+            return True
+        return (
+            isinstance(value, ast.Constant)
+            and isinstance(value.value, (int, float))
+            and value.value < 0
+        )
+
+    out = []
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        single_writer: set[str] = set()
+        for node in cls.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if (
+                        isinstance(t, ast.Name)
+                        and t.id == "_FLOW_SINGLE_WRITER"
+                    ):
+                        for elt in getattr(node.value, "elts", []):
+                            if isinstance(elt, ast.Constant):
+                                single_writer.add(str(elt.value))
+        for m in cls.body:
+            if not isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+
+            def visit(node, locked, meth=m):
+                for child in ast.iter_child_nodes(node):
+                    child_locked = locked
+                    if isinstance(child, ast.With) and any(
+                        _lockish(_terminal_name(i.context_expr))
+                        for i in child.items
+                    ):
+                        child_locked = True
+                    if isinstance(
+                        child,
+                        (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+                    ):
+                        continue
+                    if isinstance(child, ast.AugAssign):
+                        k = counter_store(child.target)
+                        if k:
+                            where = f"`{cls.name}.{meth.name}`"
+                            if not isinstance(
+                                child.op, (ast.Add, ast.Sub)
+                            ):
+                                out.append(Finding(
+                                    "counter-discipline", relpath,
+                                    child.lineno,
+                                    f"flow counter `{k}` mutated with a "
+                                    f"non-additive operator in {where}: "
+                                    "conservation bookkeeping is "
+                                    "`+=`/`-=` only",
+                                ))
+                            elif (
+                                k not in gauges
+                                and negative_operand(child.value)
+                            ):
+                                out.append(Finding(
+                                    "counter-discipline", relpath,
+                                    child.lineno,
+                                    f"flow counter `{k}` decremented in "
+                                    f"{where}: terminal-disposition "
+                                    "counters are monotone — un-booking "
+                                    "an event breaks the conservation "
+                                    "identity (gauges go in the "
+                                    "manifest's `gauges` tuple)",
+                                ))
+                            if not child_locked and (
+                                meth.name not in single_writer
+                            ):
+                                out.append(Finding(
+                                    "counter-discipline", relpath,
+                                    child.lineno,
+                                    f"flow counter `{k}` bumped without "
+                                    f"the owner's lock in {where}: guard "
+                                    "it or declare the method in "
+                                    "_FLOW_SINGLE_WRITER with a "
+                                    "why-single-threaded comment",
+                                ))
+                    elif isinstance(child, (ast.Assign, ast.AnnAssign)):
+                        targets = (
+                            child.targets
+                            if isinstance(child, ast.Assign)
+                            else [child.target]
+                        )
+                        for t in targets:
+                            tts = (
+                                t.elts if isinstance(t, ast.Tuple) else [t]
+                            )
+                            for tt in tts:
+                                k = counter_store(tt)
+                                if k and meth.name != "__init__":
+                                    out.append(Finding(
+                                        "counter-discipline", relpath,
+                                        child.lineno,
+                                        f"flow counter `{k}` overwritten "
+                                        f"in `{cls.name}.{meth.name}`: "
+                                        "plain assignment resets the "
+                                        "books — counters are zeroed in "
+                                        "__init__ and only ever `+=`'d "
+                                        "after",
+                                    ))
+                    visit(child, child_locked, meth)
+
+            visit(m, False)
+    return out

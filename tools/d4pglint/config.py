@@ -21,11 +21,13 @@ ALL_CHECKS = (
     "global-rng",            # seeded Generators only, no np.random module state
     "unbounded-retry",       # retry loops use the bounded Backoff util
     "device-loop-transfer",  # no host numpy / .item() in megastep bodies
+    "counter-discipline",    # FLOW-manifest counters: +=/-= under lock only
     # -- whole-program checks (tools/d4pglint/wholeprog/): the full parsed
     #    file map at once, not one AST at a time --
     "lock-order",            # global lock-acquisition-order graph is acyclic
     "protocol-conformance",  # wire-id space: codecs, endpoints, MAX_PAYLOAD
     "thread-lifecycle",      # bounded joins, shed answers, timed waits
+    "flowcheck",             # conservation identities: sites, paths, asserts
     "unused-suppression",    # disable= comments must still silence something
 )
 
@@ -124,6 +126,10 @@ HOST_ONLY_MODULES = (
     # fleet hosts, the replay data plane) — a JAX import here would leak
     # into every one of them.
     "d4pg_tpu/analysis/lockwitness.py",
+    # The conservation ledger checks counter dicts at drain in the same
+    # host-only processes (router, tap, fleet hosts) — JAX-free for the
+    # same reason as the lock witness.
+    "d4pg_tpu/analysis/flowledger.py",
 )
 
 # JAX-runtime packages whose top-level import violates host-only-ness.

@@ -24,6 +24,7 @@ from __future__ import annotations
 import ast
 import os
 import re
+import time
 from dataclasses import dataclass
 
 from tools.d4pglint.config import ALL_CHECKS, DEFAULT_PATHS
@@ -79,16 +80,70 @@ def _split_checks(selected):
     return per_file, whole
 
 
+# rel -> seconds for the last per-file pass (read by the CLI's
+# slowest-files line; the whole-program pass is timed separately there)
+FILE_TIMINGS: dict = {}
+
+# Below this many files the fork+pickle overhead of a process pool
+# exceeds the lint work itself (lint_source fixtures are 1 file).
+_PARALLEL_MIN_FILES = 16
+
+
+def _jobs() -> int:
+    env = os.environ.get("D4PGLINT_JOBS")
+    if env:
+        return max(1, int(env))
+    return os.cpu_count() or 1
+
+
+def _lint_one_file(args):
+    """Worker body: re-parse from source lines (ASTs don't pickle) and
+    run every selected per-file check. Top-level so it pickles."""
+    rel, src_lines, check_ids = args
+    import time as _time
+
+    from tools.d4pglint import checks as checks_mod
+
+    t0 = _time.perf_counter()
+    tree = ast.parse("\n".join(src_lines))
+    out = []
+    for check_id in check_ids:
+        out.extend(checks_mod.REGISTRY[check_id](tree, src_lines, rel))
+    return rel, out, _time.perf_counter() - t0
+
+
 def _raw_findings(files: dict, check_ids, root) -> list[Finding]:
-    """Run checks over the parsed file map; no suppression filtering."""
+    """Run checks over the parsed file map; no suppression filtering.
+
+    The per-file pass is embarrassingly parallel, so on a manifest-sized
+    run it fans out over a process pool (D4PGLINT_JOBS overrides the
+    core count); each worker re-parses its file from source lines. The
+    whole-program pass stays serial — its value is the cross-file view.
+    """
     from tools.d4pglint import checks as checks_mod
     from tools.d4pglint import wholeprog
 
     per_file, whole = _split_checks(check_ids)
     raw: list[Finding] = []
-    for rel, (tree, src_lines) in sorted(files.items()):
-        for check_id in per_file:
-            raw.extend(checks_mod.REGISTRY[check_id](tree, src_lines, rel))
+    FILE_TIMINGS.clear()
+    jobs = min(_jobs(), len(files))
+    if per_file and jobs > 1 and len(files) >= _PARALLEL_MIN_FILES:
+        import concurrent.futures
+
+        work = [
+            (rel, src_lines, per_file)
+            for rel, (_tree, src_lines) in sorted(files.items())
+        ]
+        with concurrent.futures.ProcessPoolExecutor(max_workers=jobs) as ex:
+            for rel, found, dt in ex.map(_lint_one_file, work, chunksize=4):
+                raw.extend(found)
+                FILE_TIMINGS[rel] = dt
+    else:
+        for rel, (tree, src_lines) in sorted(files.items()):
+            t0 = time.perf_counter()
+            for check_id in per_file:
+                raw.extend(checks_mod.REGISTRY[check_id](tree, src_lines, rel))
+            FILE_TIMINGS[rel] = time.perf_counter() - t0
     if whole:
         raw.extend(wholeprog.run_checks(files, whole, root))
     return raw

@@ -460,6 +460,70 @@ def check_lock_order_graph(path: str, root: str | None = None) -> list[str]:
     return errs
 
 
+def check_flow_identities(path: str, root: str | None = None) -> list[str]:
+    """Shape + invariants for ``benchmarks/flow_identities.json``:
+
+    - the committed artifact parses and carries the v1 schema fields;
+    - every family names its identity, counters, and (for class-owned
+      families) at least one increment site per non-derived counter;
+    - every family has at least one ASSERTION site — an identity nobody
+      checks is a claim, not a contract;
+    - with ``root`` given, the artifact byte-matches a fresh analysis
+      (drift = counters/dispositions changed without regenerating:
+      ``python -m tools.d4pglint.wholeprog.flowcheck --write``).
+    """
+    from tools.d4pglint.wholeprog.flowcheck import GRAPH_SCHEMA
+
+    errs = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: unreadable/invalid JSON ({e})"]
+    if not isinstance(doc, dict) or doc.get("schema") != GRAPH_SCHEMA:
+        return [f"{path}: missing/unknown schema (expected {GRAPH_SCHEMA!r})"]
+    fams = doc.get("families")
+    if not (isinstance(fams, dict) and fams):
+        return [f"{path}: 'families' must be a non-empty object"]
+    for name, fam in sorted(fams.items()):
+        if not isinstance(fam, dict):
+            errs.append(f"{path}: families[{name!r}] must be an object")
+            continue
+        if "==" not in str(fam.get("identity", "")):
+            errs.append(f"{path}: families[{name!r}] identity needs `==`")
+        if not fam.get("assertion_sites"):
+            errs.append(
+                f"{path}: families[{name!r}] has no assertion site — an "
+                "identity no test/soak/healthz checks is uncommittable"
+            )
+        derived = set(fam.get("derived", ()))
+        sites = fam.get("increment_sites", {})
+        if fam.get("class"):
+            for counter in fam.get("counters", ()):
+                if counter not in derived and not sites.get(counter):
+                    errs.append(
+                        f"{path}: families[{name!r}] counter {counter!r} "
+                        "has no increment site"
+                    )
+    if root is not None:
+        from tools.d4pglint.core import parse_default_files
+        from tools.d4pglint.wholeprog.flowcheck import build_flow_graph
+
+        fresh = build_flow_graph(parse_default_files(root), root)
+        if doc != fresh:
+            stale = sorted(
+                k for k in set(doc.get("families", {})) | set(fresh["families"])
+                if doc.get("families", {}).get(k) != fresh["families"].get(k)
+            )
+            errs.append(
+                f"{path}: stale vs the current code (families drifted: "
+                f"{', '.join(stale) or 'top-level fields'}) — regenerate "
+                "with `python -m tools.d4pglint.wholeprog.flowcheck "
+                "--write`"
+            )
+    return errs
+
+
 def check_multihost_microbench(path: str) -> list[str]:
     """Shape + invariants for ``benchmarks/multihost_microbench.json`` —
     the ISSUE-17 acceptance artifact. Three refusals beyond the generic
@@ -889,6 +953,11 @@ def check_tree(root: str) -> list[str]:
             # pin + freshness vs the current code) replaces the generic
             # backend-key rule
             errs.extend(check_lock_order_graph(path, root))
+            continue
+        if os.path.basename(path) == "flow_identities.json":
+            # same contract as the lock graph: its own schema + a
+            # freshness pin vs the current code, not a microbench
+            errs.extend(check_flow_identities(path, root))
             continue
         errs.extend(check_benchmark_json(path))
         if os.path.basename(path) == "router_microbench.json":

@@ -61,6 +61,7 @@ def _load() -> None:
     """Import the check modules (which self-register). Deferred so that
     ``tools.d4pglint.core`` can import this package without a cycle."""
     from tools.d4pglint.wholeprog import (  # noqa: F401
+        flowcheck,
         lifecycle,
         lockgraph,
         protocolcheck,

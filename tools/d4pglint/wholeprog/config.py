@@ -153,6 +153,158 @@ DECLARED_REPLICATED = (
      "conv pixel encoder replicates by design (dp-parallel, small)"),
 )
 
+# --------------------------------------------------------- flow conservation
+# The accounting identities (ISSUE 19): every item that enters a counter
+# family must exit booked under exactly one terminal counter. Each entry
+# declares one family:
+#
+#   class        "module.py::ClassName" owning the counter store, or
+#                None for per-row families (identity holds per snapshot
+#                row, statically unattributable — runtime/assertion only)
+#   identity     the conservation equation as a python expression over
+#                counter names; the runtime ledger evaluates it verbatim
+#                against the registered dict, the static pass requires
+#                every non-derived name to have an increment site
+#   gauges       names that legally go DOWN (e.g. inflight: +1 enqueue,
+#                -1 resolve) — exempt from the non-negative-operand and
+#                single-writer rules
+#   derived      names computed at snapshot time (len(), spool state),
+#                never stored as mutable counters — no increment site
+#                expected, no mutation-discipline scope
+#   multi_writer names legitimately incremented from more than one
+#                (class, method) site; anything else with >1 writer is a
+#                finding (the double-booked-rollback bug class, PR 8)
+#   dispositions the dispatch/read/drain loops where items are consumed
+#                and must exit booked: func "module.py::Class.method",
+#                consumes = dotted-call suffixes that pop an item,
+#                books = callable/attr names that count as a terminal
+#                booking (the FleetLink bug class, PR 7)
+#
+# Removing a counter from an identity, or an identity from this table,
+# is a reviewed manifest change — exactly the lock-graph contract.
+FLOW_IDENTITIES = {
+    "fleet-actor": {
+        "class": "d4pg_tpu/fleet/actor.py::FleetActor",
+        "identity": (
+            "windows_emitted == windows_acked + windows_stale"
+            " + windows_shed + windows_dropped_reconnect"
+            " + windows_dropped_spool + spool_depth"
+        ),
+        "gauges": (),
+        "derived": ("windows_dropped_spool", "spool_depth"),
+        "multi_writer": (),
+        "dispositions": (
+            # the reader thread: every pending req_id popped on a reply
+            # must book via on_ack before the path exits
+            {"func": "d4pg_tpu/fleet/actor.py::FleetLink._read_loop",
+             "consumes": ("_pending.pop",),
+             "books": ("_on_ack", "on_ack")},
+            # the send-failure path: a popped pending entry books dropped
+            {"func": "d4pg_tpu/fleet/actor.py::FleetLink._fail_send",
+             "consumes": ("_pending.pop",),
+             "books": ("_on_ack", "on_ack")},
+        ),
+    },
+    "mirror-tap": {
+        "class": "d4pg_tpu/flywheel/tap.py::MirrorTap",
+        "identity": (
+            "windows_built == windows_acked + windows_stale + windows_shed"
+            " + windows_dropped_chaos + windows_dropped_link"
+            " + windows_dropped_full + pending"
+        ),
+        "gauges": (),
+        "derived": ("pending",),
+        "multi_writer": (),
+        "dispositions": (
+            # the sender thread batch-collects pending windows; _flush
+            # books every disposition (ack/stale/shed/dropped_link)
+            {"func": "d4pg_tpu/flywheel/tap.py::MirrorTap._sender_loop",
+             "consumes": ("_pending.popleft",),
+             "books": ("_inc",)},
+        ),
+    },
+    "fleet-ingest": {
+        "class": "d4pg_tpu/fleet/ingest.py::IngestServer",
+        "identity": (
+            "windows_from_actors + windows_from_mirror == windows_ingested"
+        ),
+        "gauges": (),
+        "derived": (),
+        "multi_writer": (),
+        "dispositions": (
+            # the writer thread batch-collects queued frames;
+            # _write_frames books ingested + per-source splits
+            {"func": "d4pg_tpu/fleet/ingest.py::IngestServer._writer_loop",
+             "consumes": ("_queue.popleft",),
+             "books": ("_inc",)},
+        ),
+    },
+    "router": {
+        "class": "d4pg_tpu/serve/router.py::RouterStats",
+        "identity": (
+            "requests_total == replies_ok + replies_overloaded"
+            " + replies_error"
+        ),
+        "gauges": (),
+        "derived": (),
+        # admission books requests_total at three entry shapes (ACT relay,
+        # FEEDBACK relay, overload shed) and each terminal books from its
+        # own path — the identity, not single-writer, is the contract here
+        "multi_writer": ("requests_total", "replies_ok",
+                         "replies_overloaded", "replies_error"),
+        # Router._serve_conn terminals resolve in done-callbacks on later
+        # relay completions — path-local disposition walking would
+        # false-positive, so the router relies on the runtime ledger
+        "dispositions": (),
+    },
+    "router-gate": {
+        "class": "d4pg_tpu/serve/router.py::RouterStats",
+        "identity": (
+            "gate_evaluations == gate_pass + gate_block + gate_stalls"
+        ),
+        "gauges": (),
+        "derived": (),
+        "multi_writer": (),
+        "dispositions": (),
+    },
+    "serve-stats": {
+        "class": "d4pg_tpu/serve/stats.py::ServeStats",
+        "identity": (
+            "requests_total == replies_ok + shed_queue_full"
+            " + shed_deadline + shed_draining + inflight"
+        ),
+        "gauges": ("inflight",),
+        "derived": (),
+        # shed_draining books from both the submit path and the
+        # cancel-on-drain sweep (DynamicBatcher.submit / _resolve paths)
+        "multi_writer": ("shed_draining",),
+        # DynamicBatcher.submit hands the item to a future resolved by
+        # the batch thread — terminals book asynchronously, runtime-only
+        "dispositions": (),
+    },
+    "router-tenant": {
+        "class": None,  # per-row: RouterStats.tenants_snapshot() rows
+        "identity": "requests == ok + overloaded + error",
+        "gauges": (),
+        "derived": (),
+        "multi_writer": (),
+        "dispositions": (),
+        "per_row": True,
+    },
+    "league-tenure": {
+        "class": None,  # per-row: league controller per-uid vertex dicts
+        "identity": (
+            "spawned + adopted == exited_0 + exited_75 + exited_err"
+            " + killed + live"
+        ),
+        "gauges": (),
+        "derived": (),
+        "multi_writer": (),
+        "dispositions": (),
+        "per_row": True,
+    },
+}
+
 # ------------------------------------------------------------ docs catalog
 # Runtime guards that docs/analysis.md must document (one "### <title>"
 # heading each) — PR 6 found a missing catalog row by hand; this makes
@@ -162,4 +314,5 @@ RUNTIME_GUARDS = (
     ("transfer.py", "Transfer guard"),
     ("ledger.py", "Staging ledger"),
     ("lockwitness.py", "Lock-order witness"),
+    ("flowledger.py", "Conservation ledger"),
 )

@@ -1,0 +1,611 @@
+"""Flow conservation: machine-check the accounting identities.
+
+The repo's most load-bearing invariant class is the accounting identity
+(``emitted == acked + stale + shed + dropped_* + pending`` and friends)
+— the exactly-once item bookkeeping production replay systems treat as a
+first-class contract. At least four review-round bugs were precisely "an
+item left a dispatch path without booking exactly one terminal counter".
+This pass is the static half of that contract (the runtime half is
+``d4pg_tpu/analysis/flowledger.py``), driven by the reviewed
+``FLOW_IDENTITIES`` manifest:
+
+1. **every declared counter has a visible increment site, and counters
+   are single-writer unless declared** — increments are indexed across
+   the whole file map (``self.K += n``, ``self._counters["K"] += n``,
+   ``self._inc("K", ...)``, ``recv.inc("K", ...)`` with the receiver
+   resolved through the PR-10 RepoIndex, and the dict-literal dispatch
+   ``self._inc({...}[kind], n)``); a counter incremented from two
+   (class, method) pairs without a ``multi_writer`` declaration is the
+   double-booked-rollback bug class caught at lint time.
+2. **disposition exit paths book** — each declared disposition function
+   (the dispatch/read/drain loop where items are consumed) is walked
+   from every consume site (``_pending.pop`` etc.) to every exit
+   (``return`` / ``raise`` / ``break`` / ``continue`` / loop-body end /
+   function end); a path that consumed an item and exits without a
+   terminal-counter booking is the FleetLink vanished-windows bug class.
+   The walk is branch-granular after flattening ``elif`` chains (a
+   branch whose subtree books is covered — conditional split-bookings
+   like ``if accepted: book(...)`` stay legal), exempts the
+   ``if <item> is None:`` not-consumed guard and the
+   ``if <item> is not None:`` booked-body shape, treats a method that
+   transitively calls a booking name as itself booking (fixpoint over
+   the class), and models batch-collect consumes
+   (``batch.append(q.popleft())``) by resuming after the collect loop.
+   Over-approximations are deliberate and one-sided: a ``raise`` after
+   consume is an exit even if an outer handler would book, and a
+   covered branch is not re-split below branch granularity.
+3. **every declared identity is asserted somewhere** — a text scan over
+   tests, soak/smoke scripts, schema_check, and d4pg_tpu for either all
+   the identity's counter names in one file or a ``[flow-verdict]``
+   parse naming the family; an unasserted identity is uncommittable
+   (the composition-matrix precedent).
+
+The extracted flow graph (counters, increment sites, dispositions,
+assertion sites) is committed as ``benchmarks/flow_identities.json`` and
+schema-gated for freshness, exactly like ``lock_order_graph.json``.
+Pure AST + text — never imports or executes linted code.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+
+from tools.d4pglint.checks import _dotted
+from tools.d4pglint.core import Finding
+from tools.d4pglint.wholeprog import wholeprog_check
+from tools.d4pglint.wholeprog.config import FLOW_IDENTITIES
+from tools.d4pglint.wholeprog.index import build_index
+
+_CHECK = "flowcheck"
+_MANIFEST_REL = "tools/d4pglint/wholeprog/config.py"
+GRAPH_SCHEMA = "flow_identities/v1"
+GENERATED_BY = "python -m tools.d4pglint.wholeprog.flowcheck --write"
+
+#: where identity assertions may live (relative dirs / files under root)
+_ASSERT_SCOPES = ("tests", "scripts", "tools/d4pglint/schema_check.py",
+                  "d4pg_tpu")
+#: the runtime ledger PRINTS the identities — not an assertion site
+_ASSERT_EXCLUDE = ("d4pg_tpu/analysis/flowledger.py",)
+
+
+def identity_counters(fam: dict) -> list:
+    """Counter names referenced by the family's identity expression."""
+    tree = ast.parse(fam["identity"], mode="eval")
+    seen: list = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and node.id not in seen:
+            seen.append(node.id)
+    return seen
+
+
+# ------------------------------------------------------------- increments
+def _const_arg(call: ast.Call) -> list:
+    """Counter-name constants a booking call increments: ``_inc("K")``,
+    ``inc("K", n)``, and the dict-literal dispatch
+    ``_inc({"a": "K1", ...}[kind], n)`` (every value is a site)."""
+    if not call.args:
+        return []
+    a = call.args[0]
+    if isinstance(a, ast.Constant) and isinstance(a.value, str):
+        return [a.value]
+    if isinstance(a, ast.Subscript) and isinstance(a.value, ast.Dict):
+        return [
+            v.value
+            for v in a.value.values
+            if isinstance(v, ast.Constant) and isinstance(v.value, str)
+        ]
+    return []
+
+
+def _index_increments(files: dict, index) -> dict:
+    """{family: {counter: set of (rel, class, method, lineno)}} for every
+    non-per-row family whose owning module is in the file map."""
+    owners: dict = {}  # class name -> [(family, counters set)]
+    for name, fam in FLOW_IDENTITIES.items():
+        if fam.get("per_row") or fam["class"] is None:
+            continue
+        rel, cls = fam["class"].split("::")
+        if rel not in files:
+            continue
+        counters = set(identity_counters(fam)) - set(fam["derived"])
+        owners.setdefault(cls, []).append((name, counters))
+    sites: dict = {
+        name: {c: set() for c in cs}
+        for infos in owners.values()
+        for name, cs in infos
+    }
+
+    def book(owner_cls, key, rel, cls, meth, lineno):
+        for fam_name, counters in owners.get(owner_cls, ()):
+            if key in counters:
+                sites[fam_name][key].add((rel, cls, meth, lineno))
+
+    for infos in index.classes.values():
+        for info in infos:
+            for mname, m in info.methods.items():
+                for node in ast.walk(m):
+                    if isinstance(node, ast.AugAssign):
+                        t = node.target
+                        # self.K += n
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                        ):
+                            book(info.node.name, t.attr, info.rel,
+                                 info.node.name, mname, node.lineno)
+                        # self._store["K"] += n
+                        if (
+                            isinstance(t, ast.Subscript)
+                            and isinstance(t.slice, ast.Constant)
+                            and isinstance(t.slice.value, str)
+                            and isinstance(t.value, ast.Attribute)
+                            and isinstance(t.value.value, ast.Name)
+                            and t.value.value.id == "self"
+                        ):
+                            book(info.node.name, t.slice.value, info.rel,
+                                 info.node.name, mname, node.lineno)
+                    elif isinstance(node, ast.Call):
+                        dotted = _dotted(node.func) or ""
+                        chain = dotted.split(".")
+                        if chain[-1] not in ("inc", "_inc"):
+                            continue
+                        keys = _const_arg(node)
+                        if not keys:
+                            continue
+                        if chain[0] == "self":
+                            attrs = chain[1:-1]
+                            targets = (
+                                {info.node.name}
+                                if not attrs
+                                else index.attr_classes(
+                                    info.node.name, attrs
+                                )
+                            )
+                            for owner in targets:
+                                for key in keys:
+                                    book(owner, key, info.rel,
+                                         info.node.name, mname, node.lineno)
+    return sites
+
+
+def _increment_findings(files: dict, index, out: list) -> None:
+    sites = _index_increments(files, index)
+    for fam_name, per_counter in sorted(sites.items()):
+        fam = FLOW_IDENTITIES[fam_name]
+        rel = fam["class"].split("::")[0]
+        for counter, found in sorted(per_counter.items()):
+            writers = sorted({(c, m) for (_r, c, m, _l) in found})
+            if not found:
+                out.append(
+                    Finding(
+                        _CHECK, rel, 1,
+                        f"[{fam_name}] counter `{counter}` appears in the "
+                        "conservation identity but has no visible "
+                        "increment site: fix the manifest (typo? snapshot-"
+                        "derived value belongs in `derived`), or teach the "
+                        "index the receiver type via KNOWN_ATTR_TYPES",
+                    )
+                )
+            elif len(writers) > 1 and counter not in fam["multi_writer"] \
+                    and counter not in fam["gauges"]:
+                pretty = ", ".join(f"{c}.{m}" for c, m in writers)
+                line = min(l for (_r, _c, _m, l) in found)
+                out.append(
+                    Finding(
+                        _CHECK, rel, line,
+                        f"[{fam_name}] counter `{counter}` is incremented "
+                        f"from {len(writers)} writers ({pretty}) without a "
+                        "`multi_writer` declaration in FLOW_IDENTITIES — "
+                        "undeclared multi-writer counters are how "
+                        "double-booking slips in; declare it (with the "
+                        "why) or consolidate the sites",
+                    )
+                )
+
+
+# ------------------------------------------------------------ dispositions
+def _booking_names(info, books) -> set:
+    """Fixpoint: a method whose body calls a booking name is booking."""
+    names = set(books)
+    changed = True
+    while changed:
+        changed = False
+        for mname, m in info.methods.items():
+            if mname in names:
+                continue
+            for sub in ast.walk(m):
+                if isinstance(sub, ast.Call):
+                    tail = (_dotted(sub.func) or "").split(".")[-1]
+                    if tail in names:
+                        names.add(mname)
+                        changed = True
+                        break
+    return names
+
+
+def _stmt_books(stmt, names) -> bool:
+    for sub in ast.walk(stmt):
+        if isinstance(sub, ast.Call):
+            tail = (_dotted(sub.func) or "").split(".")[-1]
+            if tail in names:
+                return True
+    return False
+
+
+def _flatten_if(node: ast.If):
+    """elif chains as flat (test, body) branches + the final else body."""
+    branches = []
+    while True:
+        branches.append((node.test, node.body))
+        if len(node.orelse) == 1 and isinstance(node.orelse[0], ast.If):
+            node = node.orelse[0]
+            continue
+        return branches, node.orelse
+
+
+def _is_none_test(test, var, negated) -> bool:
+    """``var is None`` (negated=False) / ``var is not None`` (True)."""
+    if var is None or not isinstance(test, ast.Compare):
+        return False
+    if len(test.ops) != 1 or len(test.comparators) != 1:
+        return False
+    op = test.ops[0]
+    want = ast.IsNot if negated else ast.Is
+    return (
+        isinstance(op, want)
+        and isinstance(test.left, ast.Name)
+        and test.left.id == var
+        and isinstance(test.comparators[0], ast.Constant)
+        and test.comparators[0].value is None
+    )
+
+
+class _ExitWalker:
+    """Walk a disposition function from a consume site to every exit."""
+
+    def __init__(self, books: set, var):
+        self.books = books
+        self.var = var
+        self.out: list = []  # (lineno, how)
+
+    def walk(self, stmts, i, conts) -> None:
+        while True:
+            if i >= len(stmts):
+                if not conts:
+                    self.out.append((None, "falls off the function end"))
+                    return
+                frame = conts[-1]
+                if frame[0] == "consume-loop":
+                    self.out.append(
+                        (frame[1],
+                         "reaches the end of the dispatch-loop body "
+                         "(the next iteration overwrites the live item)")
+                    )
+                    return
+                stmts, i, conts = frame[1], frame[2], conts[:-1]
+                continue
+            st = stmts[i]
+            if isinstance(st, ast.Return):
+                self.out.append((st.lineno, "returns"))
+                return
+            if isinstance(st, ast.Raise):
+                self.out.append((st.lineno, "raises"))
+                return
+            if isinstance(st, (ast.Break, ast.Continue)):
+                for k in range(len(conts) - 1, -1, -1):
+                    if conts[k][0] == "inner-loop":
+                        stmts, i, conts = conts[k][1], conts[k][2], conts[:k]
+                        break
+                else:
+                    kind = ("breaks out of"
+                            if isinstance(st, ast.Break)
+                            else "continues")
+                    self.out.append(
+                        (st.lineno, f"{kind} the dispatch loop")
+                    )
+                    return
+                continue
+            if isinstance(st, ast.If):
+                self._walk_if(st, stmts, i, conts)
+                return
+            if isinstance(st, (ast.While, ast.For)):
+                conts = conts + [("inner-loop", stmts, i + 1)]
+                stmts, i = st.body, 0
+                continue
+            if isinstance(st, ast.With):
+                conts = conts + [("after", stmts, i + 1)]
+                stmts, i = st.body, 0
+                continue
+            if isinstance(st, ast.Try):
+                # swallowing handlers are alternate paths into the rest;
+                # finally ordering is ignored (one-sided approximation)
+                after = conts + [("after", stmts, i + 1)]
+                for h in st.handlers:
+                    if any(isinstance(s, ast.Raise) for s in h.body):
+                        continue
+                    if not any(_stmt_books(s, self.books) for s in h.body):
+                        _fork(self, h.body, after)
+                conts = after
+                stmts, i = st.body, 0
+                continue
+            if _stmt_books(st, self.books):
+                return  # this path booked: covered
+            i += 1
+
+    def _walk_if(self, st, stmts, i, conts) -> None:
+        branches, else_body = _flatten_if(st)
+        after = conts + [("after", stmts, i + 1)]
+        exempt_fallthrough = False
+        for test, body in branches:
+            if _is_none_test(test, self.var, negated=False):
+                continue  # nothing was consumed on this branch
+            if _is_none_test(test, self.var, negated=True):
+                exempt_fallthrough = True  # test-false: nothing consumed
+            if any(_stmt_books(s, self.books) for s in body):
+                continue  # branch covered
+            _fork(self, body, after)
+        if else_body:
+            if not any(_stmt_books(s, self.books) for s in else_body):
+                _fork(self, else_body, after)
+            return  # every path went through a branch
+        if exempt_fallthrough:
+            return
+        self.walk(stmts, i + 1, conts)
+
+
+def _fork(walker, stmts, conts) -> None:
+    walker.walk(stmts, 0, conts)
+
+
+def _find_consumes(fn, patterns) -> list:
+    """(shape, var, spine) per consume site; spine = [(stmts, idx)] from
+    the function body down to the simple statement holding the call."""
+    out: list = []
+
+    def visit(stmts, path):
+        for idx, st in enumerate(stmts):
+            spine = path + [(stmts, idx)]
+            blocks = []
+            if isinstance(st, (ast.If, ast.While, ast.For)):
+                blocks = [st.body, st.orelse]
+            elif isinstance(st, ast.With):
+                blocks = [st.body]
+            elif isinstance(st, ast.Try):
+                blocks = [st.body, st.orelse, st.finalbody] + [
+                    h.body for h in st.handlers
+                ]
+            if blocks:
+                for b in blocks:
+                    visit(b, spine)
+                continue
+            for sub in ast.walk(st):
+                if isinstance(sub, ast.Call):
+                    dotted = _dotted(sub.func) or ""
+                    if any(dotted.endswith(p) for p in patterns):
+                        shape, var = "collect", None
+                        if isinstance(st, ast.Assign) and st.value is sub:
+                            shape = "item"
+                            if len(st.targets) == 1 and isinstance(
+                                st.targets[0], ast.Name
+                            ):
+                                var = st.targets[0].id
+                        out.append((shape, var, spine, sub.lineno))
+
+    visit(fn.body, [])
+    return out
+
+
+def _spine_frames(spine) -> list:
+    """Continuation frames for the constructs enclosing the consume:
+    loops become ``consume-loop`` (falling back to their top loses the
+    live item), everything else resumes after itself."""
+    frames = []
+    for stmts, idx in spine[:-1]:
+        st = stmts[idx]
+        if isinstance(st, (ast.While, ast.For)):
+            frames.append(("consume-loop", st.lineno))
+        else:
+            frames.append(("after", stmts, idx + 1))
+    return frames
+
+
+def _disposition_findings(files: dict, index, out: list) -> None:
+    for fam_name, fam in sorted(FLOW_IDENTITIES.items()):
+        for disp in fam["dispositions"]:
+            rel, qual = disp["func"].split("::")
+            if rel not in files:
+                continue  # module not in this lint scope (fixtures)
+            cls_name, meth = qual.split(".")
+            pairs = [
+                (info, m)
+                for info, m in index.method(cls_name, meth)
+                if info.rel == rel
+            ]
+            for info, fn in pairs:
+                books = _booking_names(info, set(disp["books"]))
+                for shape, var, spine, lineno in _find_consumes(
+                    fn, disp["consumes"]
+                ):
+                    if shape == "item":
+                        stmts, idx = spine[-1]
+                        conts = _spine_frames(spine)
+                        start = (stmts, idx + 1)
+                    else:
+                        # batch-collect: resume after the innermost
+                        # enclosing loop (the flush covers the batch)
+                        loop_lvl = max(
+                            (k for k, (s, j) in enumerate(spine[:-1])
+                             if isinstance(s[j], (ast.While, ast.For))),
+                            default=None,
+                        )
+                        if loop_lvl is None:
+                            stmts, idx = spine[-1]
+                            conts = _spine_frames(spine)
+                            start = (stmts, idx + 1)
+                        else:
+                            stmts, idx = spine[loop_lvl]
+                            conts = _spine_frames(spine[: loop_lvl + 1])
+                            start = (stmts, idx + 1)
+                    w = _ExitWalker(books, var)
+                    w.walk(start[0], start[1], conts)
+                    for exit_line, how in w.out:
+                        out.append(
+                            Finding(
+                                _CHECK, rel, exit_line or fn.lineno,
+                                f"[{fam_name}] `{qual}` consumes an item "
+                                f"at line {lineno} "
+                                f"({disp['consumes'][0]}) but this path "
+                                f"{how} without booking a terminal "
+                                "counter "
+                                f"({'/'.join(disp['books'])}): every "
+                                "consumed item must exit the disposition "
+                                "function booked exactly once (the "
+                                "vanished-windows bug class)",
+                            )
+                        )
+
+
+# -------------------------------------------------------------- assertions
+def _assertion_sites(root, fam_name, fam) -> list:
+    import os
+
+    counters = identity_counters(fam)
+    hits = []
+    for scope in _ASSERT_SCOPES:
+        base = os.path.join(root, scope)
+        paths = []
+        if os.path.isfile(base):
+            paths = [base]
+        elif os.path.isdir(base):
+            for dirpath, dirnames, filenames in os.walk(base):
+                dirnames[:] = [
+                    d for d in dirnames
+                    if d not in ("__pycache__", ".git", "_native_build")
+                ]
+                paths.extend(
+                    os.path.join(dirpath, f)
+                    for f in filenames
+                    if f.endswith((".py", ".sh"))
+                )
+        for p in sorted(paths):
+            rel = os.path.relpath(p, root)
+            if rel in _ASSERT_EXCLUDE or rel == _MANIFEST_REL:
+                continue
+            if fam["class"] and rel == fam["class"].split("::")[0]:
+                continue  # the owning module DECLARES, it does not assert
+            try:
+                with open(p, encoding="utf-8") as f:
+                    text = f.read()
+            except OSError:
+                continue
+            in_product = rel.startswith("d4pg_tpu/")
+            # tests/scripts/schema_check asserting the raw equation
+            if not in_product and all(c in text for c in counters):
+                hits.append(rel)
+            # a soak/smoke/test parsing the family's [flow-verdict] line
+            elif "flow-verdict" in text and f'"{fam_name}"' in text:
+                hits.append(rel)
+            # runtime wiring: a drain path registering with the ledger
+            elif in_product and "flowledger" in text \
+                    and f'"{fam_name}"' in text:
+                hits.append(rel)
+    return sorted(set(hits))
+
+
+def _assertion_findings(root, out: list) -> None:
+    for fam_name, fam in sorted(FLOW_IDENTITIES.items()):
+        if not _assertion_sites(root, fam_name, fam):
+            out.append(
+                Finding(
+                    _CHECK, _MANIFEST_REL, 1,
+                    f"[{fam_name}] declared identity "
+                    f"`{fam['identity']}` is asserted nowhere (no test, "
+                    "soak/smoke script, healthz surface, or schema_check "
+                    "co-locates its counters or parses its "
+                    "`[flow-verdict]` line) — an unasserted identity is "
+                    "uncommittable; wire a drain-time check or drop it "
+                    "from FLOW_IDENTITIES",
+                )
+            )
+
+
+@wholeprog_check("flowcheck")
+def flowcheck(files: dict, root=None) -> list:
+    out: list = []
+    index = build_index(files)
+    _increment_findings(files, index, out)
+    _disposition_findings(files, index, out)
+    if root is not None:
+        _assertion_findings(root, out)
+    out.sort(key=lambda f: (f.path, f.line))
+    return out
+
+
+# ---------------------------------------------------------------- artifact
+def build_flow_graph(files: dict, root=None) -> dict:
+    """The committed flow graph: per family the counters, increment sites
+    (paths + qualnames only, so line shifts don't drift the artifact),
+    dispositions, and assertion sites."""
+    index = build_index(files)
+    sites = _index_increments(files, index)
+    families: dict = {}
+    for fam_name, fam in sorted(FLOW_IDENTITIES.items()):
+        per_counter = sites.get(fam_name, {})
+        families[fam_name] = {
+            "class": fam["class"],
+            "identity": fam["identity"],
+            "counters": identity_counters(fam),
+            "gauges": sorted(fam["gauges"]),
+            "derived": sorted(fam["derived"]),
+            "multi_writer": sorted(fam["multi_writer"]),
+            "increment_sites": {
+                c: sorted({f"{r}::{cls}.{m}" for (r, cls, m, _l) in found})
+                for c, found in sorted(per_counter.items())
+            },
+            "dispositions": [d["func"] for d in fam["dispositions"]],
+            "assertion_sites": (
+                _assertion_sites(root, fam_name, fam)
+                if root is not None
+                else []
+            ),
+        }
+    return {
+        "schema": GRAPH_SCHEMA,
+        "generated_by": GENERATED_BY,
+        "families": families,
+    }
+
+
+def main(argv=None) -> int:
+    """CLI: print the flow graph, or ``--write`` the committed artifact."""
+    import argparse
+    import os
+
+    p = argparse.ArgumentParser(
+        prog="python -m tools.d4pglint.wholeprog.flowcheck"
+    )
+    p.add_argument("--write", action="store_true",
+                   help="write benchmarks/flow_identities.json")
+    args = p.parse_args(argv)
+    from tools.d4pglint.core import parse_default_files, repo_root
+
+    root = repo_root()
+    files = parse_default_files(root)
+    graph = build_flow_graph(files, root)
+    doc = json.dumps(graph, indent=1, sort_keys=True) + "\n"
+    if args.write:
+        path = os.path.join(root, "benchmarks", "flow_identities.json")
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(doc)
+        print(f"wrote {path}: {len(graph['families'])} families")
+    else:
+        print(doc, end="")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

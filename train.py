@@ -588,9 +588,12 @@ def main(argv=None) -> None:
         # its locks (named_lock/named_condition wrap only when enabled);
         # Trainer.close checks the recorded nesting against the committed
         # benchmarks/lock_order_graph.json.
-        from d4pg_tpu.analysis import lockwitness
+        from d4pg_tpu.analysis import flowledger, lockwitness
 
         lockwitness.enable()
+        # The conservation ledger rides the same flag: drain/close paths
+        # (fleet ingest, mirror tap) check their accounting identities.
+        flowledger.enable()
     if args.distributed or args.coordinator or (args.num_processes or 0) > 1:
         # Before config_from_args/Trainer import anything that touches
         # devices: the backend binds to the local slice at first use.
