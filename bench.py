@@ -1131,17 +1131,11 @@ def kill_policy_server_abruptly(server) -> None:
     drain, nothing answered. Used by the router availability bench and the
     in-process router fault tests; the REAL ``kill -9`` path runs through
     subprocess replicas in scripts/router_smoke.sh and chaos_soak.sh."""
-    from d4pg_tpu.serve import protocol as _sp
-
     server._shutdown.set()
-    try:
-        server._listen_sock.close()
-    except OSError:
-        pass
-    with server._conns_lock:
-        conns = list(server._conns)
-    for c in conns:
-        _sp.abortive_close(c)
+    server._loop.stop_accepting()
+    for c in server._loop.connections():
+        c.abort()  # RST, queued replies dropped — wire-identical to kill -9
+    server._loop.close(flush_timeout_s=0.5)
     server.batcher.stop(drain=False, timeout=5)
 
 

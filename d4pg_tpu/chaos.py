@@ -76,6 +76,15 @@ Spec syntax (entries separated by ``;`` or ``,``)::
                           inside its 1st evaluation (the rollout must
                           roll back at the observe deadline, never
                           promote on a missing verdict)
+    slowloris@2:4         serve/router: at their 2nd accept, launch a
+                          slowloris client trickling a frame header at
+                          4 bytes/s (the read-progress deadline evicts)
+    zero_window@3:1500    serve/router: at their 3rd accept, launch a
+                          client that floods HEALTHZ and never reads for
+                          1500 ms (write-progress deadline evicts)
+    fd_exhaust@5:150      serve/router: at their 5th accept, hoard fds
+                          to EMFILE for 150 ms (accepts shed OVERLOADED
+                          fd_exhausted; the accept loop survives)
 
 A ``:<arg>`` that does not parse as a number is kept as a string LABEL
 (``tenant_flood``'s tenant name); numeric args stay floats.
@@ -190,6 +199,28 @@ site                  tick location               recovery proven
                                                   normally — the stalled
                                                   worker's late verdict
                                                   is token-fenced out
+``slowloris``         serve/router, per accept    attacker trickles a
+                                                  frame header at :arg
+                                                  bytes/s; read-progress
+                                                  deadline evicts it
+                                                  (evicted_read_stall);
+                                                  no frame completes, so
+                                                  the answered identity
+                                                  is untouched
+``zero_window``       serve/router, per accept    attacker floods HEALTHZ
+                                                  and never reads for
+                                                  :arg ms; write-progress
+                                                  deadline / buffered-
+                                                  bytes watermark evicts
+                                                  (evicted_write_stall) —
+                                                  no head-of-line block
+``fd_exhaust``        serve/router, per accept    fds hoarded to EMFILE
+                                                  for :arg ms; accepts
+                                                  shed OVERLOADED
+                                                  fd_exhausted through
+                                                  the reserve fd
+                                                  (accept_shed) — the
+                                                  accept loop survives
 ====================  ==========================  =========================
 """
 
@@ -272,6 +303,20 @@ KNOWN_SITES = WORKER_SITES + (
     # verdict or wedge the control loop.
     "mirror_drop",
     "gate_stall",
+    # connection-attack sites (ISSUE 20, d4pg_tpu/netio/attack.py): all
+    # three tick in the serve/router front-ends at every ACCEPT and
+    # launch a self-targeted attacker driven by the victim's own event
+    # loop — slowloris trickles a frame header at ``:<arg>`` bytes/sec
+    # (read-progress deadline must evict; no frame ever completes, so
+    # the answered identity is untouched by construction), zero_window
+    # pipelines HEALTHZ and never reads for ``:<arg>`` ms (write-progress
+    # deadline/watermark must evict; HEALTHZ is outside the identity),
+    # fd_exhaust hoards descriptors to EMFILE for ``:<arg>`` ms (accepts
+    # must shed ``OVERLOADED fd_exhausted`` via the reserve fd, never
+    # kill the accept loop).
+    "slowloris",
+    "zero_window",
+    "fd_exhaust",
 )
 
 # Sites whose ``:<arg>`` is a string label, not a number (the flood's

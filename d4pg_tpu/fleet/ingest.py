@@ -55,6 +55,7 @@ from typing import Optional
 
 import numpy as np
 
+from d4pg_tpu import netio
 from d4pg_tpu.analysis.ledger import NULL_LEDGER
 from d4pg_tpu.fleet import wire
 from d4pg_tpu.replay import source
@@ -337,6 +338,13 @@ class IngestServer:
                     pass
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # Bounded SEND for acks (netio.configure_reply_timeout — the
+            # ONE place the SO_SNDTIMEO close-on-timeout guard lives for
+            # thread-path endpoints; the serve/router front-ends moved
+            # onto the event loop's write-progress deadline instead): an
+            # actor that stops reading must not wedge this reader
+            # thread's ack writes forever.
+            netio.configure_reply_timeout(conn)
             # Deadline-bounded reads: a peer that stops sending (half-open
             # TCP after an actor-host power loss) is detected here instead
             # of pinning this reader thread forever. Live actors stream

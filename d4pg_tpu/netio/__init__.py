@@ -1,0 +1,47 @@
+"""One event-loop I/O core for every frame-speaking front-end.
+
+``d4pg_tpu.netio`` is the C10k seam (ROADMAP item 4): a single
+``selectors``-based loop thread owns every accepted connection — reads,
+frame reassembly hand-off, buffered writes, per-connection progress
+deadlines, and bounded accept — so a front-end holds tens of thousands
+of mostly-idle connections with O(1) threads instead of one thread per
+connection. The serve and router front-ends run on it (PR 20); the
+fleet ingest keeps its thread path for now and adopts this seam next.
+
+Division of labor (the PROTOCOL_WIRE_MODULES rule): this package moves
+bytes and enforces *liveness* — it never parses or builds a frame
+header. Framing lives in ``d4pg_tpu.serve.protocol``
+(:class:`~d4pg_tpu.serve.protocol.FrameAssembler` on the read side,
+:func:`~d4pg_tpu.serve.protocol.encode_frame` on the write side), so the
+loop path is byte-identical to the blocking ``read_frame``/
+``write_frame`` path by construction.
+
+Robustness contract (docs/serving.md "Event-loop I/O core"):
+
+- **read-progress deadline** — once a partial frame exists, the peer has
+  ``read_stall_s`` to complete it; trickling bytes does not reset the
+  clock (a slowloris drip never does), completing a frame does. Expiry
+  evicts the connection.
+- **write-progress deadline** — while reply bytes are buffered, the peer
+  must drain *something* every ``write_stall_s`` (the SO_SNDTIMEO
+  close-on-timeout contract, now loop-owned: one zero-window client
+  stalls only itself, never a reply thread). A per-connection buffered-
+  bytes watermark (``write_buffer_limit``) bounds what a never-reading
+  peer can make the server hold.
+- **bounded accept** — EMFILE/ENFILE mid-accept sheds the connection
+  admission-controlled (a reserve fd is burned to accept + answer
+  ``OVERLOADED fd_exhausted`` + close) instead of killing the accept
+  loop; if even the reserve cannot reopen, accepting pauses briefly
+  rather than spinning.
+
+This package is JAX-free and numpy-free (host-only, stdlib + protocol):
+thin front-ends must import it without paying the JAX import.
+"""
+
+from d4pg_tpu.netio.loop import (
+    Connection,
+    FrameLoop,
+    configure_reply_timeout,
+)
+
+__all__ = ["Connection", "FrameLoop", "configure_reply_timeout"]

@@ -70,11 +70,22 @@ def build_parser() -> argparse.ArgumentParser:
                    help="on-disk spool of mirrored frames (what the "
                         "router's off-policy promotion gate reads); "
                         "independent of --mirror-ingest liveness")
+    p.add_argument("--io-read-stall-s", type=float, default=30.0,
+                   help="event loop: evict a connection whose partial "
+                        "frame makes no completion progress for this long "
+                        "(the slowloris bound)")
+    p.add_argument("--io-write-stall-s", type=float, default=10.0,
+                   help="event loop: evict a connection that drains none "
+                        "of its buffered replies for this long (the "
+                        "zero-window bound)")
     p.add_argument("--chaos", default=None, metavar="PLAN",
                    help="deterministic fault injection (d4pg_tpu/chaos.py): "
                         "e.g. 'sock_reset@5' force-resets the serving "
                         "connection at its 5th frame — proves reader/reply "
-                        "paths survive abrupt client death")
+                        "paths survive abrupt client death; "
+                        "'slowloris@N:bps' / 'zero_window@N:ms' / "
+                        "'fd_exhaust@N:ms' launch connection-level attacks "
+                        "at the Nth accept (netio deadlines must evict)")
     p.add_argument("--debug-guards", action="store_true",
                    help="runtime invariant guards (d4pg_tpu/analysis): "
                         "staging ledger on the batcher's slot rotation, "
@@ -154,6 +165,8 @@ def main(argv=None) -> None:
         chaos=chaos,
         replica_id=args.replica_id,
         mirror_tap=tap,
+        io_read_stall_s=args.io_read_stall_s,
+        io_write_stall_s=args.io_write_stall_s,
     )
 
     install_graceful_signals(

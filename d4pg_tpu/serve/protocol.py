@@ -263,18 +263,98 @@ def read_frame(stream) -> Optional[Tuple[int, int, bytes]]:
     return msg_type, req_id, payload
 
 
-def write_frame(sock, msg_type: int, req_id: int, payload: bytes = b"") -> None:
+class FrameAssembler:
+    """Incremental decoder for the non-blocking I/O core (``d4pg_tpu/
+    netio``): ``feed()`` whatever bytes arrived, then drain complete
+    frames with ``next_frame()``. Header validation (magic, version,
+    MAX_PAYLOAD) happens the moment 16 header bytes exist — a declared-
+    oversize frame is rejected before one payload byte is buffered, same
+    as ``read_frame``.
+
+    Parity contract (pinned by tests/test_netio.py): for any byte
+    sequence, the frames and the ``ProtocolError`` messages produced here
+    are EXACTLY those of ``read_frame`` over a blocking socket — including
+    the EOF cases, which the owner reports by calling :meth:`check_eof`
+    when the peer closes. Framing lives here, in the protocol module,
+    so the wire-format single-point-of-truth rule (PROTOCOL_WIRE_MODULES)
+    holds: netio moves bytes, it never parses headers."""
+
+    __slots__ = ("_buf", "_head")
+
+    def __init__(self) -> None:
+        self._buf = bytearray()
+        self._head: Optional[Tuple[int, int, int]] = None
+
+    def feed(self, data: bytes) -> None:
+        self._buf += data
+
+    @property
+    def mid_frame(self) -> bool:
+        """True while a partial frame is pending — the loop's read-progress
+        deadline (the slowloris eviction) arms on exactly this state."""
+        return self._head is not None or bool(self._buf)
+
+    def next_frame(self) -> Optional[Tuple[int, int, bytes]]:
+        """One ``(msg_type, req_id, payload)`` if a complete frame is
+        buffered, else None. Raises :class:`ProtocolError` with
+        ``read_frame``'s exact wording on a malformed header."""
+        if self._head is None:
+            if len(self._buf) < HEADER.size:
+                return None
+            magic, version, msg_type, req_id, length = HEADER.unpack_from(
+                self._buf
+            )
+            if magic != MAGIC:
+                raise ProtocolError(f"bad magic {magic!r}")
+            if version not in SUPPORTED_VERSIONS:
+                raise ProtocolError(
+                    f"protocol version {version} (this server speaks "
+                    f"{PROTOCOL_VERSION})"
+                )
+            if length > MAX_PAYLOAD:
+                raise ProtocolError(
+                    f"payload length {length} > max {MAX_PAYLOAD}"
+                )
+            del self._buf[:HEADER.size]
+            self._head = (msg_type, req_id, length)
+        msg_type, req_id, length = self._head
+        if len(self._buf) < length:
+            return None
+        payload = bytes(self._buf[:length])
+        del self._buf[:length]
+        self._head = None
+        return msg_type, req_id, payload
+
+    def check_eof(self) -> None:
+        """Peer closed: raise exactly what ``read_frame`` would have — a
+        clean frame boundary returns silently, a torn frame raises with
+        the blocking path's wording (``recv_exact``'s k/n counts)."""
+        if self._head is not None:
+            _msg_type, _req_id, length = self._head
+            if not self._buf:
+                raise ProtocolError("EOF before payload")
+            raise ProtocolError(
+                f"EOF mid-frame ({len(self._buf)}/{length} bytes)"
+            )
+        if self._buf:
+            raise ProtocolError(
+                f"EOF mid-frame ({len(self._buf)}/{HEADER.size} bytes)"
+            )
+
+
+def encode_frame(msg_type: int, req_id: int, payload: bytes = b"") -> bytes:
+    """THE frame bytes: header + payload as one object. ``write_frame``
+    sends exactly this and the event-loop write path (``d4pg_tpu/netio``)
+    enqueues exactly this, so thread and loop servers are byte-identical
+    on the wire by construction, not by parallel maintenance.
+
+    The version byte is the TYPE's floor (v1 unless the type needs v2):
+    replies to an old client are byte-identical to PR-8's, and only a
+    frame that actually uses v2 features can trip an old peer's version
+    check."""
     if len(payload) > MAX_PAYLOAD:
         raise ProtocolError(f"payload length {len(payload)} > max {MAX_PAYLOAD}")
-    # ONE sendall per frame: header+payload concatenated so a concurrent
-    # writer on the same socket (replies come from batcher callbacks, the
-    # healthz reply from the reader thread) can never interleave a frame —
-    # callers still hold a per-connection send lock for ordering.
-    # The version byte is the TYPE's floor (v1 unless the type needs v2):
-    # replies to an old client are byte-identical to PR-8's, and only a
-    # frame that actually uses v2 features can trip an old peer's
-    # version check.
-    sock.sendall(
+    return (
         HEADER.pack(
             MAGIC,
             _FRAME_MIN_VERSION.get(msg_type, 1),
@@ -284,6 +364,16 @@ def write_frame(sock, msg_type: int, req_id: int, payload: bytes = b"") -> None:
         )
         + payload
     )
+
+
+def write_frame(sock, msg_type: int, req_id: int, payload: bytes = b"") -> None:
+    if len(payload) > MAX_PAYLOAD:
+        raise ProtocolError(f"payload length {len(payload)} > max {MAX_PAYLOAD}")
+    # ONE sendall per frame: header+payload concatenated so a concurrent
+    # writer on the same socket (replies come from batcher callbacks, the
+    # healthz reply from the reader thread) can never interleave a frame —
+    # callers still hold a per-connection send lock for ordering.
+    sock.sendall(encode_frame(msg_type, req_id, payload))
 
 
 def write_truncated_frame(

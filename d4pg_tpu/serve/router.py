@@ -90,14 +90,12 @@ ejection/re-admission state machine, and the canary decision table.
 
 from __future__ import annotations
 
-import errno
 import json
 import os
 import random
 import shutil
 import signal
 import socket
-import struct
 import tempfile
 import threading
 import time
@@ -106,6 +104,8 @@ from typing import Optional
 
 import numpy as np
 
+from d4pg_tpu import netio
+from d4pg_tpu.netio import attack as netio_attack
 from d4pg_tpu.serve import protocol
 from d4pg_tpu.serve.client import ConnectionClosed, Overloaded, PolicyClient
 from d4pg_tpu.serve.protocol import ProtocolError
@@ -413,16 +413,14 @@ class Router:
     # written under _lock (prober) after the first successful probe and
     # only ever goes None -> int; _obs_dims entries likewise.
     _THREAD_SAFE = ()
-    # d4pglint thread-lifecycle: per-connection reader threads are not
-    # joined — drain() closes every socket in _conns, which unblocks the
-    # blocking read_frame immediately (same contract as PolicyServer).
-    # router-gate workers are bounded by the gate evaluation itself
-    # (spool read + one NumPy policy forward); a wedged one (gate_stall
-    # chaos, hung filesystem) is exactly the fault the observe-deadline
-    # rollback covers, and its late verdict is token-fenced out —
-    # joining would hand the control thread the very stall the design
-    # isolates it from.
-    _DETACHED_THREADS = ("router-conn", "router-gate")
+    # d4pglint thread-lifecycle: router-gate workers are bounded by the
+    # gate evaluation itself (spool read + one NumPy policy forward); a
+    # wedged one (gate_stall chaos, hung filesystem) is exactly the
+    # fault the observe-deadline rollback covers, and its late verdict
+    # is token-fenced out — joining would hand the control thread the
+    # very stall the design isolates it from. (Client connections live
+    # on the netio event loop — no per-connection threads to account.)
+    _DETACHED_THREADS = ("router-gate",)
 
     def __init__(
         self,
@@ -461,6 +459,9 @@ class Router:
         replica_capacity: int = 0,
         bulk_fraction: float = 0.5,
         flood_burst: int = 200,
+        io_read_stall_s: float = netio.loop.DEFAULT_READ_STALL_S,
+        io_write_stall_s: float = netio.loop.DEFAULT_WRITE_STALL_S,
+        io_write_buffer_limit: int = netio.loop.DEFAULT_WRITE_BUFFER_LIMIT,
     ):
         if not backends:
             raise ValueError("router needs at least one backend replica")
@@ -596,11 +597,17 @@ class Router:
         self._metrics = None
 
         self._listen_sock: Optional[socket.socket] = None
-        self._accept_thread: Optional[threading.Thread] = None
+        # ONE event-loop thread owns every client connection (reads,
+        # frame reassembly, buffered writes, progress deadlines, bounded
+        # accept) — the C10k front: thread count is O(1) in connections.
+        self._loop = netio.FrameLoop(
+            name="router-io",
+            read_stall_s=io_read_stall_s,
+            write_stall_s=io_write_stall_s,
+            write_buffer_limit=io_write_buffer_limit,
+        )
         self._control_thread: Optional[threading.Thread] = None
         self._metrics_thread: Optional[threading.Thread] = None
-        self._conns: set = set()
-        self._conns_lock = lockwitness.named_lock("Router._conns_lock")
         self._shutdown = threading.Event()
         self._started = False
 
@@ -613,10 +620,14 @@ class Router:
             (self.host, self._requested_port)
         )
         self.port = self._listen_sock.getsockname()[1]
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, name="router-accept", daemon=True
+        self._loop.serve(
+            self._listen_sock,
+            on_frame=self._serve_conn,
+            on_open=self._on_conn_open,
+            on_close=self._on_conn_close,
+            on_protocol_error=self._on_protocol_error,
         )
-        self._accept_thread.start()
+        self._loop.start()
         self._control_thread = threading.Thread(
             target=self._control_loop, name="router-control", daemon=True
         )
@@ -724,20 +735,11 @@ class Router:
         """Graceful stop: no new connections, shed new requests with
         ``draining``, let every in-flight dispatch come back, tear down."""
         self._shutdown.set()
-        if self._listen_sock is not None:
-            try:
-                self._listen_sock.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
-            try:  # wake a stack where shutdown() on a listener is a no-op
-                with socket.create_connection((self.host, self.port), timeout=1):
-                    pass
-            except OSError:
-                pass
-            try:
-                self._listen_sock.close()
-            except OSError:
-                pass
+        # No new connections; the loop keeps running so in-flight
+        # dispatch replies (and ``draining`` sheds for frames that race
+        # the drain) still reach their clients.
+        self._loop.stop_accepting()
+        self._listen_sock = None
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             with self._lock:
@@ -745,8 +747,6 @@ class Router:
             if inflight == 0:
                 break
             time.sleep(0.05)
-        if self._accept_thread is not None:
-            self._accept_thread.join(timeout=5)
         if self._control_thread is not None:
             self._control_thread.join(timeout=self._probe_interval_s + 10)
         with self._lock:
@@ -766,17 +766,9 @@ class Router:
             self._metrics.log(self.stats.requests_total, self._metrics_row())
             self._metrics.close()
             self._metrics = None
-        with self._conns_lock:
-            conns = list(self._conns)
-        for c in conns:
-            try:
-                c.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
-            try:
-                c.close()
-            except OSError:
-                pass
+        # Flush every connection's queued replies (bounded by the write-
+        # progress deadline), close them, join the one I/O thread.
+        self._loop.close(flush_timeout_s=5.0)
         # --debug-guards: admission/terminal accounting, the promotion
         # gate's poll accounting, and every tenant row must balance now
         # that in-flight dispatches resolved and the readers are gone
@@ -1318,201 +1310,164 @@ class Router:
                 )
 
     # ------------------------------------------------------------ client side
-    def _accept_loop(self) -> None:
-        while not self._shutdown.is_set():
-            try:
-                conn, _addr = self._listen_sock.accept()
-            except OSError as e:
-                if self._shutdown.is_set():
-                    return  # listener closed: draining
-                if e.errno in (errno.EBADF, errno.EINVAL):
-                    # the listen socket died under us WITHOUT a drain:
-                    # say so loudly instead of silently never accepting
-                    # again while the fleet keeps answering probes
-                    print(f"[router] accept loop dead: {e!r}", flush=True)
-                    self._record_event("accept_error", error=repr(e))
-                    return
-                # transient (ECONNABORTED from a client RST between SYN
-                # and accept — exactly the failover/chaos traffic shape —
-                # or a brief EMFILE): keep accepting (the ingest server's
-                # accept loop learned this in PR 7)
-                time.sleep(0.05)
-                continue
-            if self._shutdown.is_set():
-                try:
-                    conn.close()  # the drain's own wake-up connection
-                except OSError:
-                    pass
-                return
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            try:
-                # Same rationale as PolicyServer: replies are written from
-                # the replica links' reader threads — one zero-window
-                # client must not head-of-line-block a replica's whole
-                # reply pump behind an unbounded sendall.
-                conn.setsockopt(
-                    socket.SOL_SOCKET, socket.SO_SNDTIMEO,
-                    struct.pack("ll", 10, 0),
-                )
-            except OSError:
-                pass
-            with self._conns_lock:
-                self._conns.add(conn)
-            threading.Thread(
-                target=self._serve_conn, args=(conn,),
-                name="router-conn", daemon=True,
-            ).start()
+    def _on_conn_open(self, conn) -> None:
+        # Connection-level chaos sites fire at accept: each launches a
+        # loop-timer-driven attacker against this router's own listener
+        # (slowloris trickle / zero-window staller / fd hoard), proving
+        # the eviction machinery on live traffic.
+        if self._chaos is not None:
+            netio_attack.tick_attacks(
+                self._chaos, self._loop, self.host, self.port
+            )
 
-    def _serve_conn(self, conn: socket.socket) -> None:
-        send_lock = lockwitness.named_lock("Router._serve_conn.send_lock")
-        rfile = conn.makefile("rb")
+    def _on_conn_close(self, conn) -> None:
+        if self._tap is not None:
+            # vanished client: drop its half-built mirror window whole
+            self._tap.on_disconnect(id(conn))
+
+    def _on_protocol_error(self, conn, exc) -> None:
+        # Framing is per-connection state: connection-fatal ERROR (req_id
+        # 0), then the loop flush-closes. Other connections are untouched.
+        self.stats.inc("protocol_errors")
+        conn.send(protocol.ERROR, 0, str(exc).encode())
+
+    def _reply(self, conn, msg_type: int, req_id: int,
+               payload: bytes = b"") -> None:
+        if not conn.send(msg_type, req_id, payload):
+            # Client gone before its reply (disconnect-mid-request) or
+            # evicted for stalling: count the computed-but-undeliverable
+            # reply, same as the thread path's OSError branch did.
+            self.stats.inc("dropped_replies")
+
+    def _serve_conn(self, conn, msg_type: int, req_id: int,
+                    payload: bytes) -> None:
+        """One complete frame, on the loop thread. Must not block — the
+        dispatch tier (``_route``) is already asynchronous (replica link
+        done-callbacks), so the only stall the thread path tolerated
+        here, the ``replica_slow`` chaos sleep, becomes a loop timer.
+        Raising :class:`ProtocolError` routes to ``_on_protocol_error``
+        (connection-fatal), like a framing error from the byte stream."""
 
         def reply(msg_type: int, req_id: int, payload: bytes = b"") -> None:
-            try:
-                with send_lock:
-                    protocol.write_frame(conn, msg_type, req_id, payload)
-            except OSError:
-                # Client gone before its reply, or wedged past the send
-                # timeout: a partial frame is unrecoverable — close (which
-                # also unblocks this connection's reader).
-                self.stats.inc("dropped_replies")
-                try:
-                    conn.close()
-                except OSError:
-                    pass
+            self._reply(conn, msg_type, req_id, payload)
 
-        try:
-            while True:
-                frame = protocol.read_frame(rfile)
-                if frame is None:
-                    return  # clean EOF
-                msg_type, req_id, payload = frame
-                if msg_type == protocol.HEALTHZ:
-                    reply(protocol.HEALTHZ_OK, req_id,
-                          json.dumps(self.healthz()).encode())
-                    continue
-                if msg_type == protocol.ACT:
-                    # v1: default policy, interactive class, anonymous
-                    # tenant — old clients negotiate down implicitly
-                    policy = protocol.DEFAULT_POLICY
-                    qos = protocol.QOS_INTERACTIVE
-                    tenant = ""
-                    obs_dim = self._obs_dim
-                    if obs_dim is None:
-                        # no replica has ever answered a probe: obs_dim
-                        # (and the fleet) is unknown — shed honestly
-                        self.stats.inc("requests_total")
-                        self.stats.inc("replies_overloaded")
-                        reply(protocol.OVERLOADED, req_id, b"no_replicas")
-                        continue
-                    obs, deadline_us = protocol.decode_act(payload, obs_dim)
-                elif msg_type == protocol.ACT2:
-                    obs, deadline_us, policy, qos, tenant = (
-                        protocol.decode_act2(payload)
-                    )
-                    known = self._obs_dims.get(policy)
-                    if known is not None and obs.shape[0] != known:
-                        self.stats.inc("requests_total")
-                        self.stats.tenant_request(tenant, qos)
-                        self.stats.inc("replies_error")
-                        self.stats.tenant_outcome(tenant, qos, 3)
-                        reply(
-                            protocol.ERROR, req_id,
-                            f"obs is {obs.shape[0]}-dim, policy "
-                            f"{policy!r} wants {known}".encode(),
-                        )
-                        continue
-                elif msg_type == protocol.FEEDBACK:
-                    # Reward echo for THIS connection's previous request —
-                    # handled LOCALLY (the router decoded the obs, so it
-                    # can pair the feedback itself; forwarding would need
-                    # replica-sticky feedback routing for no benefit).
-                    # Always acked: clients need not know whether a tap
-                    # rides this router.
-                    fb = protocol.decode_feedback(payload)
-                    self.stats.inc("feedback_frames")
-                    if (
-                        self._tap is not None
-                        and fb["policy_id"] == protocol.DEFAULT_POLICY
-                    ):
-                        self._tap.on_feedback(id(conn), fb)
-                    reply(protocol.FEEDBACK_OK, req_id)
-                    continue
-                else:
-                    raise ProtocolError(f"unexpected message type {msg_type}")
-                if (
-                    self._tap is not None
-                    and policy == protocol.DEFAULT_POLICY
-                ):
-                    # remember the obs this connection's next FEEDBACK
-                    # pairs with
-                    self._tap.on_request(id(conn), obs)
+        if msg_type == protocol.HEALTHZ:
+            reply(protocol.HEALTHZ_OK, req_id,
+                  json.dumps(self.healthz()).encode())
+            return
+        if msg_type == protocol.ACT:
+            # v1: default policy, interactive class, anonymous
+            # tenant — old clients negotiate down implicitly
+            policy = protocol.DEFAULT_POLICY
+            qos = protocol.QOS_INTERACTIVE
+            tenant = ""
+            obs_dim = self._obs_dim
+            if obs_dim is None:
+                # no replica has ever answered a probe: obs_dim
+                # (and the fleet) is unknown — shed honestly
+                self.stats.inc("requests_total")
+                self.stats.inc("replies_overloaded")
+                reply(protocol.OVERLOADED, req_id, b"no_replicas")
+                return
+            obs, deadline_us = protocol.decode_act(payload, obs_dim)
+        elif msg_type == protocol.ACT2:
+            obs, deadline_us, policy, qos, tenant = (
+                protocol.decode_act2(payload)
+            )
+            known = self._obs_dims.get(policy)
+            if known is not None and obs.shape[0] != known:
                 self.stats.inc("requests_total")
                 self.stats.tenant_request(tenant, qos)
-                if self._shutdown.is_set():
-                    self.stats.inc("replies_overloaded")
-                    self.stats.tenant_outcome(tenant, qos, 2)
-                    reply(protocol.OVERLOADED, req_id, b"draining")
-                    continue
-                if self._chaos is not None:
-                    e = self._chaos.tick("replica_slow")
-                    if e is not None:
-                        # stall THIS request's dispatch (a slow replica as
-                        # seen by one request): p99 must account it, other
-                        # connections must not feel it
-                        time.sleep(
-                            (e.arg if e.arg is not None else 100.0) / 1e3
-                        )
-                    e = self._chaos.tick("tenant_flood")
-                    if e is not None:
-                        # synthetic bulk flood from the named tenant: real
-                        # load through the real admission + dispatch path
-                        # (counted in every identity surface) — proves
-                        # interactive isolation under a misbehaving tenant
-                        self._inject_flood(
-                            e.label or "flood_tenant", self._flood_burst
-                        )
-                    e = self._chaos.tick("policy_skew")
-                    if e is not None:
-                        # 95% of a synthetic burst hits the default
-                        # policy; the cold policies' requests ride along
-                        # and must still meet their deadlines
-                        self._inject_skew(self._flood_burst)
-                # admission: quota first, then the class-aware capacity
-                # check — sheds here never reach a replica
-                shed = self._admit_tenant(tenant, qos)
-                if shed is not None:
-                    self.stats.inc("replies_overloaded")
-                    self.stats.tenant_outcome(tenant, qos, 2)
-                    reply(protocol.OVERLOADED, req_id, shed)
-                    continue
-                self._route(obs, deadline_us, req_id, reply,
-                            policy=policy, qos=qos, tenant=tenant)
-        except ProtocolError as e:
-            self.stats.inc("protocol_errors")
-            try:
-                with send_lock:
-                    protocol.write_frame(
-                        conn, protocol.ERROR, 0, str(e).encode()
-                    )
-            except OSError:
-                pass
-        except OSError:
-            pass  # peer reset / socket closed by drain
-        finally:
-            with self._conns_lock:
-                self._conns.discard(conn)
-            if self._tap is not None:
-                # vanished client: drop its half-built mirror window whole
-                self._tap.on_disconnect(id(conn))
-            try:
-                rfile.close()
-            except OSError:
-                pass
-            try:
-                conn.close()
-            except OSError:
-                pass
+                self.stats.inc("replies_error")
+                self.stats.tenant_outcome(tenant, qos, 3)
+                reply(
+                    protocol.ERROR, req_id,
+                    f"obs is {obs.shape[0]}-dim, policy "
+                    f"{policy!r} wants {known}".encode(),
+                )
+                return
+        elif msg_type == protocol.FEEDBACK:
+            # Reward echo for THIS connection's previous request —
+            # handled LOCALLY (the router decoded the obs, so it
+            # can pair the feedback itself; forwarding would need
+            # replica-sticky feedback routing for no benefit).
+            # Always acked: clients need not know whether a tap
+            # rides this router.
+            fb = protocol.decode_feedback(payload)
+            self.stats.inc("feedback_frames")
+            if (
+                self._tap is not None
+                and fb["policy_id"] == protocol.DEFAULT_POLICY
+            ):
+                self._tap.on_feedback(id(conn), fb)
+            reply(protocol.FEEDBACK_OK, req_id)
+            return
+        else:
+            raise ProtocolError(f"unexpected message type {msg_type}")
+        if (
+            self._tap is not None
+            and policy == protocol.DEFAULT_POLICY
+        ):
+            # remember the obs this connection's next FEEDBACK
+            # pairs with
+            self._tap.on_request(id(conn), obs)
+        self.stats.inc("requests_total")
+        self.stats.tenant_request(tenant, qos)
+        if self._shutdown.is_set():
+            self.stats.inc("replies_overloaded")
+            self.stats.tenant_outcome(tenant, qos, 2)
+            reply(protocol.OVERLOADED, req_id, b"draining")
+            return
+        if self._chaos is not None:
+            e = self._chaos.tick("replica_slow")
+            if e is not None:
+                # stall THIS request's dispatch (a slow replica as seen
+                # by one request): p99 must account it, other connections
+                # must not feel it — so the stall is a loop TIMER, never
+                # a sleep on the loop thread
+                self._loop.call_later(
+                    (e.arg if e.arg is not None else 100.0) / 1e3,
+                    self._admit_and_route,
+                    conn, req_id, obs, deadline_us, policy, qos, tenant,
+                )
+                return
+            e = self._chaos.tick("tenant_flood")
+            if e is not None:
+                # synthetic bulk flood from the named tenant: real
+                # load through the real admission + dispatch path
+                # (counted in every identity surface) — proves
+                # interactive isolation under a misbehaving tenant
+                self._inject_flood(
+                    e.label or "flood_tenant", self._flood_burst
+                )
+            e = self._chaos.tick("policy_skew")
+            if e is not None:
+                # 95% of a synthetic burst hits the default
+                # policy; the cold policies' requests ride along
+                # and must still meet their deadlines
+                self._inject_skew(self._flood_burst)
+        self._admit_and_route(
+            conn, req_id, obs, deadline_us, policy, qos, tenant
+        )
+
+    def _admit_and_route(self, conn, req_id, obs, deadline_us, policy,
+                         qos, tenant) -> None:
+        """Admission (quota first, then the class-aware capacity check —
+        sheds here never reach a replica) and dispatch for one already-
+        counted request. Split out of ``_serve_conn`` so a
+        ``replica_slow`` stall can defer it on a loop timer."""
+
+        def reply(msg_type: int, req_id: int, payload: bytes = b"") -> None:
+            self._reply(conn, msg_type, req_id, payload)
+
+        shed = self._admit_tenant(tenant, qos)
+        if shed is not None:
+            self.stats.inc("replies_overloaded")
+            self.stats.tenant_outcome(tenant, qos, 2)
+            reply(protocol.OVERLOADED, req_id, shed)
+            return
+        self._route(obs, deadline_us, req_id, reply,
+                    policy=policy, qos=qos, tenant=tenant)
 
     # ------------------------------------------------------- canary rollout
     def _canary_step(self) -> None:
@@ -2137,6 +2092,10 @@ class Router:
             snap["events_tail"] = list(self._events)[-20:]
         if self._chaos is not None:
             snap["chaos_injections"] = self._chaos.injections_total
+        # Event-loop I/O core counters (docs/serving.md): connection
+        # census plus the attack-eviction/shed books — slowloris and
+        # zero-window evictions, EMFILE accept sheds.
+        snap["netio"] = self._loop.stats()
         return snap
 
     def _metrics_row(self) -> dict:
@@ -2341,11 +2300,23 @@ def build_parser():
     p.add_argument("--log-dir", default=None,
                    help="append router metrics rows (metrics.jsonl) here")
     p.add_argument("--metrics-interval", type=float, default=30.0)
+    p.add_argument("--io-read-stall-s", type=float,
+                   default=netio.loop.DEFAULT_READ_STALL_S,
+                   help="event loop: evict a connection whose partial "
+                        "frame makes no completion progress for this long "
+                        "(the slowloris bound)")
+    p.add_argument("--io-write-stall-s", type=float,
+                   default=netio.loop.DEFAULT_WRITE_STALL_S,
+                   help="event loop: evict a connection that drains none "
+                        "of its buffered replies for this long (the "
+                        "zero-window bound)")
     p.add_argument("--chaos", default=None, metavar="PLAN",
                    help="deterministic fault injection (d4pg_tpu/chaos.py): "
                         "replica_kill@N / replica_slow@N:ms / "
                         "canary_corrupt@N / tenant_flood@N:tenant / "
-                        "policy_skew@N / mirror_drop@N / gate_stall@N:s "
+                        "policy_skew@N / mirror_drop@N / gate_stall@N:s / "
+                        "slowloris@N:bps / zero_window@N:ms / "
+                        "fd_exhaust@N:ms "
                         "(scaledown_during_canary@N ticks in the "
                         "autoscaler)")
     p.add_argument("--debug-guards", action="store_true",
@@ -2524,6 +2495,8 @@ def main(argv=None) -> None:
         log_dir=args.log_dir,
         metrics_interval_s=args.metrics_interval,
         chaos=chaos,
+        io_read_stall_s=args.io_read_stall_s,
+        io_write_stall_s=args.io_write_stall_s,
     )
     install_graceful_signals(
         router.request_shutdown,

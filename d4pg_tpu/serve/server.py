@@ -1,13 +1,15 @@
-"""Socket front-end: accept loop, per-connection readers, hot reload, SLOs.
+"""Socket front-end: event-loop I/O, hot reload, SLOs.
 
-Pure stdlib (``socket`` + ``threading``) — serving must not drag in an RPC
-framework the container doesn't have. The threading shape mirrors the
-trainer's: one accept thread, one reader thread per connection, the
-batcher's single device thread, a reload watcher, and a metrics ticker.
-Replies are written by whichever thread completes the future (the device
-thread via ``add_done_callback``), serialized per connection by a send
-lock; the ``req_id`` echo makes pipelining safe, so a connection can have
-many requests in flight and replies may arrive out of order.
+Pure stdlib — serving must not drag in an RPC framework the container
+doesn't have. All connection I/O (accept, reads, frame reassembly,
+buffered writes, progress deadlines) lives on ONE ``d4pg_tpu.netio``
+event-loop thread, so thread count is O(1) in connections: the loop,
+the batcher's single device thread per policy, a reload watcher, and a
+metrics ticker. Replies are queued by whichever thread completes the
+future (the device thread via ``add_done_callback``) through the
+thread-safe ``Connection.send`` and flushed by the loop; the ``req_id``
+echo makes pipelining safe, so a connection can have many requests in
+flight and replies may arrive out of order.
 
 Checkpoint hot-reload: a watcher polls two sources —
 
@@ -34,12 +36,13 @@ from __future__ import annotations
 import json
 import os
 import socket
-import struct
 import threading
 from typing import Optional
 
 import numpy as np
 
+from d4pg_tpu import netio
+from d4pg_tpu.netio import attack as netio_attack
 from d4pg_tpu.serve import protocol
 from d4pg_tpu.serve.batcher import DynamicBatcher, ShedError
 from d4pg_tpu.serve.bundle import PolicyBundle, bundle_mtime, load_bundle
@@ -142,12 +145,6 @@ class PolicyServer:
     _THREAD_SAFE = (
         "bundle", "_best_mtime",
     )
-    # d4pglint thread-lifecycle: per-connection reader threads are not
-    # joined — drain() closes every socket in _conns, which unblocks the
-    # blocking read_frame immediately, and daemon=True bounds interpreter
-    # exit. Joining N client threads would serialize the drain on the
-    # slowest client.
-    _DETACHED_THREADS = ("serve-conn",)
 
     def __init__(
         self,
@@ -169,6 +166,9 @@ class PolicyServer:
         replica_id: Optional[int] = None,
         policies: Optional[dict] = None,
         mirror_tap=None,
+        io_read_stall_s: float = netio.loop.DEFAULT_READ_STALL_S,
+        io_write_stall_s: float = netio.loop.DEFAULT_WRITE_STALL_S,
+        io_write_buffer_limit: int = netio.loop.DEFAULT_WRITE_BUFFER_LIMIT,
     ):
         self.bundle = bundle
         # Fleet attribution (--replica-id): stamped into healthz and every
@@ -249,11 +249,17 @@ class PolicyServer:
         self._metrics = None
 
         self._listen_sock: Optional[socket.socket] = None
-        self._accept_thread: Optional[threading.Thread] = None
+        # ONE event-loop thread owns every connection (reads, frame
+        # reassembly, buffered writes, progress deadlines, bounded
+        # accept) — thread count is O(1) in connections.
+        self._loop = netio.FrameLoop(
+            name="serve-io",
+            read_stall_s=io_read_stall_s,
+            write_stall_s=io_write_stall_s,
+            write_buffer_limit=io_write_buffer_limit,
+        )
         self._watch_thread: Optional[threading.Thread] = None
         self._metrics_thread: Optional[threading.Thread] = None
-        self._conns: set[socket.socket] = set()
-        self._conns_lock = lockwitness.named_lock("PolicyServer._conns_lock")
         self._shutdown = threading.Event()
         self._started = False
 
@@ -269,10 +275,14 @@ class PolicyServer:
             (self.host, self._requested_port)
         )
         self.port = self._listen_sock.getsockname()[1]
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, name="serve-accept", daemon=True
+        self._loop.serve(
+            self._listen_sock,
+            on_frame=self._serve_conn,
+            on_open=self._on_conn_open,
+            on_close=self._on_conn_close,
+            on_protocol_error=self._on_protocol_error,
         )
-        self._accept_thread.start()
+        self._loop.start()
         if any(p._watch_bundle for p in self._policies.values()) or self._watch_run:
             self._watch_thread = threading.Thread(
                 target=self._watch_loop, name="serve-reload", daemon=True
@@ -302,29 +312,18 @@ class PolicyServer:
         """Graceful stop: no new connections, shed new requests, answer
         everything already admitted, then tear down."""
         self._shutdown.set()
-        if self._listen_sock is not None:
-            # close() alone does NOT wake a thread blocked in accept() on
-            # Linux; shutdown() does, and the self-connect below covers
-            # stacks where even that is a no-op on listening sockets.
-            try:
-                self._listen_sock.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
-            try:
-                with socket.create_connection(
-                    (self.host, self.port), timeout=1
-                ):
-                    pass
-            except OSError:
-                pass
-            try:
-                self._listen_sock.close()
-            except OSError:
-                pass
+        # Drain choreography on the loop: (1) stop accepting — the
+        # listener closes on the loop thread, no new connections; (2)
+        # drain the batchers — everything already admitted is answered
+        # (replies flow through the still-running loop) while new
+        # submissions shed ``draining``; (3) close the loop — flush every
+        # connection's queued replies (bounded by the write-progress
+        # deadline) and join the one I/O thread.
+        self._loop.stop_accepting()
         for p in self._policies.values():
             p.batcher.stop(drain=True, timeout=timeout)
-        if self._accept_thread is not None:
-            self._accept_thread.join(timeout=5)
+        self._loop.close(flush_timeout_s=5.0)
+        self._listen_sock = None
         if self._watch_thread is not None:
             self._watch_thread.join(timeout=self._poll_interval_s + 5)
         if self._metrics_thread is not None:
@@ -333,18 +332,6 @@ class PolicyServer:
             self._metrics.log(self.stats.batches_total, self._metrics_row())
             self._metrics.close()
             self._metrics = None
-        # Reader threads block in recv; closing the sockets unblocks them.
-        with self._conns_lock:
-            conns = list(self._conns)
-        for c in conns:
-            try:
-                c.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
-            try:
-                c.close()
-            except OSError:
-                pass
         if self.sentinel is not None:
             # Budget: one compiled program per bucket for the whole run —
             # hot reloads and traffic shape must never have retraced. Last
@@ -496,212 +483,169 @@ class PolicyServer:
             )
 
     # ------------------------------------------------------------ connections
-    def _accept_loop(self) -> None:
-        while not self._shutdown.is_set():
-            try:
-                conn, _addr = self._listen_sock.accept()
-            except OSError:
-                return  # listen socket closed: draining
-            if self._shutdown.is_set():
-                try:
-                    conn.close()  # the drain's own wake-up connection
-                except OSError:
-                    pass
+    def _on_conn_open(self, conn: netio.Connection) -> None:
+        # Connection-level chaos sites fire at accept: each launches a
+        # loop-timer-driven attacker against this server's own listener
+        # (slowloris trickle / zero-window staller / fd hoard), proving
+        # the eviction machinery on live traffic.
+        if self._chaos is not None:
+            netio_attack.tick_attacks(
+                self._chaos, self._loop, self.host, self.port
+            )
+
+    def _on_conn_close(self, conn: netio.Connection) -> None:
+        if self._tap is not None:
+            # Episode boundary is the CONNECTION: a vanished client's
+            # half-built window is dropped whole, never flushed as if
+            # the episode ended cleanly.
+            self._tap.on_disconnect(id(conn))
+
+    def _on_protocol_error(self, conn: netio.Connection, exc) -> None:
+        # Framing is per-connection state: after a malformed frame the
+        # stream is unrecoverable, so this is a connection-fatal ERROR
+        # (req_id 0) — the loop flush-closes the connection after this
+        # returns. Pipelined siblings on OTHER connections are untouched.
+        self.stats.inc("protocol_errors")
+        conn.send(protocol.ERROR, 0, str(exc).encode())
+
+    def _reply(
+        self, conn: netio.Connection, msg_type: int, req_id: int,
+        payload: bytes = b"",
+    ) -> None:
+        if not conn.send(msg_type, req_id, payload):
+            # Client gone before its reply (the disconnect-mid-request
+            # fault path) or evicted for stalling: the batch already
+            # computed its action; count it.
+            self.stats.inc("dropped_replies")
+
+    def _serve_conn(
+        self, conn: netio.Connection, msg_type: int, req_id: int,
+        payload: bytes,
+    ) -> None:
+        """One complete frame, on the loop thread. Must not block: the
+        only slow work — inference — is handed to the policy's batcher
+        and replied from its done-callback via the thread-safe
+        ``Connection.send``. Raising :class:`ProtocolError` routes to
+        ``_on_protocol_error`` (connection-fatal), exactly like a framing
+        error from the byte stream itself."""
+        reply = self._reply
+        if self._chaos is not None:
+            e = self._chaos.tick("sock_reset")
+            if e is not None:
+                # Abortive close (RST on real stacks): the peer — and any
+                # reply in flight — sees a reset, exactly the
+                # disconnect-mid-request fault class. The server must
+                # keep serving every other connection.
+                conn.abort()
                 return
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            try:
-                # Bounded SEND only (recv must block forever — idle
-                # connections are legal): replies for ALL connections
-                # funnel through the batcher's single reply thread, and a
-                # client that stops reading (zero TCP window) would
-                # otherwise head-of-line block every other client's
-                # replies — and wedge the drain — behind one sendall.
-                # On timeout the reply path closes this connection.
-                conn.setsockopt(
-                    socket.SOL_SOCKET, socket.SO_SNDTIMEO,
-                    struct.pack("ll", 10, 0),
+        if msg_type == protocol.HEALTHZ:
+            reply(
+                conn,
+                protocol.HEALTHZ_OK,
+                req_id,
+                json.dumps(self.healthz()).encode(),
+            )
+            return
+        if msg_type == protocol.ACT:
+            # v1 path: an old client negotiates down to the
+            # DEFAULT policy implicitly — reply bytes (version
+            # byte included, via the per-type frame floor) are
+            # identical to the PR-8 server's.
+            pol = self._default
+            obs, deadline_us = protocol.decode_act(
+                payload, pol.bundle.obs_dim
+            )
+        elif msg_type == protocol.ACT2:
+            obs, deadline_us, policy_id, _qos, _tenant = (
+                protocol.decode_act2(payload)
+            )
+            # QoS/tenant ride the frame for the ROUTER's admission
+            # tier; the replica itself routes on policy only.
+            pol = self._policies.get(policy_id)
+            if pol is None:
+                # well-formed frame, wrong policy: a per-request
+                # ERROR, not a ProtocolError — the connection
+                # (and its pipelined siblings) survives
+                self.stats.inc("unknown_policy")
+                reply(
+                    conn, protocol.ERROR, req_id,
+                    f"unknown policy {policy_id!r} (resident: "
+                    f"{sorted(self._policies)})".encode(),
                 )
-            except OSError:
-                pass  # stack without SO_SNDTIMEO: keep the old behavior
-            with self._conns_lock:
-                self._conns.add(conn)
-            threading.Thread(
-                target=self._serve_conn, args=(conn,),
-                name="serve-conn", daemon=True,
-            ).start()
-
-    def _serve_conn(self, conn: socket.socket) -> None:
-        send_lock = lockwitness.named_lock("PolicyServer._serve_conn.send_lock")
-        # Buffered read side: one kernel read drains whatever frames are
-        # pipelined instead of 2+ recv syscalls per frame (a measured large
-        # slice of per-request cost at saturation). Writes stay on the raw
-        # socket (one sendall per frame).
-        rfile = conn.makefile("rb")
-
-        def reply(msg_type: int, req_id: int, payload: bytes = b"") -> None:
-            try:
-                with send_lock:
-                    protocol.write_frame(conn, msg_type, req_id, payload)
-            except OSError:
-                # Client gone before its reply (the disconnect-mid-request
-                # fault path) or wedged past the send timeout: the batch
-                # already computed its action; count it and CLOSE this
-                # connection — a timed-out sendall may have written a
-                # partial frame, so its framing is unrecoverable, and
-                # closing also unblocks this connection's reader thread.
-                self.stats.inc("dropped_replies")
-                try:
-                    conn.close()
-                except OSError:
-                    pass
-
+                return
+            if obs.shape[0] != pol.bundle.obs_dim:
+                reply(
+                    conn, protocol.ERROR, req_id,
+                    f"obs is {obs.shape[0]}-dim, policy "
+                    f"{policy_id!r} wants {pol.bundle.obs_dim}".encode(),
+                )
+                return
+        elif msg_type == protocol.FEEDBACK:
+            # Reward echo for THIS connection's previous ACT (the
+            # flywheel's closed loop). Malformed frames are
+            # per-request ERRORs — the connection survives; the
+            # frame is ALWAYS acked so clients need not know
+            # whether a tap is attached.
+            fb = protocol.decode_feedback(payload)
+            fpol = self._policies.get(fb["policy_id"])
+            if fpol is None:
+                self.stats.inc("unknown_policy")
+                reply(
+                    conn, protocol.ERROR, req_id,
+                    f"unknown policy {fb['policy_id']!r} (resident: "
+                    f"{sorted(self._policies)})".encode(),
+                )
+                return
+            if (
+                fb["action"].shape[0] != fpol.bundle.action_dim
+                or fb["next_obs"].shape[0] != fpol.bundle.obs_dim
+            ):
+                reply(
+                    conn, protocol.ERROR, req_id,
+                    f"feedback dims ({fb['action'].shape[0]} act, "
+                    f"{fb['next_obs'].shape[0]} obs) do not match "
+                    f"policy {fb['policy_id']!r} "
+                    f"({fpol.bundle.action_dim} act, "
+                    f"{fpol.bundle.obs_dim} obs)".encode(),
+                )
+                return
+            self.stats.inc("feedback_frames")
+            if self._tap is not None and fpol is self._default:
+                self._tap.on_feedback(id(conn), fb)
+            reply(conn, protocol.FEEDBACK_OK, req_id)
+            return
+        else:
+            raise ProtocolError(f"unexpected message type {msg_type}")
+        if self._tap is not None and pol is self._default:
+            # Remember this connection's latest request obs; the
+            # client's next FEEDBACK frame completes the pair.
+            self._tap.on_request(id(conn), obs)
+        deadline_s = (
+            deadline_us / 1e6 if deadline_us else self.default_deadline_s
+        )
         try:
-            while True:
-                frame = protocol.read_frame(rfile)
-                if frame is None:
-                    return  # clean EOF
-                if self._chaos is not None:
-                    e = self._chaos.tick("sock_reset")
-                    if e is not None:
-                        # Abortive close (RST on real stacks): the peer —
-                        # and any reply in flight — sees a reset, exactly
-                        # the disconnect-mid-request fault class. The
-                        # OSError lands in the handler below; the server
-                        # must keep serving every other connection.
-                        protocol.abortive_close(conn)
-                        raise OSError("chaos: injected socket reset")
-                msg_type, req_id, payload = frame
-                if msg_type == protocol.HEALTHZ:
-                    reply(
-                        protocol.HEALTHZ_OK,
-                        req_id,
-                        json.dumps(self.healthz()).encode(),
-                    )
-                    continue
-                if msg_type == protocol.ACT:
-                    # v1 path: an old client negotiates down to the
-                    # DEFAULT policy implicitly — reply bytes (version
-                    # byte included, via the per-type frame floor) are
-                    # identical to the PR-8 server's.
-                    pol = self._default
-                    obs, deadline_us = protocol.decode_act(
-                        payload, pol.bundle.obs_dim
-                    )
-                elif msg_type == protocol.ACT2:
-                    obs, deadline_us, policy_id, _qos, _tenant = (
-                        protocol.decode_act2(payload)
-                    )
-                    # QoS/tenant ride the frame for the ROUTER's admission
-                    # tier; the replica itself routes on policy only.
-                    pol = self._policies.get(policy_id)
-                    if pol is None:
-                        # well-formed frame, wrong policy: a per-request
-                        # ERROR, not a ProtocolError — the connection
-                        # (and its pipelined siblings) survives
-                        self.stats.inc("unknown_policy")
-                        reply(
-                            protocol.ERROR, req_id,
-                            f"unknown policy {policy_id!r} (resident: "
-                            f"{sorted(self._policies)})".encode(),
-                        )
-                        continue
-                    if obs.shape[0] != pol.bundle.obs_dim:
-                        reply(
-                            protocol.ERROR, req_id,
-                            f"obs is {obs.shape[0]}-dim, policy "
-                            f"{policy_id!r} wants {pol.bundle.obs_dim}".encode(),
-                        )
-                        continue
-                elif msg_type == protocol.FEEDBACK:
-                    # Reward echo for THIS connection's previous ACT (the
-                    # flywheel's closed loop). Malformed frames are
-                    # per-request ERRORs — the connection survives; the
-                    # frame is ALWAYS acked so clients need not know
-                    # whether a tap is attached.
-                    fb = protocol.decode_feedback(payload)
-                    fpol = self._policies.get(fb["policy_id"])
-                    if fpol is None:
-                        self.stats.inc("unknown_policy")
-                        reply(
-                            protocol.ERROR, req_id,
-                            f"unknown policy {fb['policy_id']!r} (resident: "
-                            f"{sorted(self._policies)})".encode(),
-                        )
-                        continue
-                    if (
-                        fb["action"].shape[0] != fpol.bundle.action_dim
-                        or fb["next_obs"].shape[0] != fpol.bundle.obs_dim
-                    ):
-                        reply(
-                            protocol.ERROR, req_id,
-                            f"feedback dims ({fb['action'].shape[0]} act, "
-                            f"{fb['next_obs'].shape[0]} obs) do not match "
-                            f"policy {fb['policy_id']!r} "
-                            f"({fpol.bundle.action_dim} act, "
-                            f"{fpol.bundle.obs_dim} obs)".encode(),
-                        )
-                        continue
-                    self.stats.inc("feedback_frames")
-                    if self._tap is not None and fpol is self._default:
-                        self._tap.on_feedback(id(conn), fb)
-                    reply(protocol.FEEDBACK_OK, req_id)
-                    continue
-                else:
-                    raise ProtocolError(f"unexpected message type {msg_type}")
-                if self._tap is not None and pol is self._default:
-                    # Remember this connection's latest request obs; the
-                    # client's next FEEDBACK frame completes the pair.
-                    self._tap.on_request(id(conn), obs)
-                deadline_s = (
-                    deadline_us / 1e6 if deadline_us else self.default_deadline_s
+            fut = pol.batcher.submit(obs, deadline_s)
+        except ShedError as e:
+            reply(conn, protocol.OVERLOADED, req_id, e.reason.encode())
+            return
+
+        def deliver(f, conn=conn, req_id=req_id):
+            exc = f.exception()
+            if exc is None:
+                reply(
+                    conn,
+                    protocol.ACT_OK,
+                    req_id,
+                    # inside f's own done-callback: resolved by
+                    # definition, result() cannot block
+                    protocol.encode_action(f.result()),  # d4pglint: disable=thread-lifecycle  -- done-callback, future resolved
                 )
-                try:
-                    fut = pol.batcher.submit(obs, deadline_s)
-                except ShedError as e:
-                    reply(protocol.OVERLOADED, req_id, e.reason.encode())
-                    continue
+            elif isinstance(exc, ShedError):
+                reply(conn, protocol.OVERLOADED, req_id, exc.reason.encode())
+            else:
+                reply(conn, protocol.ERROR, req_id, str(exc).encode())
 
-                def deliver(f, req_id=req_id):
-                    exc = f.exception()
-                    if exc is None:
-                        reply(
-                            protocol.ACT_OK,
-                            req_id,
-                            # inside f's own done-callback: resolved by
-                            # definition, result() cannot block
-                            protocol.encode_action(f.result()),  # d4pglint: disable=thread-lifecycle  -- done-callback, future resolved
-                        )
-                    elif isinstance(exc, ShedError):
-                        reply(protocol.OVERLOADED, req_id, exc.reason.encode())
-                    else:
-                        reply(protocol.ERROR, req_id, str(exc).encode())
-
-                fut.add_done_callback(deliver)
-        except ProtocolError as e:
-            self.stats.inc("protocol_errors")
-            try:
-                with send_lock:
-                    protocol.write_frame(conn, protocol.ERROR, 0, str(e).encode())
-            except OSError:
-                pass
-        except OSError:
-            pass  # peer reset / socket closed by drain
-        finally:
-            with self._conns_lock:
-                self._conns.discard(conn)
-            if self._tap is not None:
-                # Episode boundary is the CONNECTION: a vanished client's
-                # half-built window is dropped whole, never flushed as if
-                # the episode ended cleanly.
-                self._tap.on_disconnect(id(conn))
-            try:
-                rfile.close()
-            except OSError:
-                pass
-            try:
-                conn.close()
-            except OSError:
-                pass
+        fut.add_done_callback(deliver)
 
     # ----------------------------------------------------------------- status
     def healthz(self) -> dict:
@@ -757,4 +701,8 @@ class PolicyServer:
             k: round(v, 4)
             for k, v in self.batcher.timers.summary_ms().items()
         }
+        # Event-loop I/O core counters (docs/serving.md): connection
+        # census plus the attack-eviction/shed books — slowloris and
+        # zero-window evictions, EMFILE accept sheds.
+        snap["netio"] = self._loop.stats()
         return snap

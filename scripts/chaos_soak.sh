@@ -59,6 +59,15 @@
 #      the fixed-seed served return must strictly rise across the soak,
 #      both planes' accounting identities hold exact, and the leg emits
 #      the schema-gated flywheel_soak.json artifact.
+#  11. connection-level attack (ISSUE 20): router + two replicas all on
+#      the netio event loop, under sustained real load, while the three
+#      new chaos sites attack their OWN listeners — slowloris (trickled
+#      bytes, never a frame), zero_window (pipelined floods, never
+#      reads), fd_exhaust (descriptor-table hoard mid-accept). The
+#      loops' read/write-progress deadlines must evict every attacker
+#      (healthz netio counters prove it), interactive traffic must keep
+#      answering throughout, the answered identity stays exact
+#      ([flow-verdict] at drain), and every drain exits rc 0.
 #
 # Knobs (env vars): SOAK_DIR (default mktemp), SOAK_ENV (Pendulum-v1),
 # SOAK_STEPS (grad steps per leg, default 6), SOAK_HIDDEN (16,16),
@@ -1396,6 +1405,177 @@ if pgrep -f "fleet-bundle $DIR/flywheel/lbundle" > /dev/null 2>&1 \
    || pgrep -f "d4pg_tpu.flywheel.sim_client" > /dev/null 2>&1; then
   echo "CHAOS_SOAK_FAIL: flywheel processes survived the shutdown"
   pgrep -af "$DIR/flywheel" || true
+  exit 1
+fi
+
+# ---- leg 11: connection-level attack — the event-loop I/O core under -------
+# slowloris / zero_window / fd_exhaust (ISSUE 20). Both tiers (router
+# front-end AND a replica) run their listeners on the netio loop with
+# tight eviction bounds; the chaos sites launch the attacks against each
+# process's own listener at deterministic accept counts. Contracts: every
+# attacker evicted (netio counters via healthz), real traffic answered
+# before/during/after, the answered identity exact at drain, rc 0
+# everywhere.
+cp -r "$DIR/bundle" "$DIR/l11r0"
+cp -r "$DIR/bundle" "$DIR/l11r1"
+python - "$DIR" <<'EOF'
+import json, sys, threading, time
+import numpy as np
+
+sys.path.insert(0, "scripts")
+from spawnlib import spawn
+
+d = sys.argv[1]
+
+# Replica 0 carries its own slowloris (the replica tier is on the loop
+# too); replica 1 runs clean as the control.
+reps = [
+    spawn([sys.executable, "-m", "d4pg_tpu.serve",
+           "--bundle", f"{d}/l11r{rid}", "--port", "0",
+           "--max-batch", "8", "--max-wait-us", "500",
+           "--poll-interval", "0.2", "--replica-id", str(rid),
+           "--io-read-stall-s", "2", "--io-write-stall-s", "2",
+           "--debug-guards"]
+          + (["--chaos", "seed=20;slowloris@2:50"] if rid == 0 else []),
+          f"l11-replica{rid}")
+    for rid in (0, 1)
+]
+ports = [r.wait_port(180) for r in reps]
+
+# The router takes all three attacks. zero_window floods HEALTHZ (whose
+# JSON replies are kilobytes — the backlog builds fast against a 4 KiB
+# attacker rcvbuf); fd_exhaust hoards the table for 250 ms mid-service.
+router = spawn(
+    [sys.executable, "-m", "d4pg_tpu.serve.router",
+     "--backends", ",".join(f"127.0.0.1:{p}" for p in ports),
+     "--backend-bundles", f"{d}/l11r0,{d}/l11r1",
+     "--port", "0", "--probe-interval", "0.2", "--readmit-after", "2",
+     "--io-read-stall-s", "2", "--io-write-stall-s", "2",
+     "--debug-guards",
+     "--chaos", "seed=20;slowloris@3:50;zero_window@5:8000;fd_exhaust@8:250"],
+    "l11-router",
+)
+rport = router.wait_port(120)
+for _ in range(300):
+    if any("admitted 2/2" in l for l in router.lines):
+        break
+    time.sleep(0.2)
+else:
+    raise SystemExit("CHAOS_SOAK_FAIL: l11 router never admitted both replicas")
+
+from d4pg_tpu.serve.client import PolicyClient, Overloaded
+from d4pg_tpu.serve.protocol import probe_healthz
+
+obs = np.array([0.1, -0.2, 0.05], np.float32)
+counts = {"ok": 0, "overloaded": 0, "error": 0}
+lock = threading.Lock()
+stop = threading.Event()
+
+
+def load_loop():
+    # one blocking chain: every act() resolves to exactly ONE outcome —
+    # the client-side tally is the answered identity's left side. Each
+    # reconnect (an evicted/shed client would need one) is a new accept,
+    # which is also what marches the chaos sites to their trigger counts.
+    while not stop.is_set():
+        try:
+            with PolicyClient("127.0.0.1", rport, timeout=60) as c:
+                while not stop.is_set():
+                    try:
+                        a = c.act(obs, timeout=60)
+                        assert a.shape == (1,) and abs(float(a[0])) <= 2.0, a
+                        k = "ok"
+                    except Overloaded:
+                        k = "overloaded"
+                    with lock:
+                        counts[k] += 1
+        except Exception:
+            with lock:
+                counts["error"] += 1
+            time.sleep(0.1)
+
+
+threads = [
+    threading.Thread(target=load_loop, name=f"l11-load{i}", daemon=True)
+    for i in range(4)
+]
+for t in threads:
+    t.start()
+
+
+def wait_for(cond, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if cond():
+                return
+        except OSError:
+            pass  # probe landed inside the fd_exhaust hold window
+        time.sleep(0.3)
+    raise SystemExit(f"CHAOS_SOAK_FAIL: timed out waiting for {what}")
+
+
+def netio(port):
+    return probe_healthz("127.0.0.1", port, timeout_s=5.0)["netio"]
+
+
+# every attacker must be evicted by the deadlines, not waited on forever
+wait_for(lambda: netio(rport)["evicted_read_stall"] >= 1, 120,
+         "router slowloris eviction")
+wait_for(lambda: netio(rport)["evicted_write_stall"] >= 1, 120,
+         "router zero-window eviction")
+wait_for(lambda: netio(ports[0])["evicted_read_stall"] >= 1, 120,
+         "replica 0 slowloris eviction")
+print("[chaos-soak] l11: all attackers evicted", flush=True)
+
+# service stayed interactive THROUGH the attacks and still is
+with lock:
+    ok_during = counts["ok"]
+assert ok_during > 0, counts
+with PolicyClient("127.0.0.1", rport, timeout=30) as c:
+    a = c.act(obs, timeout=30)
+    assert a.shape == (1,), a
+
+time.sleep(1)  # a little more load on the post-attack fleet
+stop.set()
+for t in threads:
+    t.join(timeout=90)
+    assert not t.is_alive(), "l11 load thread wedged"
+
+h = probe_healthz("127.0.0.1", rport, timeout_s=5.0)
+submitted = sum(counts.values())
+assert counts["ok"] > 0 and submitted > 0, counts
+
+# drains: rc 0 = guards + ledger clean; the [flow-verdict] lines are the
+# router-side answered identity (requests_total == ok+overloaded+error)
+rc = router.stop(drain_timeout_s=120)
+assert rc == 0, f"l11 router exit {rc}"
+verdicts = [json.loads(l.split("[flow-verdict]", 1)[1])
+            for l in router.lines if "[flow-verdict]" in l]
+for fam in ("router", "router-tenant"):
+    fv = [v for v in verdicts if v["family"] == fam]
+    assert fv, f"l11 router drain emitted no {fam} flow verdict"
+    assert all(v["ok"] for v in fv), fv
+for rid in (0, 1):
+    rc = reps[rid].stop(drain_timeout_s=120)
+    assert rc == 0, f"l11 replica {rid} exit {rc}"
+    rv = [json.loads(l.split("[flow-verdict]", 1)[1])
+          for l in reps[rid].lines if "[flow-verdict]" in l]
+    sv = [v for v in rv if v["family"] == "serve-stats"]
+    assert sv and all(v["ok"] for v in sv), (rid, rv)
+
+print("CHAOS_SOAK_NETIO_OK", json.dumps({
+    "submitted": submitted, **counts,
+    "router_netio": {k: h["netio"][k] for k in (
+        "conns_total", "evicted_read_stall", "evicted_write_stall",
+        "accept_shed", "accept_backoffs")},
+}))
+EOF
+
+# zero leg-11 processes survive
+if pgrep -f "d4pg_tpu.serve.*$DIR/l11r" > /dev/null 2>&1; then
+  echo "CHAOS_SOAK_FAIL: leg-11 processes survived the shutdown"
+  pgrep -af "$DIR/l11r" || true
   exit 1
 fi
 

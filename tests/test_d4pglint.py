@@ -421,6 +421,36 @@ FIXTURES = [
         """,
     ),
     (
+        "loop-blocking-call",
+        "d4pg_tpu/serve/router.py",
+        """
+        import time
+
+        class Router:
+            def _serve_conn(self, conn, msg_type, req_id, payload):
+                time.sleep(0.1)
+                conn.sock.recv(4096)
+        """,
+        """
+        class Router:
+            def _serve_conn(self, conn, msg_type, req_id, payload):
+                # conn.send is the frame-queue API (append + wake): exempt
+                conn.send(2, req_id, payload)
+                # a stall becomes a loop TIMER, never a sleep on the loop
+                self._loop.call_later(
+                    0.1, self._admit_and_route, conn, req_id
+                )
+
+            def _admit_and_route(self, conn, req_id):
+                def done(f):
+                    # nested def, not in the manifest: runs on the
+                    # replica link's reader thread, so result() is fine
+                    conn.send(2, req_id, f.result())
+
+                self._dispatch().add_done_callback(done)
+        """,
+    ),
+    (
         "lock-order",
         "d4pg_tpu/runtime/x.py",
         """

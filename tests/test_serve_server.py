@@ -370,8 +370,8 @@ def test_drain_sheds_new_answers_admitted():
         drainer.join(timeout=30)
         assert not drainer.is_alive()
         assert ok >= 1  # admitted work was answered, not dropped
-    # post-drain: accept loop exited and the batcher refuses new work
-    assert not srv._accept_thread.is_alive()
+    # post-drain: the I/O loop exited and the batcher refuses new work
+    assert not srv._loop._thread.is_alive()
     with pytest.raises((ShedError, RuntimeError)):
         srv.batcher.submit(obs)
 
@@ -456,3 +456,54 @@ def test_healthz_reports_degraded_after_failed_reload(tmp_path):
         assert h["status"] == "ok" and h["last_reload"].startswith("ok")
     finally:
         srv.drain()
+
+
+def test_raw_socket_reply_bytes_pinned(server):
+    """Byte-identity at the raw-socket level (ISSUE 20 acceptance):
+    handcrafted request bytes in — no client library — and the exact
+    reply header layout of the thread-path server out. Any drift in the
+    loop's write path (version byte, header order, framing) fails here
+    even if the symmetric client library would mask it."""
+    with socket.create_connection(("127.0.0.1", server.port), timeout=5) as c:
+        # HEALTHZ: v1, header-only request; reply is v1 HEALTHZ_OK
+        c.sendall(
+            protocol.HEADER.pack(protocol.MAGIC, 1, protocol.HEALTHZ, 77, 0)
+        )
+        hdr = protocol.recv_exact(c, protocol.HEADER.size)
+        magic, ver, typ, rid, ln = protocol.HEADER.unpack(hdr)
+        assert (magic, ver, typ, rid) == (
+            protocol.MAGIC, 1, protocol.HEALTHZ_OK, 77,
+        )
+        snap = json.loads(protocol.recv_exact(c, ln))
+        assert snap["status"] in ("ok", "degraded")
+        assert "netio" in snap  # the loop's counters ride healthz
+        # ACT: v1 request; reply header pinned, payload action_dim f32s
+        payload = protocol.encode_act(np.zeros(4, np.float32), 0)
+        c.sendall(
+            protocol.HEADER.pack(
+                protocol.MAGIC, 1, protocol.ACT, 78, len(payload)
+            )
+            + payload
+        )
+        hdr = protocol.recv_exact(c, protocol.HEADER.size)
+        magic, ver, typ, rid, ln = protocol.HEADER.unpack(hdr)
+        assert (magic, ver, typ, rid) == (
+            protocol.MAGIC, 1, protocol.ACT_OK, 78,
+        )
+        act = protocol.decode_action(protocol.recv_exact(c, ln))
+        assert act.shape == (2,)
+    # bad magic: the ENTIRE reply byte stream is pinned — one ERROR
+    # frame with read_frame's exact wording, then FIN
+    with socket.create_connection(("127.0.0.1", server.port), timeout=5) as c:
+        c.sendall(b"XY" + bytes(14))
+        expected = protocol.encode_frame(
+            protocol.ERROR, 0, b"bad magic b'XY'"
+        )
+        got = b""
+        while len(got) < len(expected):
+            chunk = c.recv(4096)
+            if not chunk:
+                break
+            got += chunk
+        assert got == expected
+        assert c.recv(4096) == b""  # FIN after the notice

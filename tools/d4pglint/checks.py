@@ -18,6 +18,7 @@ from tools.d4pglint.config import (
     HOST_ONLY_MODULES,
     HOT_PATH_FUNCTIONS,
     JAX_FAMILY,
+    LOOP_CALLBACK_FUNCTIONS,
     MEGASTEP_FUNCTIONS,
     JIT_WRAPPER_CALLS,
     RNG_OK,
@@ -906,4 +907,110 @@ def counter_discipline(tree, src_lines, relpath):
                     visit(child, child_locked, meth)
 
             visit(m, False)
+    return out
+
+
+# ----------------------------------------------------------------- check 13
+@check("loop-blocking-call")
+def loop_blocking_call(tree, src_lines, relpath):
+    """The LOOP_CALLBACK_FUNCTIONS manifest names the code that runs on a
+    netio FrameLoop thread: ONE thread serves every connection, so a
+    single blocking call (socket I/O, sleep, subprocess, queue, wait/
+    join, file open) stalls the whole fleet's I/O at once — a self-
+    inflicted slowloris. Nested defs are checked only when explicitly
+    listed: most closures here are done-callbacks that run on OTHER
+    threads, while loop-timer closures (listed `Outer._tick` style) do
+    run on the loop. `conn.send(...)` is exempt by receiver name — that
+    is the Connection frame-queue API (append + wake, non-blocking by
+    contract); raw `sock.send/recv/accept` on the loop must carry a
+    suppression stating why the fd cannot block (non-blocking mode,
+    EWOULDBLOCK handled)."""
+    wanted = {}
+    for entry in LOOP_CALLBACK_FUNCTIONS:
+        suffix, qual = entry.split("::")
+        if relpath.endswith(suffix):
+            wanted[qual] = entry
+    if not wanted:
+        return []
+    out = []
+
+    def blocking_reason(call: ast.Call) -> str | None:
+        fn = call.func
+        if isinstance(fn, ast.Name) and fn.id == "open":
+            return "file open()"
+        if not isinstance(fn, ast.Attribute):
+            return None
+        owner = fn.value
+        dotted = _dotted(owner)
+        attr = fn.attr
+        if dotted == "time" and attr in BLOCKING_SIMPLE_CALLS:
+            return f"time.{attr}()"
+        for mod, names in BLOCKING_MODULE_CALLS.items():
+            if dotted == mod and attr in names:
+                return f"{mod}.{attr}()"
+        if attr in BLOCKING_METHOD_CALLS:
+            if attr == "send" and _terminal_name(owner) == "conn":
+                # the sanctioned reply path: Connection.send queues the
+                # encoded frame and wakes the loop — never a socket call
+                return None
+            return f".{attr}() (socket/future I/O)"
+        if attr == "wait":
+            # no cv exemption here (unlike lock-blocking-call): the loop
+            # thread waiting on ANYTHING freezes every connection
+            return ".wait() (loop thread must never wait)"
+        if attr == "join":
+            args_ok = all(
+                isinstance(a, ast.Constant)
+                and isinstance(a.value, (int, float))
+                for a in call.args
+            )
+            kw_ok = all(k.arg == "timeout" for k in call.keywords)
+            if args_ok and kw_ok:
+                # even a timeout-bounded join stalls every connection
+                # for the timeout — `", ".join(parts)` never matches
+                return ".join() (thread join)"
+            return None
+        name = _terminal_name(owner) or ""
+        if attr in BLOCKING_QUEUE_METHODS and (
+            "queue" in name.lower() or name.lower().endswith("_q") or name == "q"
+        ):
+            nonblocking = any(
+                k.arg == "block" and isinstance(k.value, ast.Constant)
+                and k.value.value is False
+                for k in call.keywords
+            )
+            if not nonblocking and not attr.endswith("_nowait"):
+                return f"queue .{attr}()"
+        return None
+
+    def scan(fn_node, qual: str):
+        # direct body only — a nested def runs on whatever thread calls
+        # it later and is checked iff the manifest lists it explicitly
+        for sub in _walk_skip_nested_defs(fn_node):
+            if not isinstance(sub, ast.Call):
+                continue
+            reason = blocking_reason(sub)
+            if reason:
+                out.append(
+                    Finding(
+                        "loop-blocking-call", relpath, sub.lineno,
+                        f"blocking call {reason} in loop callback "
+                        f"`{qual}`: one thread serves every connection — "
+                        "this stalls all of them; hand the work to a "
+                        "loop timer / another thread, or suppress with "
+                        "the reason the fd cannot block",
+                    )
+                )
+
+    def collect(body, prefix: str):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{node.name}"
+                if qual in wanted:
+                    scan(node, qual)
+                collect(node.body, f"{qual}.")
+            elif isinstance(node, ast.ClassDef):
+                collect(node.body, f"{prefix}{node.name}.")
+
+    collect(tree.body, "")
     return out

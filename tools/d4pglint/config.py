@@ -22,6 +22,7 @@ ALL_CHECKS = (
     "unbounded-retry",       # retry loops use the bounded Backoff util
     "device-loop-transfer",  # no host numpy / .item() in megastep bodies
     "counter-discipline",    # FLOW-manifest counters: +=/-= under lock only
+    "loop-blocking-call",    # no blocking call inside event-loop callbacks
     # -- whole-program checks (tools/d4pglint/wholeprog/): the full parsed
     #    file map at once, not one AST at a time --
     "lock-order",            # global lock-acquisition-order graph is acyclic
@@ -59,6 +60,13 @@ HOST_ONLY_MODULES = (
     "d4pg_tpu/runtime/metrics.py",
     "d4pg_tpu/serve/__init__.py",
     "d4pg_tpu/serve/protocol.py",
+    # The event-loop I/O core (ISSUE 20): one selectors thread owns every
+    # serving/router connection — it moves frame bytes for host-only
+    # front-ends (router included), so a JAX import here would leak into
+    # all of them AND stall the restart-in-milliseconds contract.
+    "d4pg_tpu/netio/__init__.py",
+    "d4pg_tpu/netio/loop.py",
+    "d4pg_tpu/netio/attack.py",
     "d4pg_tpu/serve/client.py",
     "d4pg_tpu/serve/stats.py",
     # The replica front-end moves bytes and stat files, never tensors: M
@@ -244,3 +252,65 @@ BLOCKING_METHOD_CALLS = (
     "recv", "send", "sendall", "accept", "connect", "listen", "result",
 )
 BLOCKING_QUEUE_METHODS = ("get", "put")  # on names containing queue/_q
+
+# Event-loop callback manifest (ISSUE 20): these functions run ON the
+# netio FrameLoop thread — ONE thread serves every connection, so a
+# single blocking call here stalls the whole fleet's I/O (the exact
+# failure the event-loop port exists to remove). `module suffix::qual`
+# keys like HOT_PATH_FUNCTIONS, except NESTED defs are NOT implicitly
+# checked and must be listed explicitly (`Outer._tick` style): most
+# closures in these files are done-callbacks that run on OTHER threads
+# (batcher reply threads, replica-link readers), while loop-timer
+# closures scheduled via call_soon/call_later DO run on the loop.
+# `conn.send(...)` is exempt by name: that is the Connection frame-queue
+# API (append + wake, non-blocking by contract), not a socket send —
+# raw `sock.send/recv/accept` sites must carry a suppression proving
+# the fd is non-blocking.
+LOOP_CALLBACK_FUNCTIONS = (
+    # the loop itself: everything dispatched from FrameLoop._run
+    "d4pg_tpu/netio/loop.py::FrameLoop._run",
+    "d4pg_tpu/netio/loop.py::FrameLoop._select_timeout",
+    "d4pg_tpu/netio/loop.py::FrameLoop._drain_waker",
+    "d4pg_tpu/netio/loop.py::FrameLoop._run_callbacks",
+    "d4pg_tpu/netio/loop.py::FrameLoop._call_at",
+    "d4pg_tpu/netio/loop.py::FrameLoop._run_timers",
+    "d4pg_tpu/netio/loop.py::FrameLoop._do_accept",
+    "d4pg_tpu/netio/loop.py::FrameLoop._shed_accept",
+    "d4pg_tpu/netio/loop.py::FrameLoop._resume_accept",
+    "d4pg_tpu/netio/loop.py::FrameLoop._close_listener",
+    "d4pg_tpu/netio/loop.py::FrameLoop._on_readable",
+    "d4pg_tpu/netio/loop.py::FrameLoop._check_read_deadline",
+    "d4pg_tpu/netio/loop.py::FrameLoop._flush",
+    "d4pg_tpu/netio/loop.py::FrameLoop._check_write_deadline",
+    "d4pg_tpu/netio/loop.py::FrameLoop._set_mask",
+    "d4pg_tpu/netio/loop.py::FrameLoop._protocol_error",
+    "d4pg_tpu/netio/loop.py::FrameLoop._evict",
+    "d4pg_tpu/netio/loop.py::FrameLoop._teardown",
+    "d4pg_tpu/netio/loop.py::FrameLoop._begin_shutdown",
+    "d4pg_tpu/netio/loop.py::FrameLoop._final_cleanup",
+    # the chaos attackers ride the victim's own loop as timer callbacks
+    "d4pg_tpu/netio/attack.py::tick_attacks",
+    "d4pg_tpu/netio/attack.py::_quiet_close",
+    "d4pg_tpu/netio/attack.py::_attack_socket",
+    "d4pg_tpu/netio/attack.py::_start_slowloris",
+    "d4pg_tpu/netio/attack.py::_start_slowloris._tick",
+    "d4pg_tpu/netio/attack.py::_start_zero_window",
+    "d4pg_tpu/netio/attack.py::_start_zero_window._tick",
+    "d4pg_tpu/netio/attack.py::_start_fd_exhaust",
+    "d4pg_tpu/netio/attack.py::_start_fd_exhaust._release",
+    # front-end frame handlers: per-frame work on the loop thread — the
+    # only slow work (inference / replica dispatch) must leave via a
+    # batcher submit or an async client future, never block in place
+    "d4pg_tpu/serve/server.py::PolicyServer._serve_conn",
+    "d4pg_tpu/serve/server.py::PolicyServer._on_conn_open",
+    "d4pg_tpu/serve/server.py::PolicyServer._on_conn_close",
+    "d4pg_tpu/serve/server.py::PolicyServer._on_protocol_error",
+    "d4pg_tpu/serve/server.py::PolicyServer._reply",
+    "d4pg_tpu/serve/router.py::Router._serve_conn",
+    "d4pg_tpu/serve/router.py::Router._admit_and_route",
+    "d4pg_tpu/serve/router.py::Router._on_conn_open",
+    "d4pg_tpu/serve/router.py::Router._on_conn_close",
+    "d4pg_tpu/serve/router.py::Router._on_protocol_error",
+    "d4pg_tpu/serve/router.py::Router._reply",
+    "d4pg_tpu/serve/router.py::Router._route",
+)

@@ -615,6 +615,93 @@ def check_multihost_microbench(path: str) -> list[str]:
     return errs
 
 
+def check_c10k_microbench(path: str) -> list[str]:
+    """Shape + invariants for ``benchmarks/c10k_microbench.json`` — the
+    ISSUE-20 acceptance artifact. Three refusals beyond the generic
+    rule: a broken accounting identity (``identity.ok`` not literally
+    true, or any recorded ``router`` flow-verdict not ok), fewer than
+    10000 held connections (the C10k floor IS the headline), and thread
+    growth past the constant budget (thread count O(conns) means the
+    event-loop claim regressed to thread-per-connection — refuse the
+    artifact, whatever the other numbers say)."""
+    errs = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: unreadable/invalid JSON ({e})"]
+    for key in ("backend", "conns_target", "held_connections", "slo_ms",
+                "threads", "interactive", "identity", "netio", "router_rc"):
+        if key not in doc:
+            errs.append(f"{path}: missing top-level key {key!r}")
+    ident = doc.get("identity")
+    if not isinstance(ident, dict) or ident.get("ok") is not True:
+        errs.append(
+            f"{path}: identity.ok is not true — the committed artifact "
+            "must never attest a broken accounting identity"
+        )
+    elif any(v.get("ok") is not True for v in ident.get("verdicts", [])):
+        errs.append(
+            f"{path}: a recorded flow-verdict is not ok: "
+            f"{ident['verdicts']!r}"
+        )
+    held = doc.get("held_connections")
+    if not isinstance(held, int) or held < 10000:
+        errs.append(
+            f"{path}: held_connections = {held!r} — the committed "
+            "artifact must hold >= 10000 concurrent connections"
+        )
+    th = doc.get("threads")
+    if not isinstance(th, dict):
+        errs.append(f"{path}: 'threads' must be an object")
+    else:
+        for key in ("threads_baseline", "threads_at_max", "growth",
+                    "growth_budget"):
+            if key not in th:
+                errs.append(f"{path}: threads missing {key!r}")
+        growth = th.get("growth")
+        budget = th.get("growth_budget")
+        if not isinstance(budget, int) or budget > 8:
+            errs.append(
+                f"{path}: threads.growth_budget = {budget!r} — the budget "
+                "itself must stay a small constant (<= 8), or 'O(1) "
+                "threads' stops meaning anything"
+            )
+        if not isinstance(growth, int) or (
+            isinstance(budget, int) and growth > budget
+        ):
+            errs.append(
+                f"{path}: threads.growth = {growth!r} past budget "
+                f"{budget!r} — thread count grew with connections; the "
+                "event-loop front-end regressed to thread-per-connection"
+            )
+    inter = doc.get("interactive")
+    if not isinstance(inter, dict):
+        errs.append(f"{path}: 'interactive' must be an object")
+    else:
+        p99 = inter.get("p99_ms")
+        slo = doc.get("slo_ms")
+        if not (isinstance(p99, (int, float)) and p99 > 0):
+            errs.append(f"{path}: interactive.p99_ms must be > 0")
+        elif isinstance(slo, (int, float)) and p99 > slo:
+            errs.append(
+                f"{path}: interactive.p99_ms {p99!r} > slo_ms {slo!r} — "
+                "interactive latency beside the held population is the "
+                "other half of the headline"
+            )
+        if inter.get("error"):
+            errs.append(
+                f"{path}: interactive.error = {inter['error']!r} — a "
+                "client died during the committed run"
+            )
+    if doc.get("router_rc") != 0:
+        errs.append(
+            f"{path}: router_rc = {doc.get('router_rc')!r} — the router "
+            "must drain rc 0 after the run"
+        )
+    return errs
+
+
 def check_league_soak(path: str) -> list[str]:
     """Shape + invariants for ``benchmarks/league_soak.json`` — the
     ISSUE-15 acceptance artifact (the league controller's end-of-run
@@ -976,6 +1063,8 @@ def check_tree(root: str) -> list[str]:
             errs.extend(check_flywheel_soak(path))
         if os.path.basename(path) == "multihost_microbench.json":
             errs.extend(check_multihost_microbench(path))
+        if os.path.basename(path) == "c10k_microbench.json":
+            errs.extend(check_c10k_microbench(path))
     for path in sorted(
         glob.glob(os.path.join(root, "runs", "**", "metrics.jsonl"),
                   recursive=True)
